@@ -1,0 +1,424 @@
+"""Lattice engine: state, streaming, per-stage step, iteration.
+
+TPU-native re-design of the reference lattice engine (reference
+src/Lattice.cu.Rt, src/LatticeContainer.inc.cpp.Rt, src/cuda.cu.Rt):
+
+* the reference's double-buffered ``FTabs`` snapshots + 27 margin blocks
+  become a single dense ``(n_storage, *shape)`` array per state; streaming is
+  a functional pull (``jnp.roll`` — periodic like the reference's wrapped
+  margins), so double buffering is XLA's problem (donated buffers), not ours;
+* the reference's per-(operation x globals x stage) generated kernel zoo
+  (src/cuda.cu.Rt:81-283) becomes ONE traced step function per stage,
+  specialized by ``jax.jit``;
+* per-node ``switch (NodeType & NODE_BOUNDARY)`` dispatch
+  (src/d2q9/Dynamics.c.Rt:121-150) becomes mask/select algebra on the flag
+  field — branchless, which is exactly what the VPU wants;
+* globals accumulated with shared-memory trees + atomics
+  (src/cuda.cu.Rt:176-202) become masked ``jnp.sum``/``max`` reductions
+  (deterministic, unlike the reference's atomic order).
+
+The engine is pure-functional: ``step(state, params) -> state`` is jittable,
+differentiable (the adjoint path — reference Tapenade machinery, tools/makeAD)
+and shardable (parallel/halo.py wraps it in ``shard_map``).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from functools import partial
+from typing import Any, Callable, Optional, Sequence
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from flax import struct
+
+from tclb_tpu.core.registry import Model
+
+FLAG_DTYPE = jnp.uint16
+
+
+@struct.dataclass
+class SimParams:
+    """Runtime parameters: the reference's GPU-const-memory settings
+    (src/LatticeContainer.inc.cpp.Rt:32-55) + zonal setting tables (C7,
+    src/ZoneSettings.h).  ``zone_table[s, z]`` is the value of setting ``s``
+    in settings-zone ``z``; non-zonal settings read ``settings[s]``."""
+
+    settings: jnp.ndarray        # (n_settings,) real
+    zone_table: jnp.ndarray      # (n_settings, zone_max) real
+
+
+@struct.dataclass
+class LatticeState:
+    """The complete per-step lattice state (a pytree — one pytree per
+    reference ``FTabs`` snapshot)."""
+
+    fields: jnp.ndarray          # (n_storage, *shape) real
+    flags: jnp.ndarray           # (*shape) uint16 node-type bitfield
+    globals_: jnp.ndarray        # (n_globals,) per-iteration integrals
+    iteration: jnp.ndarray       # () int32
+
+
+# --------------------------------------------------------------------------- #
+# Streaming
+# --------------------------------------------------------------------------- #
+
+
+def pull_stream(model: Model, fields: jnp.ndarray) -> jnp.ndarray:
+    """Pull-scheme streaming: plane ``i`` at node ``x`` receives the value
+    stored at ``x - e_i`` (reference pull streaming,
+    src/LatticeAccess.inc.cpp.Rt:182-263).  Periodic wrap — the reference's
+    global domain is periodic through its margin wiring; walls are painted.
+
+    ``jnp.roll(a, s)[x] == a[x - s]``, so rolling plane ``i`` by ``e_i``
+    is exactly the pull.  Zero-vector planes are left untouched.
+    """
+    ndim = model.ndim
+    out = []
+    for i in range(model.n_storage):
+        dx, dy, dz = (int(v) for v in model.ei[i])
+        plane = fields[i]
+        shifts, axes = [], []
+        # axis layout: (..., z, y, x) — x is last (TPU lane dimension)
+        for shift, axis in ((dz, -3), (dy, -2), (dx, -1)):
+            if shift and (ndim >= -axis):
+                shifts.append(shift)
+                axes.append(axis)
+        if shifts:
+            plane = jnp.roll(plane, shifts, axes)
+        out.append(plane)
+    return jnp.stack(out)
+
+
+# --------------------------------------------------------------------------- #
+# Node context — what a model's Run()/Init() sees
+# --------------------------------------------------------------------------- #
+
+
+class NodeCtx:
+    """The model-facing view of one lattice-wide kernel invocation.
+
+    Plays the role of the reference's generated node object (``Node_Run`` with
+    its pop'ed density locals, settings in const memory and NodeType register,
+    src/cuda.cu.Rt:236-274) — but vectorized over the whole (local) lattice:
+    every accessor returns full planes, and "per-node dispatch" is mask
+    algebra via :meth:`nt_is` / :meth:`boundary_case`.
+    """
+
+    def __init__(self, model: Model, fields: jnp.ndarray, raw: jnp.ndarray,
+                 flags: jnp.ndarray, params: SimParams):
+        self.model = model
+        self._fields = fields      # pulled (streamed) storage
+        self._raw = raw            # un-streamed storage (for Field loads)
+        self.flags = flags
+        self.params = params
+        self._globals: dict[str, jnp.ndarray] = {}
+        self._zone_ids = None
+
+    # -- field access ------------------------------------------------------- #
+
+    def group(self, name: str) -> jnp.ndarray:
+        """Streamed stack of all densities in a group: shape (n, *shape)."""
+        idx = self.model.groups[name]
+        return self._fields[jnp.array(idx)] if len(idx) > 1 \
+            else self._fields[idx[0]][None]
+
+    def density(self, name: str) -> jnp.ndarray:
+        return self._fields[self.model.storage_index[name]]
+
+    def load(self, name: str, dx: int = 0, dy: int = 0, dz: int = 0
+             ) -> jnp.ndarray:
+        """Neighbor access to a stored Field: value at ``x + (dx,dy,dz)``
+        (reference ``load_<field><DX,DY,DZ>``,
+        src/LatticeAccess.inc.cpp.Rt:266-292).  Rolling by ``-d`` brings the
+        ``x + d`` neighbor to ``x``."""
+        plane = self._raw[self.model.storage_index[name]]
+        ndim = self.model.ndim
+        shifts, axes = [], []
+        for shift, axis in ((dz, -3), (dy, -2), (dx, -1)):
+            if shift and (ndim >= -axis):
+                shifts.append(-shift)
+                axes.append(axis)
+        return jnp.roll(plane, shifts, axes) if shifts else plane
+
+    def store(self, groups: dict[str, jnp.ndarray]) -> jnp.ndarray:
+        """Write group stacks back into the full storage stack and return it
+        (the reference's push_<Stage> writes, src/LatticeAccess.inc.cpp.Rt:216-225).
+        Unmentioned storage keeps its streamed value."""
+        buf = self._fields
+        for g, stack in groups.items():
+            idx = self.model.groups[g]
+            if len(idx) == 1:
+                buf = buf.at[idx[0]].set(stack[0] if stack.ndim > buf.ndim - 1
+                                         else stack)
+            else:
+                buf = buf.at[jnp.array(idx)].set(stack)
+        return buf
+
+    # -- settings ----------------------------------------------------------- #
+
+    def setting(self, name: str) -> jnp.ndarray:
+        """Scalar for plain settings; per-node plane for zonal settings
+        (gathered through the flag's zone bits — reference ``ZoneSetting()``
+        device accessor, src/LatticeContainer.h.Rt:89-108)."""
+        m = self.model
+        i = m.setting_index[name]
+        spec = m.settings[i]
+        if not spec.zonal:
+            return self.params.settings[i]
+        if self._zone_ids is None:
+            self._zone_ids = (self.flags.astype(jnp.int32) >> m.zone_shift)
+        return self.params.zone_table[i][self._zone_ids]
+
+    # -- node types --------------------------------------------------------- #
+
+    def nt_is(self, name: str) -> jnp.ndarray:
+        """Bool plane: node's group-field equals this node type."""
+        t = self.model.node_types[name]
+        return (self.flags & FLAG_DTYPE(t.mask)) == FLAG_DTYPE(t.value)
+
+    def nt_in_group(self, group: str) -> jnp.ndarray:
+        m = self.model.group_masks[group]
+        return (self.flags & FLAG_DTYPE(m)) != FLAG_DTYPE(0)
+
+    def boundary_case(self, f: jnp.ndarray,
+                      cases: dict[str, Callable[[jnp.ndarray], jnp.ndarray]]
+                      ) -> jnp.ndarray:
+        """Vectorized ``switch (NodeType & NODE_<group>)``: each case function
+        maps the full stack to a modified stack; nodes whose group-field
+        equals the named type select that case's result, others keep ``f``
+        (each node type carries its own group mask).  Multiple names may
+        share a function by passing a tuple key."""
+        out = f
+        for names, fn in cases.items():
+            if isinstance(names, str):
+                names = (names,)
+            mask = self.nt_is(names[0])
+            for n in names[1:]:
+                mask = mask | self.nt_is(n)
+            out = jnp.where(mask[None], fn(f), out)
+        return out
+
+    # -- globals ------------------------------------------------------------ #
+
+    def add_global(self, name: str, plane: jnp.ndarray,
+                   where: Optional[jnp.ndarray] = None) -> None:
+        """Accumulate a per-node contribution to a Global (reference
+        ``AddTo<Global>`` + atomic reduction, src/cuda.cu.Rt:130-202).
+        ``where`` masks contributing nodes (e.g. objective node types)."""
+        if where is not None:
+            plane = jnp.where(where, plane, jnp.zeros_like(plane))
+        if name in self._globals:
+            self._globals[name] = self._globals[name] + plane
+        else:
+            self._globals[name] = plane
+
+    def reduce_globals(self) -> jnp.ndarray:
+        m = self.model
+        out = jnp.zeros((m.n_globals,),
+                        dtype=self._fields.dtype)
+        for name, plane in self._globals.items():
+            g = m.globals_[m.global_index[name]]
+            red = jnp.max(plane) if g.op == "MAX" else jnp.sum(plane)
+            out = out.at[m.global_index[name]].set(red)
+        return out
+
+
+# --------------------------------------------------------------------------- #
+# Step / iterate
+# --------------------------------------------------------------------------- #
+
+
+def make_stage_step(model: Model, stage_name: str) -> Callable:
+    """Build the pure step function for one stage (the reference compiles a
+    ``Node_Run`` kernel per stage, src/cuda.cu.Rt:209-283; we trace one)."""
+    stage = model.stages[stage_name]
+    fn = model.stage_fns[stage.main]
+    if fn is None:
+        raise ValueError(f"model {model.name}: stage {stage_name} has no "
+                         f"bound function {stage.main!r}")
+
+    def step(state: LatticeState, params: SimParams) -> LatticeState:
+        raw = state.fields
+        pulled = pull_stream(model, raw) if stage.load_densities else raw
+        ctx = NodeCtx(model, pulled, raw, state.flags, params)
+        new_fields = fn(ctx)
+        # a stage may return a partial update: dict name->plane
+        if isinstance(new_fields, dict):
+            buf = pulled
+            for name, plane in new_fields.items():
+                buf = buf.at[model.storage_index[name]].set(plane)
+            new_fields = buf
+        # Solid/Wall nodes keep the engine's semantics from the model's Run();
+        # nothing special here — BCs are the model's job via ctx.boundary_case.
+        return LatticeState(
+            fields=new_fields,
+            flags=state.flags,
+            globals_=ctx.reduce_globals(),
+            iteration=state.iteration + (1 if stage.load_densities else 0),
+        )
+
+    return step
+
+
+def make_action_step(model: Model, action: str = "Iteration") -> Callable:
+    """Compose an action's stages into one step (reference Actions,
+    src/conf.R:339 + the per-stage loop in Lattice::Iteration,
+    src/Lattice.cu.Rt:414-457)."""
+    steps = [make_stage_step(model, s) for s in model.actions[action]]
+
+    def step(state: LatticeState, params: SimParams) -> LatticeState:
+        for s in steps:
+            state = s(state, params)
+        return state
+
+    return step
+
+
+def make_iterate(model: Model, action: str = "Iteration",
+                 unroll: int = 1) -> Callable:
+    """niter-step loop as a ``lax.scan`` (reference Lattice::Iterate,
+    src/Lattice.cu.Rt:780-869).  Differentiable; wrap with ``jax.checkpoint``
+    policies for long-horizon adjoints (reference SnapLevel tape,
+    src/Lattice.cu.Rt:34-49)."""
+    step = make_action_step(model, action)
+
+    def iterate(state: LatticeState, params: SimParams, niter: int
+                ) -> LatticeState:
+        def body(s, _):
+            return step(s, params), None
+        state, _ = jax.lax.scan(body, state, None, length=niter,
+                                unroll=unroll)
+        return state
+
+    return iterate
+
+
+# --------------------------------------------------------------------------- #
+# Host-side Lattice wrapper
+# --------------------------------------------------------------------------- #
+
+
+class Lattice:
+    """Host-side convenience wrapper, mirroring the reference ``Lattice``
+    class surface (src/Lattice.h.Rt:36-168): allocate, Init, Iterate,
+    Get/Set densities, GetQuantity, settings, save/load."""
+
+    def __init__(self, model: Model, shape: Sequence[int],
+                 dtype: Any = jnp.float32,
+                 settings: Optional[dict[str, float]] = None):
+        if len(shape) != model.ndim:
+            raise ValueError(f"model {model.name} is {model.ndim}D; "
+                             f"got shape {shape}")
+        self.model = model
+        self.shape = tuple(int(s) for s in shape)
+        self.dtype = dtype
+        vec = model.settings_vector(settings)
+        self.params = SimParams(
+            settings=jnp.asarray(vec, dtype=dtype),
+            zone_table=jnp.asarray(
+                np.broadcast_to(vec[:, None], (len(vec), model.zone_max)),
+                dtype=dtype),
+        )
+        self.state = LatticeState(
+            fields=jnp.zeros((model.n_storage,) + self.shape, dtype=dtype),
+            flags=jnp.zeros(self.shape, dtype=FLAG_DTYPE),
+            globals_=jnp.zeros((model.n_globals,), dtype=dtype),
+            iteration=jnp.zeros((), dtype=jnp.int32),
+        )
+        self._iterate = jax.jit(make_iterate(model),
+                                static_argnames=("niter",), donate_argnums=0)
+        self._init = jax.jit(make_action_step(model, "Init"), donate_argnums=0)
+
+    # -- setup -------------------------------------------------------------- #
+
+    def set_flags(self, flags: np.ndarray) -> None:
+        """Overwrite the node-type field (reference Lattice::FlagOverwrite,
+        src/Lattice.cu.Rt:892-905)."""
+        assert flags.shape == self.shape
+        self.state = dataclasses.replace(
+            self.state, flags=jnp.asarray(flags, dtype=FLAG_DTYPE))
+
+    def set_setting(self, name: str, value: float, zone: Optional[int] = None
+                    ) -> None:
+        """reference Lattice::setSetting + zonal variant
+        (src/Lattice.cu.Rt:1135-1191)."""
+        m = self.model
+        vec = np.array(self.params.settings, dtype=np.float64)
+        table = np.array(self.params.zone_table, dtype=np.float64)
+        if zone is None:
+            m._set_with_derived(vec, name, float(value))
+            # keep un-touched zones following the scalar value
+            table[m.setting_index[name], :] = vec[m.setting_index[name]]
+        else:
+            table[m.setting_index[name], zone] = float(value)
+        self.params = SimParams(settings=jnp.asarray(vec, dtype=self.dtype),
+                                zone_table=jnp.asarray(table, dtype=self.dtype))
+
+    def init(self) -> None:
+        """Run the model's Init action (reference Lattice::Init)."""
+        self.state = self._init(self.state, self.params)
+
+    # -- running ------------------------------------------------------------ #
+
+    def iterate(self, niter: int) -> None:
+        self.state = self._iterate(self.state, self.params, niter)
+
+    # -- inspection --------------------------------------------------------- #
+
+    def get_quantity(self, name: str) -> jnp.ndarray:
+        """Evaluate a registered Quantity over the lattice (reference
+        Lattice::GetQuantity, src/Lattice.cu.Rt:1012-1036)."""
+        fn = self.model.quantity_fns[name]
+        ctx = NodeCtx(self.model, self.state.fields, self.state.fields,
+                      self.state.flags, self.params)
+        return fn(ctx)
+
+    def get_density(self, name: str) -> jnp.ndarray:
+        return self.state.fields[self.model.storage_index[name]]
+
+    def set_density(self, name: str, value: np.ndarray) -> None:
+        self.state = dataclasses.replace(
+            self.state, fields=self.state.fields.at[
+                self.model.storage_index[name]].set(
+                    jnp.asarray(value, dtype=self.dtype)))
+
+    def get_globals(self) -> dict[str, float]:
+        """reference Lattice::getGlobals (src/Lattice.cu.Rt:1093-1106)."""
+        vals = np.asarray(self.state.globals_)
+        return {g.name: float(vals[i]) for i, g in enumerate(self.model.globals_)}
+
+    def get_objective(self) -> float:
+        """Weighted objective from <Global>InObj settings (reference
+        Lattice::calcGlobals, src/Lattice.cu.Rt:1113-1129)."""
+        m = self.model
+        obj = 0.0
+        vals = np.asarray(self.state.globals_)
+        svec = np.asarray(self.params.settings)
+        for i, g in enumerate(m.globals_):
+            obj += float(svec[m.setting_index[g.name + "InObj"]]) * float(vals[i])
+        return obj
+
+    # -- checkpoint --------------------------------------------------------- #
+
+    def save(self, path: str) -> None:
+        """Full-state dump (reference Lattice::save, src/Lattice.cu.Rt:592-626)."""
+        np.savez(path,
+                 fields=np.asarray(self.state.fields),
+                 flags=np.asarray(self.state.flags),
+                 iteration=int(self.state.iteration),
+                 settings=np.asarray(self.params.settings),
+                 zone_table=np.asarray(self.params.zone_table))
+
+    def load(self, path: str) -> None:
+        d = np.load(path if path.endswith(".npz") else path + ".npz")
+        self.state = LatticeState(
+            fields=jnp.asarray(d["fields"], dtype=self.dtype),
+            flags=jnp.asarray(d["flags"], dtype=FLAG_DTYPE),
+            globals_=self.state.globals_,
+            iteration=jnp.asarray(d["iteration"], dtype=jnp.int32),
+        )
+        self.params = SimParams(
+            settings=jnp.asarray(d["settings"], dtype=self.dtype),
+            zone_table=jnp.asarray(d["zone_table"], dtype=self.dtype))

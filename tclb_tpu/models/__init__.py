@@ -1,0 +1,36 @@
+"""Model catalogue — the TPU-native counterpart of the reference's
+``src/<model>/`` directories (inventory: SURVEY.md §2.3).  Models register a
+builder here; ``get_model`` builds (and caches) the frozen Model with physics
+bound."""
+
+from __future__ import annotations
+
+import importlib
+from typing import Callable
+
+from tclb_tpu.core.registry import Model
+
+# model name -> module path (lazy import; building a model is cheap but
+# importing all of them on package import is not needed)
+_REGISTRY: dict[str, str] = {
+    "d2q9": "tclb_tpu.models.d2q9",
+}
+
+_CACHE: dict[str, Model] = {}
+
+
+def register(name: str, module: str) -> None:
+    _REGISTRY[name] = module
+
+
+def list_models() -> list[str]:
+    return sorted(_REGISTRY)
+
+
+def get_model(name: str) -> Model:
+    if name not in _CACHE:
+        if name not in _REGISTRY:
+            raise KeyError(f"unknown model {name!r}; known: {list_models()}")
+        mod = importlib.import_module(_REGISTRY[name])
+        _CACHE[name] = mod.build()
+    return _CACHE[name]

@@ -1,0 +1,93 @@
+"""Shared symbolic-free LBM math — the TPU-side equivalent of the reference's
+R algebra library (reference src/lib/feq.R, src/lib/cumulant.R,
+src/lib/lattice.R).  Where the reference emits closed-form C expressions from
+symbolic algebra at build time, we compute the same quantities numerically
+with numpy (constants) + jnp (traced), and let XLA do the fusing.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+import jax.numpy as jnp
+
+CS2 = 1.0 / 3.0  # lattice speed of sound squared
+
+
+def opposite(E: np.ndarray) -> np.ndarray:
+    """Index i -> index of -e_i (bounce-back pairing)."""
+    opp = np.zeros(len(E), dtype=np.int32)
+    for i, e in enumerate(E):
+        (j,) = np.where((E == -e).all(axis=1))
+        opp[i] = j[0]
+    return opp
+
+
+def weights(E: np.ndarray) -> np.ndarray:
+    """Standard lattice weights by speed shell (works for d2q9/d3q19/d3q27)."""
+    q, d = E.shape
+    table = {
+        (9, 2): {0: 4 / 9, 1: 1 / 9, 2: 1 / 36},
+        (19, 3): {0: 1 / 3, 1: 1 / 18, 2: 1 / 36},
+        (27, 3): {0: 8 / 27, 1: 2 / 27, 2: 1 / 54, 3: 1 / 216},
+        (5, 2): {0: 1 / 3, 1: 1 / 6},
+        (7, 3): {0: 1 / 4, 1: 1 / 8},
+    }[(q, d)]
+    return np.array([table[int((e * e).sum())] for e in E])
+
+
+def equilibrium(E: np.ndarray, W: np.ndarray, rho, u):
+    """Second-order Maxwell equilibrium
+    f_i = w_i rho (1 + e.u/cs2 + (e.u)^2/(2 cs4) - u^2/(2 cs2)).
+
+    ``u`` is a tuple of velocity planes; returns a (Q, *shape) stack.
+    """
+    dt = rho.dtype
+    usq = sum(c * c for c in u)
+    out = []
+    for i in range(len(E)):
+        # skip exact-zero velocity components so XLA sees fewer ops
+        eu = sum(float(E[i, a]) * u[a] for a in range(len(u)) if E[i, a])
+        if isinstance(eu, int):  # rest population: e.u == 0
+            common = 1.0 - usq / (2 * CS2)
+        else:
+            common = 1.0 + eu / CS2 + eu * eu / (2 * CS2 * CS2) \
+                - usq / (2 * CS2)
+        out.append(jnp.asarray(float(W[i]), dt) * rho * common)
+    return jnp.stack(out)
+
+
+def mrt_basis_d2q9(E: np.ndarray) -> np.ndarray:
+    """Orthogonal (Gram-Schmidt) d2q9 moment basis of Lallemand & Luo:
+    rows = (rho, jx, jy, e, eps, qx, qy, pxx, pxy) as integer polynomials of
+    the velocity set.  Matches the basis the reference builds symbolically in
+    src/lib/feq.R (used at src/d2q9/Dynamics.c.Rt:234-243)."""
+    ex, ey = E[:, 0].astype(np.float64), E[:, 1].astype(np.float64)
+    e2 = ex * ex + ey * ey
+    M = np.stack([
+        np.ones_like(ex),               # rho
+        ex,                             # jx
+        ey,                             # jy
+        3.0 * e2 - 4.0,                 # e (energy)
+        4.5 * e2 * e2 - 10.5 * e2 + 4.0,  # eps (energy squared)
+        (3.0 * e2 - 5.0) * ex,          # qx (energy flux)
+        (3.0 * e2 - 5.0) * ey,          # qy
+        ex * ex - ey * ey,              # pxx
+        ex * ey,                        # pxy
+    ])
+    # sanity: rows orthogonal
+    g = M @ M.T
+    assert np.allclose(g - np.diag(np.diag(g)), 0.0), "basis not orthogonal"
+    return M
+
+
+def moments(M: np.ndarray, f: jnp.ndarray) -> jnp.ndarray:
+    """m = M f over the leading (population) axis — an MXU matmul batched
+    over lattice points."""
+    return jnp.einsum("qi,i...->q...", jnp.asarray(M, f.dtype), f)
+
+
+def from_moments(M: np.ndarray, m: jnp.ndarray) -> jnp.ndarray:
+    """Inverse of :func:`moments` for an orthogonal (row) basis."""
+    norm = (M * M).sum(axis=1)
+    Minv = (M / norm[:, None]).T
+    return jnp.einsum("iq,q...->i...", jnp.asarray(Minv, m.dtype), m)

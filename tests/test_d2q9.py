@@ -1,0 +1,201 @@
+"""d2q9 physics validation: conservation, Poiseuille vs analytic profile,
+Zou/He channel smoke — the framework's analogue of the reference regression
+suite for the d2q9 family (SURVEY.md §4)."""
+
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from tclb_tpu.core.lattice import Lattice
+from tclb_tpu.models import get_model
+
+
+def make_lattice(shape, settings=None):
+    return Lattice(get_model("d2q9"), shape, dtype=jnp.float64,
+                   settings=settings)
+
+
+def flags_full_mrt(model, shape):
+    return np.full(shape, model.flag_for("MRT"), dtype=np.uint16)
+
+
+def test_mass_momentum_conservation_periodic():
+    m = get_model("d2q9")
+    lat = make_lattice((32, 64), {"nu": 0.05})
+    lat.set_flags(flags_full_mrt(m, (32, 64)))
+    lat.init()
+    # perturb away from uniform equilibrium (periodic shear wave)
+    f = np.array(lat.state.fields)
+    y = np.arange(32)[:, None]
+    ux = 0.01 * np.sin(2 * np.pi * y / 32) * np.ones((32, 64))
+    from tclb_tpu.models.d2q9 import _equilibrium
+    feq = _equilibrium(jnp.ones((32, 64), jnp.float64),
+                       jnp.asarray(ux), jnp.zeros((32, 64), jnp.float64))
+    f[:9] = np.asarray(feq)
+    lat.state = lat.state.replace(fields=jnp.asarray(f))
+
+    def mass_mom(lat):
+        rho = np.asarray(lat.get_quantity("Rho"))
+        u = np.asarray(lat.get_quantity("U"))
+        return rho.sum(), (rho * u[0]).sum(), (rho * u[1]).sum()
+
+    m0, jx0, jy0 = mass_mom(lat)
+    lat.iterate(50)
+    m1, jx1, jy1 = mass_mom(lat)
+    assert np.isclose(m0, m1, rtol=0, atol=1e-9 * m0)
+    assert np.isclose(jx0, jx1, atol=1e-10 * abs(m0))
+    assert np.isclose(jy0, jy1, atol=1e-10 * abs(m0))
+
+
+def test_shear_wave_viscosity():
+    """Decay rate of a periodic shear wave must match nu (validates that the
+    MRT S78 rate really encodes the viscosity)."""
+    nu = 0.05
+    ny = 64
+    m = get_model("d2q9")
+    lat = make_lattice((ny, 8), {"nu": nu})
+    lat.set_flags(flags_full_mrt(m, (ny, 8)))
+    lat.init()
+    k = 2 * np.pi / ny
+    y = np.arange(ny)[:, None]
+    u0 = 0.001
+    ux = u0 * np.sin(k * y) * np.ones((ny, 8))
+    from tclb_tpu.models.d2q9 import _equilibrium
+    feq = _equilibrium(jnp.ones((ny, 8), jnp.float64), jnp.asarray(ux),
+                       jnp.zeros((ny, 8), jnp.float64))
+    f = np.array(lat.state.fields)
+    f[:9] = np.asarray(feq)
+    lat.state = lat.state.replace(fields=jnp.asarray(f))
+    steps = 200
+    lat.iterate(steps)
+    u = np.asarray(lat.get_quantity("U"))
+    amp = np.abs(np.fft.fft(u[0, :, 0])[1]) * 2 / ny
+    expected = u0 * np.exp(-nu * k * k * steps)
+    assert np.isclose(amp, expected, rtol=2e-2)
+
+
+def test_poiseuille_body_force():
+    """Body-force-driven channel flow vs the parabolic analytic profile
+    (the reference's D2Q9_Poiseuille baseline case, BASELINE.md)."""
+    ny, nx = 34, 16
+    nu, g = 0.1666666, 1e-6
+    m = get_model("d2q9")
+    lat = make_lattice((ny, nx), {"nu": nu, "GravitationX": g})
+    flags = flags_full_mrt(m, (ny, nx))
+    flags[0, :] = m.flag_for("Wall")
+    flags[-1, :] = m.flag_for("Wall")
+    lat.set_flags(flags)
+    lat.init()
+    lat.iterate(8000)
+    u = np.asarray(lat.get_quantity("U"))
+    ux = u[0, :, nx // 2]
+    y = np.arange(ny, dtype=np.float64)
+    # full-way bounce-back walls sit half a cell inside the wall nodes
+    y0, y1 = 0.5, ny - 1.5
+    analytic = g / (2 * nu) * (y - y0) * (y1 - y)
+    sel = slice(1, ny - 1)
+    err = np.abs(ux[sel] - analytic[sel]).max() / analytic.max()
+    assert err < 2e-2, f"profile error {err:.3e}"
+
+
+def test_zou_he_channel_smoke():
+    """WVelocity inlet + EPressure outlet channel: stays finite, conserves
+    flux, and reports sensible globals (the Kármán benchmark geometry family,
+    reference example/karman.xml)."""
+    ny, nx = 36, 128
+    vel = 0.05
+    m = get_model("d2q9")
+    lat = make_lattice((ny, nx), {"nu": 0.05, "Velocity": vel})
+    flags = flags_full_mrt(m, (ny, nx))
+    # like the reference geometry: <MRT><Box/></MRT> first, then boundary
+    # types only overwrite the BOUNDARY bits — BC nodes keep colliding
+    flags[:, 0] = m.flag_for("WVelocity", "MRT")
+    flags[:, -1] = m.flag_for("EPressure", "MRT")
+    flags[0, :] = m.flag_for("Wall")
+    flags[-1, :] = m.flag_for("Wall")
+    # objective strips (reference karman.xml Inlet/Outlet boxes)
+    flags[1:-1, 5] = m.flag_for("MRT", "Inlet")
+    flags[1:-1, -6] = m.flag_for("MRT", "Outlet")
+    lat.set_flags(flags)
+    lat.init()
+    lat.iterate(2000)
+    u = np.asarray(lat.get_quantity("U"))
+    rho = np.asarray(lat.get_quantity("Rho"))
+    assert np.isfinite(u).all() and np.isfinite(rho).all()
+    assert abs(rho[1:-1, 1:-1].mean() - 1.0) < 0.05
+    g = lat.get_globals()
+    # flux through both strips should be positive and comparable (the run is
+    # still developing at 2000 steps — this is a smoke check, not steady state)
+    assert g["InletFlux"] > 0 and g["OutletFlux"] > 0
+    assert abs(g["InletFlux"] - g["OutletFlux"]) / g["InletFlux"] < 0.25
+    assert g["PressureLoss"] > 0
+
+
+def test_wpressure_drives_flow_forward():
+    """Pressure-driven channel: WPressure inlet at rho>1, EPressure outlet at
+    rho=1 must push flow in +x (regression: the W-side Zou/He reconstruction
+    must use the physical ux, reference WPressure semantics)."""
+    ny, nx = 20, 64
+    m = get_model("d2q9")
+    lat = make_lattice((ny, nx), {"nu": 0.1})
+    flags = flags_full_mrt(m, (ny, nx))
+    flags[:, 0] = m.flag_for("WPressure", "MRT", zone=1)
+    flags[:, -1] = m.flag_for("EPressure", "MRT")
+    lat.set_flags(flags)
+    # zone 1 = inlet overpressure
+    lat.set_setting("Density", 1.02, zone=1)
+    lat.init()
+    lat.iterate(500)
+    u = np.asarray(lat.get_quantity("U"))
+    assert np.isfinite(u).all()
+    assert u[0].mean() > 1e-4, f"mean ux={u[0].mean():.2e}, flow not driven +x"
+
+
+def test_derived_defaults_consistent():
+    """Default-constructed params must have a consistent derived chain
+    (nu default -> omega -> S78), regression for the defaults pass."""
+    m = get_model("d2q9")
+    vec = m.settings_vector()
+    omega = vec[m.setting_index["omega"]]
+    assert np.isclose(omega, 1.0 / (3 * (1 / 6) + 0.5))
+    assert np.isclose(vec[m.setting_index["S78"]], 1.0 - omega)
+
+
+def test_field_load_direction():
+    """ctx.load(name, dx=1) must return the +x neighbor."""
+    import jax.numpy as jnp
+    from tclb_tpu.core.lattice import NodeCtx, SimParams
+    from tclb_tpu.core.registry import ModelDef
+    d = ModelDef("loadtest", ndim=2)
+    d.add_density("f[0]")
+    d.add_field("phi", dx=(-1, 1), dy=(-1, 1))
+    mm = d.finalize()
+    raw = jnp.zeros((2, 4, 8))
+    plane = jnp.arange(4 * 8, dtype=jnp.float64).reshape(4, 8)
+    raw = raw.at[1].set(plane)
+    ctx = NodeCtx(mm, raw, raw, jnp.zeros((4, 8), jnp.uint16),
+                  SimParams(settings=jnp.zeros(1), zone_table=jnp.zeros((1, 1))))
+    got = ctx.load("phi", dx=1)
+    np.testing.assert_array_equal(np.asarray(got[:, :-1]),
+                                  np.asarray(plane[:, 1:]))
+    got = ctx.load("phi", dy=-1)
+    np.testing.assert_array_equal(np.asarray(got[1:, :]),
+                                  np.asarray(plane[:-1, :]))
+
+
+def test_save_load_roundtrip(tmp_path):
+    m = get_model("d2q9")
+    lat = make_lattice((16, 32), {"nu": 0.05})
+    lat.set_flags(flags_full_mrt(m, (16, 32)))
+    lat.init()
+    lat.iterate(10)
+    p = str(tmp_path / "ckpt.npz")
+    lat.save(p)
+    ref = np.array(lat.state.fields)
+    lat2 = make_lattice((16, 32))
+    lat2.load(p)
+    lat2.iterate(5)
+    lat.iterate(5)
+    np.testing.assert_array_equal(np.asarray(lat.state.fields),
+                                  np.asarray(lat2.state.fields))
+    assert ref.shape == lat2.state.fields.shape

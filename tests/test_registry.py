@@ -1,0 +1,52 @@
+"""Unit tests of the model registry / node-type packing — coverage the
+reference lacks entirely (its conf.R derivations are only exercised end-to-end,
+SURVEY.md §4)."""
+
+import numpy as np
+
+from tclb_tpu.models import get_model
+
+
+def test_node_type_packing_disjoint_groups():
+    m = get_model("d2q9")
+    masks = [t for g, t in m.group_masks.items()
+             if g not in ("ALL", "NONE")]
+    # group bit-spans must not overlap
+    for i, a in enumerate(masks):
+        for b in masks[i + 1:]:
+            assert a & b == 0
+    # values stay within their group's mask
+    for t in m.node_types.values():
+        assert t.value & ~t.mask == 0
+
+
+def test_flag_compose_and_zone():
+    m = get_model("d2q9")
+    v = m.flag_for("MRT", "Outlet", zone=3)
+    assert v & m.group_masks["COLLISION"] == m.nt_value("MRT")
+    assert v & m.group_masks["OBJECTIVE"] == m.nt_value("Outlet")
+    assert v >> m.zone_shift == 3
+    assert m.zone_max >= 2  # room for settings zones in 16 bits
+
+
+def test_derived_settings():
+    m = get_model("d2q9")
+    vec = m.settings_vector({"nu": 0.02})
+    omega = vec[m.setting_index["omega"]]
+    assert np.isclose(omega, 1.0 / (3 * 0.02 + 0.5))
+    # derived chains: nu -> omega -> S78 = 1 - omega
+    assert np.isclose(vec[m.setting_index["S78"]], 1.0 - omega)
+
+
+def test_globals_imply_inobj_settings():
+    m = get_model("d2q9")
+    for g in m.globals_:
+        assert g.name + "InObj" in m.setting_index
+
+
+def test_streaming_vectors():
+    m = get_model("d2q9")
+    ei = m.ei[:9]
+    # d2q9 set: one rest + 4 axis + 4 diagonal, momentum-free
+    assert (ei.sum(axis=0) == 0).all()
+    assert sorted((np.abs(e).sum() for e in ei)) == [0, 1, 1, 1, 1, 2, 2, 2, 2]

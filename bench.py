@@ -1,0 +1,66 @@
+"""Benchmark: d2q9 MRT Kármán channel, the reference's headline case
+(reference example/karman.xml: 1024x100 lattice) measured exactly the way the
+reference measures itself: MLUPS = nx*ny*iters/elapsed/1e6 (reference
+src/main.cpp.Rt:100-126).
+
+Prints ONE JSON line: metric/value/unit/vs_baseline.  ``vs_baseline`` is the
+achieved fraction of this chip's HBM streaming roofline for the same traffic
+model the reference prints as GB/s (2 x n_storage x sizeof(real) + flag read
+per node update, src/main.cpp.Rt:126) — the reference publishes no absolute
+numbers (BASELINE.md), so roofline fraction is the honest comparison axis.
+"""
+
+import json
+import os
+import time
+
+import numpy as np
+
+
+def main():
+    import jax
+    import jax.numpy as jnp
+    from tclb_tpu.core.lattice import Lattice
+    from tclb_tpu.models import get_model
+
+    # karman.xml is 1024x100; square it for steady bandwidth measurement.
+    # Env knobs exist for CPU smoke runs only; the driver runs defaults.
+    ny = nx = int(os.environ.get("TCLB_BENCH_N", 1024))
+    iters = int(os.environ.get("TCLB_BENCH_ITERS", 2000))
+    m = get_model("d2q9")
+    lat = Lattice(m, (ny, nx), dtype=jnp.float32,
+                  settings={"nu": 0.02, "Velocity": 0.01})
+    flags = np.full((ny, nx), m.flag_for("MRT"), dtype=np.uint16)
+    flags[:, 0] = m.flag_for("WVelocity", "MRT")
+    flags[:, -1] = m.flag_for("EPressure", "MRT")
+    flags[0, :] = m.flag_for("Wall")
+    flags[-1, :] = m.flag_for("Wall")
+    flags[ny//3:2*ny//3, nx//10:nx//5] = m.flag_for("Wall")
+    lat.set_flags(flags)
+    lat.init()
+
+    lat.iterate(50)  # warmup + compile
+    jax.block_until_ready(lat.state.fields)
+    t0 = time.perf_counter()
+    lat.iterate(iters)
+    jax.block_until_ready(lat.state.fields)
+    dt = time.perf_counter() - t0
+
+    mlups = ny * nx * iters / dt / 1e6
+    # HBM roofline: bytes per node update (reference traffic model)
+    bytes_per_update = 2 * m.n_storage * 4 + 2
+    dev = jax.devices()[0]
+    hbm_gbs = {"TPU v5 lite": 819.0, "TPU v5e": 819.0,
+               "TPU v5p": 2765.0, "TPU v4": 1228.0}.get(
+                   dev.device_kind, 819.0)
+    roofline_mlups = hbm_gbs * 1e9 / bytes_per_update / 1e6
+    print(json.dumps({
+        "metric": f"MLUPS d2q9 Karman {ny}x{nx} f32",
+        "value": round(mlups, 1),
+        "unit": "MLUPS",
+        "vs_baseline": round(mlups / roofline_mlups, 4),
+    }))
+
+
+if __name__ == "__main__":
+    main()

@@ -91,6 +91,38 @@ def pull_stream(model: Model, fields: jnp.ndarray) -> jnp.ndarray:
     return jnp.stack(out)
 
 
+class Streaming:
+    """Streaming strategy: how pulled densities and neighbor Field loads are
+    realized.  This default implements the single-device / global-array case
+    (periodic roll).  The sharded engine substitutes
+    :class:`tclb_tpu.parallel.halo.HaloStreaming`, which fetches halos over
+    the mesh — injecting the strategy here keeps model code identical in both
+    worlds (the reference achieves the same with its margin-block pointer
+    rewiring, src/Lattice.cu.Rt:399-410)."""
+
+    def __init__(self, model: Model):
+        self.model = model
+
+    def pull(self, fields: jnp.ndarray) -> jnp.ndarray:
+        return pull_stream(self.model, fields)
+
+    def make_loader(self, raw: jnp.ndarray) -> Callable:
+        """Return ``load(index, dx, dy, dz)`` giving the ``x + d`` neighbor
+        of storage plane ``index``."""
+        ndim = self.model.ndim
+
+        def load(index: int, dx: int, dy: int, dz: int) -> jnp.ndarray:
+            plane = raw[index]
+            shifts, axes = [], []
+            for shift, axis in ((dz, -3), (dy, -2), (dx, -1)):
+                if shift and (ndim >= -axis):
+                    shifts.append(-shift)
+                    axes.append(axis)
+            return jnp.roll(plane, shifts, axes) if shifts else plane
+
+        return load
+
+
 # --------------------------------------------------------------------------- #
 # Node context — what a model's Run()/Init() sees
 # --------------------------------------------------------------------------- #
@@ -107,10 +139,12 @@ class NodeCtx:
     """
 
     def __init__(self, model: Model, fields: jnp.ndarray, raw: jnp.ndarray,
-                 flags: jnp.ndarray, params: SimParams):
+                 flags: jnp.ndarray, params: SimParams,
+                 loader: Optional[Callable] = None):
         self.model = model
         self._fields = fields      # pulled (streamed) storage
         self._raw = raw            # un-streamed storage (for Field loads)
+        self._loader = loader or Streaming(model).make_loader(raw)
         self.flags = flags
         self.params = params
         self._globals: dict[str, jnp.ndarray] = {}
@@ -131,16 +165,9 @@ class NodeCtx:
              ) -> jnp.ndarray:
         """Neighbor access to a stored Field: value at ``x + (dx,dy,dz)``
         (reference ``load_<field><DX,DY,DZ>``,
-        src/LatticeAccess.inc.cpp.Rt:266-292).  Rolling by ``-d`` brings the
-        ``x + d`` neighbor to ``x``."""
-        plane = self._raw[self.model.storage_index[name]]
-        ndim = self.model.ndim
-        shifts, axes = [], []
-        for shift, axis in ((dz, -3), (dy, -2), (dx, -1)):
-            if shift and (ndim >= -axis):
-                shifts.append(-shift)
-                axes.append(axis)
-        return jnp.roll(plane, shifts, axes) if shifts else plane
+        src/LatticeAccess.inc.cpp.Rt:266-292).  Goes through the injected
+        streaming strategy so sharded runs fetch across shard boundaries."""
+        return self._loader(self.model.storage_index[name], dx, dy, dz)
 
     def store(self, groups: dict[str, jnp.ndarray]) -> jnp.ndarray:
         """Write group stacks back into the full storage stack and return it
@@ -230,19 +257,27 @@ class NodeCtx:
 # --------------------------------------------------------------------------- #
 
 
-def make_stage_step(model: Model, stage_name: str) -> Callable:
+def make_stage_step(model: Model, stage_name: str,
+                    streaming: Optional[Streaming] = None) -> Callable:
     """Build the pure step function for one stage (the reference compiles a
-    ``Node_Run`` kernel per stage, src/cuda.cu.Rt:209-283; we trace one)."""
+    ``Node_Run`` kernel per stage, src/cuda.cu.Rt:209-283; we trace one).
+
+    ``streaming`` injects the streaming strategy (pull + neighbor loads):
+    default is the global periodic roll; the sharded engine
+    (parallel/halo.py) injects a halo-exchange strategy instead."""
     stage = model.stages[stage_name]
     fn = model.stage_fns[stage.main]
     if fn is None:
         raise ValueError(f"model {model.name}: stage {stage_name} has no "
                          f"bound function {stage.main!r}")
+    if streaming is None:
+        streaming = Streaming(model)
 
     def step(state: LatticeState, params: SimParams) -> LatticeState:
         raw = state.fields
-        pulled = pull_stream(model, raw) if stage.load_densities else raw
-        ctx = NodeCtx(model, pulled, raw, state.flags, params)
+        pulled = streaming.pull(raw) if stage.load_densities else raw
+        ctx = NodeCtx(model, pulled, raw, state.flags, params,
+                      loader=streaming.make_loader(raw))
         new_fields = fn(ctx)
         # a stage may return a partial update: dict name->plane
         if isinstance(new_fields, dict):
@@ -262,11 +297,13 @@ def make_stage_step(model: Model, stage_name: str) -> Callable:
     return step
 
 
-def make_action_step(model: Model, action: str = "Iteration") -> Callable:
+def make_action_step(model: Model, action: str = "Iteration",
+                     streaming: Optional[Streaming] = None) -> Callable:
     """Compose an action's stages into one step (reference Actions,
     src/conf.R:339 + the per-stage loop in Lattice::Iteration,
     src/Lattice.cu.Rt:414-457)."""
-    steps = [make_stage_step(model, s) for s in model.actions[action]]
+    steps = [make_stage_step(model, s, streaming)
+             for s in model.actions[action]]
 
     def step(state: LatticeState, params: SimParams) -> LatticeState:
         for s in steps:
@@ -277,12 +314,13 @@ def make_action_step(model: Model, action: str = "Iteration") -> Callable:
 
 
 def make_iterate(model: Model, action: str = "Iteration",
-                 unroll: int = 1) -> Callable:
+                 unroll: int = 1,
+                 streaming: Optional[Streaming] = None) -> Callable:
     """niter-step loop as a ``lax.scan`` (reference Lattice::Iterate,
     src/Lattice.cu.Rt:780-869).  Differentiable; wrap with ``jax.checkpoint``
     policies for long-horizon adjoints (reference SnapLevel tape,
     src/Lattice.cu.Rt:34-49)."""
-    step = make_action_step(model, action)
+    step = make_action_step(model, action, streaming)
 
     def iterate(state: LatticeState, params: SimParams, niter: int
                 ) -> LatticeState:
@@ -307,13 +345,15 @@ class Lattice:
 
     def __init__(self, model: Model, shape: Sequence[int],
                  dtype: Any = jnp.float32,
-                 settings: Optional[dict[str, float]] = None):
+                 settings: Optional[dict[str, float]] = None,
+                 mesh: Any = None):
         if len(shape) != model.ndim:
             raise ValueError(f"model {model.name} is {model.ndim}D; "
                              f"got shape {shape}")
         self.model = model
         self.shape = tuple(int(s) for s in shape)
         self.dtype = dtype
+        self.mesh = mesh
         vec = model.settings_vector(settings)
         self.params = SimParams(
             settings=jnp.asarray(vec, dtype=dtype),
@@ -327,8 +367,17 @@ class Lattice:
             globals_=jnp.zeros((model.n_globals,), dtype=dtype),
             iteration=jnp.zeros((), dtype=jnp.int32),
         )
-        self._iterate = jax.jit(make_iterate(model),
-                                static_argnames=("niter",), donate_argnums=0)
+        if mesh is not None:
+            from tclb_tpu.parallel.halo import make_sharded_iterate
+            from tclb_tpu.parallel.mesh import shard_state
+            self._iterate = make_sharded_iterate(model, mesh)
+            self._place = lambda: shard_state(self.state, self.params, mesh)
+            self.state, self.params = self._place()
+        else:
+            self._iterate = jax.jit(make_iterate(model),
+                                    static_argnames=("niter",),
+                                    donate_argnums=0)
+            self._place = None
         self._init = jax.jit(make_action_step(model, "Init"), donate_argnums=0)
 
     # -- setup -------------------------------------------------------------- #
@@ -339,6 +388,8 @@ class Lattice:
         assert flags.shape == self.shape
         self.state = dataclasses.replace(
             self.state, flags=jnp.asarray(flags, dtype=FLAG_DTYPE))
+        if self._place is not None:
+            self.state, self.params = self._place()
 
     def set_setting(self, name: str, value: float, zone: Optional[int] = None
                     ) -> None:
@@ -355,6 +406,8 @@ class Lattice:
             table[m.setting_index[name], zone] = float(value)
         self.params = SimParams(settings=jnp.asarray(vec, dtype=self.dtype),
                                 zone_table=jnp.asarray(table, dtype=self.dtype))
+        if self._place is not None:
+            self.state, self.params = self._place()
 
     def init(self) -> None:
         """Run the model's Init action (reference Lattice::Init)."""
@@ -383,6 +436,8 @@ class Lattice:
             self.state, fields=self.state.fields.at[
                 self.model.storage_index[name]].set(
                     jnp.asarray(value, dtype=self.dtype)))
+        if self._place is not None:
+            self.state, self.params = self._place()
 
     def get_globals(self) -> dict[str, float]:
         """reference Lattice::getGlobals (src/Lattice.cu.Rt:1093-1106)."""
@@ -422,3 +477,5 @@ class Lattice:
         self.params = SimParams(
             settings=jnp.asarray(d["settings"], dtype=self.dtype),
             zone_table=jnp.asarray(d["zone_table"], dtype=self.dtype))
+        if self._place is not None:
+            self.state, self.params = self._place()

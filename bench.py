@@ -39,14 +39,20 @@ def main():
     lat.set_flags(flags)
     lat.init()
 
-    lat.iterate(50)  # warmup + compile
+    # warmup with the SAME niter: niter is a static jit arg, so a different
+    # value would recompile inside the timed region
+    chunk = min(iters, 500)
+    lat.iterate(chunk)
     jax.block_until_ready(lat.state.fields)
     t0 = time.perf_counter()
-    lat.iterate(iters)
+    done = 0
+    while done < iters:
+        lat.iterate(chunk)
+        done += chunk
     jax.block_until_ready(lat.state.fields)
     dt = time.perf_counter() - t0
 
-    mlups = ny * nx * iters / dt / 1e6
+    mlups = ny * nx * done / dt / 1e6
     # HBM roofline: bytes per node update (reference traffic model)
     bytes_per_update = 2 * m.n_storage * 4 + 2
     dev = jax.devices()[0]

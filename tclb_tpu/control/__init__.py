@@ -1,0 +1,7 @@
+"""Control layer: the XML-driven run orchestration (reference Handlers,
+src/Handlers.{h,cpp.Rt}; Solver, src/Solver.{h,cpp}.Rt; main,
+src/main.cpp.Rt).  The config file *is* the program."""
+
+from tclb_tpu.control.solver import Solver, run_config, run_config_string
+
+__all__ = ["Solver", "run_config", "run_config_string"]

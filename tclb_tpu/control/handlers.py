@@ -1,0 +1,545 @@
+"""XML handler tree — element name -> behavior.
+
+Parity target: the reference Handlers layer (src/Handlers.{h,cpp.Rt}):
+``vHandler`` scheduling with fractional intervals (Now/Next,
+src/Handlers.h:46-78), ``GenericAction`` recursive execution + callback
+stacking (src/Handlers.cpp.Rt:1418-1454), ``getHandler`` dispatch
+(:2989-3119), and the individual handler classes listed in SURVEY.md §2.2.
+
+Handlers run host-side; everything device-bound goes through the Lattice.
+"""
+
+from __future__ import annotations
+
+import math
+import xml.etree.ElementTree as ET
+from typing import Optional
+
+import numpy as np
+
+from tclb_tpu.control.solver import ITERATION_STOP, Solver
+
+
+class Handler:
+    """Base scheduling unit (reference vHandler, src/Handlers.h:24-78)."""
+
+    kind = "action"   # action | callback | container | design
+
+    def __init__(self, node: ET.Element, solver: Solver):
+        self.node = node
+        self.solver = solver
+        self.start_iter = 0
+        self.every_iter = 0.0
+
+    # -- schedule ----------------------------------------------------------- #
+
+    def _parse_interval(self) -> None:
+        self.start_iter = self.solver.iter
+        attr = self.node.get("Iterations")
+        self.every_iter = self.solver.units.alt(attr) if attr else 0.0
+
+    def now(self, it: int) -> bool:
+        """True when ``it`` is a firing iteration (reference vHandler::Now:
+        handles fractional intervals by floor-crossing)."""
+        if not self.every_iter:
+            return False
+        it -= self.start_iter
+        return math.floor(it / self.every_iter) > \
+            math.floor((it - 1) / self.every_iter)
+
+    def next_it(self, it: int) -> int:
+        """Steps until the next firing (reference vHandler::Next)."""
+        if not self.every_iter:
+            return -1
+        it -= self.start_iter
+        k = math.floor(it / self.every_iter)
+        return int(-math.floor(-(k + 1) * self.every_iter)) - it
+
+    # -- lifecycle ---------------------------------------------------------- #
+
+    def init(self) -> int:
+        self._parse_interval()
+        if self.node.get("output"):
+            self.solver.output_prefix = self.node.get("output")
+        return 0
+
+    def do_it(self) -> int:
+        return 0
+
+    def finish(self) -> int:
+        return 0
+
+
+class GenericAction(Handler):
+    """Container executing children immediately; periodic children stack
+    into ``solver.hands`` until this action completes (reference
+    GenericAction::ExecuteInternal/Unstack, src/Handlers.cpp.Rt:1418-1454)."""
+
+    def init(self) -> int:
+        super().init()
+        return self.execute_internal()
+
+    def execute_internal(self) -> int:
+        self._stacked = 0
+        for child in self.node:
+            h = get_handler(child, self.solver)
+            if h is None:
+                continue
+            ret = h.init()
+            if ret not in (0, None):
+                return ret
+            if h.every_iter or h.kind == "design":
+                self.solver.hands.append(h)
+                self._stacked += 1
+        return 0
+
+    def unstack(self) -> None:
+        for _ in range(getattr(self, "_stacked", 0)):
+            h = self.solver.hands.pop()
+            h.finish()
+
+
+class MainContainer(GenericAction):
+    """<CLBConfig> root (reference MainContainer,
+    src/Handlers.cpp.Rt:1501-1529)."""
+
+    kind = "container"
+
+    def init(self) -> int:
+        self.start_iter = self.solver.iter
+        self.every_iter = 0.0
+        if self.node.get("output"):
+            self.solver.output_prefix = self.node.get("output")
+        ret = self.execute_internal()
+        self.unstack()
+        return ret
+
+
+class acSolve(GenericAction):
+    """<Solve Iterations="N">: the main loop — event-driven batching of
+    lattice iterations between due callbacks (reference acSolve,
+    src/Handlers.cpp.Rt:1531-1570)."""
+
+    def init(self) -> int:
+        Handler.init(self)
+        ret = self.execute_internal()
+        if ret not in (0, None):
+            return ret
+        s = self.solver
+        stop = False
+        while True:
+            next_it = self.next_it(s.iter)
+            for h in s.hands:
+                it = h.next_it(s.iter)
+                if 0 < it < next_it:
+                    next_it = it
+            steps = next_it
+            s.iter += steps
+            s.lattice.iterate(steps)
+            for h in s.hands:
+                if h.now(s.iter):
+                    r = h.do_it()
+                    if r == ITERATION_STOP:
+                        stop = True
+                    elif r not in (0, None):
+                        return r
+            if stop or self.now(s.iter):
+                break
+        self.unstack()
+        return 0
+
+
+class acRepeat(GenericAction):
+    """<Repeat Times="N">: run children N times (reference acRepeat,
+    src/Handlers.cpp.Rt:2191-2212)."""
+
+    def init(self) -> int:
+        Handler.init(self)
+        times = int(self.node.get("Times", "1"))
+        for _ in range(times):
+            ret = self.execute_internal()
+            if ret not in (0, None):
+                return ret
+            self.unstack()
+        return 0
+
+
+class acGeometry(Handler):
+    """<Geometry>: run the painter and push flags (reference acGeometry,
+    src/Handlers.cpp.Rt:2975-2988)."""
+
+    def init(self) -> int:
+        super().init()
+        s = self.solver
+        s.geometry.load(self.node)
+        s.lattice.set_flags(s.geometry.result())
+        return 0
+
+
+class acModel(GenericAction):
+    """<Model>: children (Params) then lattice Init (reference acModel,
+    src/Handlers.cpp.Rt:2643-2652)."""
+
+    def init(self) -> int:
+        Handler.init(self)
+        ret = self.execute_internal()
+        if ret not in (0, None):
+            return ret
+        self.solver.lattice.init()
+        self.unstack()
+        return 0
+
+
+class acInit(Handler):
+    """<Init/>: re-run the Init action (reference acInit,
+    src/Handlers.cpp.Rt:2653-2662)."""
+
+    def init(self) -> int:
+        super().init()
+        self.solver.lattice.init()
+        return 0
+
+
+class acParams(Handler):
+    """<Params name="value" name-zone="value">: set (zonal) settings through
+    the units engine; unknown names are ignored with a warning (reference
+    acParams, src/Handlers.cpp.Rt:2487-2530)."""
+
+    def init(self) -> int:
+        super().init()
+        s = self.solver
+        m = s.model
+        for name, raw in self.node.attrib.items():
+            if name in ("Iterations", "output"):
+                continue
+            zone: Optional[int] = None
+            par = name
+            if "-" in name:
+                par, zname = name.split("-", 1)
+                if zname in s.geometry.setting_zones:
+                    zone = s.geometry.setting_zones[zname]
+                else:
+                    print(f"WARNING: unknown zone {zname!r} "
+                          f"(setting {par})")
+                    continue
+            if par in m.setting_index:
+                val = s.units.alt(raw)
+                s.lattice.set_setting(par, val, zone=zone)
+        return 0
+
+
+class cbVTK(Handler):
+    kind = "callback"
+
+    def _what(self) -> Optional[set]:
+        w = self.node.get("what")
+        return set(w.split(",")) if w else None
+
+    def do_it(self) -> int:
+        self.solver.write_vtk(self._what())
+        return 0
+
+    def init(self) -> int:
+        super().init()
+        if not self.every_iter:
+            return self.do_it()
+        return 0
+
+
+class cbTXT(cbVTK):
+    def do_it(self) -> int:
+        self.solver.write_txt(self._what())
+        return 0
+
+
+class cbBIN(cbVTK):
+    def do_it(self) -> int:
+        self.solver.write_bin()
+        return 0
+
+
+class cbLog(Handler):
+    kind = "callback"
+
+    def do_it(self) -> int:
+        self.solver.write_log()
+        return 0
+
+    def init(self) -> int:
+        super().init()
+        if not self.every_iter:
+            return self.do_it()
+        return 0
+
+
+class cbDumpSettings(Handler):
+    kind = "callback"
+
+    def do_it(self) -> int:
+        s = self.solver
+        path = s.out_path("Settings", "txt")
+        svec = np.asarray(s.lattice.params.settings)
+        with open(path, "w") as f:
+            for spec in s.model.settings:
+                f.write(f"{spec.name} = "
+                        f"{svec[s.model.setting_index[spec.name]]!r}\n")
+        return 0
+
+    def init(self) -> int:
+        super().init()
+        if not self.every_iter:
+            return self.do_it()
+        return 0
+
+
+class cbStop(Handler):
+    """<Stop GlobalChange="eps" Times="k">: stop when every watched Global
+    changed less than eps for k consecutive checks (reference cbStop,
+    src/Handlers.cpp.Rt:1079-1157)."""
+
+    kind = "callback"
+
+    def init(self) -> int:
+        super().init()
+        m = self.solver.model
+        self.watch: list[tuple[str, float]] = []
+        for g in m.globals_:
+            a = self.node.get(g.name + "Change")
+            if a is not None:
+                self.watch.append((g.name, float(a)))
+        if not self.watch:
+            raise ValueError("No *Change attribute in <Stop>")
+        self.times = int(self.node.get("Times", "1"))
+        self.old = {n: -12341234.0 for n, _ in self.watch}
+        self.score = 0
+        return 0
+
+    def do_it(self) -> int:
+        g = self.solver.lattice.get_globals()
+        any_change = 0
+        for name, eps in self.watch:
+            if abs(self.old[name] - g[name]) > eps:
+                any_change += 1
+            self.old[name] = g[name]
+        self.score = 0 if any_change else self.score + 1
+        if self.score >= self.times:
+            self.score = 0
+            for name, _ in self.watch:
+                self.old[name] = -12341234.0
+            return ITERATION_STOP
+        return 0
+
+
+class cbFailcheck(Handler):
+    """<Failcheck Iterations="N">: NaN scan of quantities; on failure run
+    child elements (rescue dump) then stop (reference cbFailcheck,
+    src/Handlers.cpp.Rt:1175-1277)."""
+
+    kind = "callback"
+
+    def do_it(self) -> int:
+        s = self.solver
+        what = self.node.get("what")
+        names = set(what.split(",")) if what else {"all"}
+        bad = False
+        for q in s.model.quantities:
+            if q.adjoint:
+                continue
+            if "all" not in names and q.name not in names:
+                continue
+            arr = np.asarray(s.lattice.get_quantity(q.name))
+            if not np.isfinite(arr).all():
+                print(f"Failcheck: {q.name} has non-finite values")
+                bad = True
+                break
+        if bad:
+            for child in self.node:
+                h = get_handler(child, self.solver)
+                if h is not None:
+                    h.init()
+                    h.do_it()
+            return ITERATION_STOP
+        return 0
+
+
+class cbSample(Handler):
+    """<Sample what="U,Rho" Iterations="N"><Point dx=... dy=.../></Sample>
+    (reference cbSample, src/Handlers.cpp.Rt:1278-1337): per-iteration point
+    probes flushed on the callback."""
+
+    kind = "callback"
+
+    def init(self) -> int:
+        super().init()
+        if not self.every_iter:
+            raise ValueError("Sampler needs a nonzero Iterations attribute")
+        s = self.solver
+        what = self.node.get("what")
+        quants = ([q.name for q in s.model.quantities if not q.adjoint]
+                  if not what or what == "all" else what.split(","))
+        pts = []
+        for p in self.node:
+            if p.tag != "Point":
+                raise ValueError(f"unknown element <{p.tag}> in Sampler")
+            x = int(round(s.units.alt(p.get("dx", "0"))))
+            y = int(round(s.units.alt(p.get("dy", "0"))))
+            z = int(round(s.units.alt(p.get("dz", "0"))))
+            pts.append((z, y, x)[-s.model.ndim:])
+        from tclb_tpu.utils.sampler import Sampler
+        self.sampler = Sampler(s.model, quants, np.asarray(pts),
+                               s.out_path("Sample", "csv", with_iter=False),
+                               s.units)
+        s.lattice.attach_sampler(self.sampler)
+        return 0
+
+    def do_it(self) -> int:
+        self.sampler.flush()
+        return 0
+
+    def finish(self) -> int:
+        self.sampler.flush()
+        self.solver.lattice.sampler = None
+        return 0
+
+
+class cbKeep(Handler):
+    """<Keep What="..." Above=|Below=|Equal=...>: feedback controller pinning
+    a Global by adjusting its InObj weight (reference cbKeep,
+    src/Handlers.cpp.Rt:1339-1417)."""
+
+    kind = "callback"
+
+    def init(self) -> int:
+        super().init()
+        self.gname = self.node.get("What")
+        if self.gname not in self.solver.model.global_index:
+            raise ValueError(f"Keep: unknown global {self.gname!r}")
+        for mode in ("Above", "Below", "Equal"):
+            if self.node.get(mode) is not None:
+                self.mode = mode
+                self.target = self.solver.units.alt(self.node.get(mode))
+                break
+        else:
+            raise ValueError("Keep needs Above=, Below= or Equal=")
+        self.rate = float(self.node.get("Rate", "1.0"))
+        return 0
+
+    def do_it(self) -> int:
+        s = self.solver
+        val = s.lattice.get_globals()[self.gname]
+        wname = self.gname + "InObj"
+        cur = float(np.asarray(s.lattice.params.settings)[
+            s.model.setting_index[wname]])
+        err = val - self.target
+        if (self.mode == "Above" and err < 0) or \
+           (self.mode == "Below" and err > 0) or self.mode == "Equal":
+            cur -= self.rate * err
+            s.lattice.set_setting(wname, cur)
+        return 0
+
+
+class cbSaveBinary(Handler):
+    kind = "callback"
+
+    def do_it(self) -> int:
+        s = self.solver
+        fn = self.node.get("filename") or s.out_path("Save", "npz")
+        s.lattice.save(fn[:-4] if fn.endswith(".npz") else fn)
+        return 0
+
+    def init(self) -> int:
+        super().init()
+        if not self.every_iter:
+            return self.do_it()
+        return 0
+
+
+class acLoadBinary(Handler):
+    def init(self) -> int:
+        super().init()
+        fn = self.node.get("filename")
+        if not fn:
+            raise ValueError("LoadBinary needs filename=")
+        self.solver.lattice.load(fn)
+        return 0
+
+
+class acCallPython(Handler):
+    """<CallPython module="m" function="f">: call a user function with the
+    solver — the reference builds numpy views over staged component buffers
+    (cbPythonCall, src/Handlers.cpp.Rt:2774-2970); here the framework *is*
+    Python, so the user function receives the live Solver and mutates
+    densities via get/set_density."""
+
+    kind = "callback"
+
+    def init(self) -> int:
+        super().init()
+        import importlib
+        mod = self.node.get("module")
+        fn = self.node.get("function", "run")
+        self._fn = getattr(importlib.import_module(mod), fn)
+        if not self.every_iter:
+            return self.do_it()
+        return 0
+
+    def do_it(self) -> int:
+        ret = self._fn(self.solver)
+        return int(ret) if ret else 0
+
+
+class GenericContainer(GenericAction):
+    kind = "container"
+
+    def init(self) -> int:
+        Handler.init(self)
+        ret = self.execute_internal()
+        self.unstack()
+        return ret
+
+
+class acNop(Handler):
+    """Elements handled elsewhere (Units is read before the tree runs)."""
+
+    def init(self) -> int:
+        return 0
+
+
+_HANDLERS = {
+    "CLBConfig": MainContainer,
+    "Solve": acSolve,
+    "Repeat": acRepeat,
+    "Geometry": acGeometry,
+    "Model": acModel,
+    "Init": acInit,
+    "Params": acParams,
+    "VTK": cbVTK,
+    "TXT": cbTXT,
+    "BIN": cbBIN,
+    "Log": cbLog,
+    "Stop": cbStop,
+    "Failcheck": cbFailcheck,
+    "Sample": cbSample,
+    "Keep": cbKeep,
+    "SaveBinary": cbSaveBinary,
+    "SaveMemoryDump": cbSaveBinary,
+    "LoadBinary": acLoadBinary,
+    "LoadMemoryDump": acLoadBinary,
+    "DumpSettings": cbDumpSettings,
+    "CallPython": acCallPython,
+    "Units": acNop,
+    "Container": GenericContainer,
+}
+
+
+def register_handler(name: str, cls) -> None:
+    _HANDLERS[name] = cls
+
+
+def get_handler(node: ET.Element, solver: Solver) -> Optional[Handler]:
+    """Element name -> handler instance (reference getHandler,
+    src/Handlers.cpp.Rt:2989-3119)."""
+    cls = _HANDLERS.get(node.tag)
+    if cls is None:
+        raise ValueError(f"unknown config element <{node.tag}>")
+    return cls(node, solver)

@@ -1,0 +1,223 @@
+"""Solver: process-level orchestration + config entry points.
+
+Parity target: reference ``Solver`` (src/Solver.h.Rt:57-171,
+src/Solver.cpp.Rt) and ``main()`` (src/main.cpp.Rt:172-346): read units and
+gauge them, size the lattice from the <Geometry> element, run the handler
+tree, fan out VTK/TXT/BIN/Log output, keep the iteration counter and the
+stacked periodic callbacks.
+
+The reference's per-rank MPI bookkeeping (MPIDivision, node tables) has no
+equivalent here: device parallelism is a ``jax.sharding.Mesh`` handed to the
+Lattice, and every host-side array is the *global* lattice (JAX global-view
+arrays), so output and geometry code is rank-free by construction.
+"""
+
+from __future__ import annotations
+
+import os
+import time
+import xml.etree.ElementTree as ET
+from typing import Any, Optional
+
+import numpy as np
+
+from tclb_tpu.core.lattice import Lattice
+from tclb_tpu.core.registry import Model
+from tclb_tpu.utils.geometry import Geometry
+from tclb_tpu.utils.units import UnitEnv
+from tclb_tpu.utils.vtk import CSVLog
+
+ITERATION_STOP = 1
+
+
+class Solver:
+    """Host orchestration state shared by all handlers."""
+
+    def __init__(self, model: Model, output: str = "output/",
+                 mesh: Any = None, dtype: Any = None):
+        self.model = model
+        self.units = UnitEnv()
+        self.output_prefix = output
+        self.mesh = mesh
+        self.dtype = dtype
+        self.lattice: Optional[Lattice] = None
+        self.geometry: Optional[Geometry] = None
+        self.shape: tuple[int, ...] = ()
+        self.iter = 0
+        self.iter_type = 0
+        self.opt_iter = 0
+        self.hands: list = []        # stacked periodic callbacks
+        self.log: Optional[CSVLog] = None
+        self.start_walltime = time.time()
+        self.conf_name = "run"
+        self.stop_flag = False
+
+    # -- naming (reference Solver::outIterFile/outGlobalFile) --------------- #
+
+    def out_path(self, name: str, ext: str, with_iter: bool = True) -> str:
+        base = self.output_prefix
+        if base.endswith("/"):
+            os.makedirs(base, exist_ok=True)
+            base = os.path.join(base, self.conf_name)
+        tag = f"_{name}_{self.iter:08d}" if with_iter else f"_{name}"
+        return f"{base}{tag}.{ext}"
+
+    # -- setup --------------------------------------------------------------- #
+
+    def set_size(self, shape: tuple[int, ...]) -> None:
+        """Allocate lattice + geometry painter (reference Solver::setSize +
+        InitAll, src/Solver.cpp.Rt:265-395)."""
+        self.shape = tuple(int(s) for s in shape)
+        import jax.numpy as jnp
+        self.lattice = Lattice(self.model, self.shape,
+                               dtype=self.dtype or jnp.float32,
+                               mesh=self.mesh)
+        self.geometry = Geometry(self.model, self.shape, self.units)
+
+    def set_unit(self, name: str, value: str, gauge: str = "1") -> None:
+        self.units.set_unit(name, self.units.read_text(value),
+                            float(self.units.si(gauge)))
+
+    def gauge(self) -> None:
+        self.units.make_gauge()
+
+    # -- logging (reference initLog/writeLog, src/Solver.cpp.Rt:120-206) ---- #
+
+    def log_row(self) -> dict[str, float]:
+        m = self.model
+        lat = self.lattice
+        row: dict[str, float] = {
+            "Iteration": float(self.iter),
+            "Time_si": self.units.scale_time() * self.iter
+            if hasattr(self.units, "scale_time") else float(self.iter),
+            "Walltime": time.time() - self.start_walltime,
+            "OptIteration": float(self.opt_iter),
+        }
+        svec = np.asarray(lat.params.settings)
+        for s in m.settings:
+            row[f"{s.name}"] = float(svec[m.setting_index[s.name]])
+        if self.geometry:
+            table = np.asarray(lat.params.zone_table)
+            for s in m.zonal_settings:
+                for zname, zid in self.geometry.setting_zones.items():
+                    row[f"{s}-{zname}"] = float(table[m.setting_index[s], zid])
+        for name, val in lat.get_globals().items():
+            row[name] = val
+        return row
+
+    def write_log(self) -> None:
+        if self.log is None:
+            self.log = CSVLog(self.out_path("Log", "csv", with_iter=False))
+        self.log.write(self.log_row())
+
+    # -- output fan-out ------------------------------------------------------ #
+
+    def quantity_arrays(self, what: Optional[set[str]] = None
+                        ) -> dict[str, np.ndarray]:
+        """Evaluate selected quantities -> host arrays (reference
+        vtkWriteLattice quantity loop, src/vtkLattice.cpp.Rt:47-66)."""
+        out = {}
+        for q in self.model.quantities:
+            if q.adjoint:
+                continue
+            if what and q.name not in what and "all" not in what:
+                continue
+            out[q.name] = np.asarray(self.lattice.get_quantity(q.name))
+        return out
+
+    def write_vtk(self, what: Optional[set[str]] = None) -> str:
+        from tclb_tpu.utils.vtk import write_pvti, write_vti
+        arrays = self.quantity_arrays(what)
+        flags = np.asarray(self.lattice.state.flags)
+        # node-type group layers (reference writes one flag layer per
+        # selected group, src/vtkLattice.cpp.Rt:33-46)
+        if what is None or "flag" in (what or set()) or not what:
+            arrays["Flag"] = flags
+        piece = write_vti(self.out_path("VTK", "vti"), arrays)
+        write_pvti(self.out_path("VTK", "pvti"), piece, arrays)
+        return piece
+
+    def write_txt(self, what: Optional[set[str]] = None,
+                  gzip_out: bool = True) -> list[str]:
+        """Per-quantity text dumps (reference cbTXT/writeTXT gzip path,
+        src/Solver.cpp.Rt:228-260)."""
+        import gzip
+        paths = []
+        for name, arr in self.quantity_arrays(what).items():
+            p = self.out_path(f"TXT_{name}", "txt.gz" if gzip_out else "txt")
+            a2 = arr.reshape(-1, arr.shape[-1])
+            if gzip_out:
+                with gzip.open(p, "wt") as f:
+                    np.savetxt(f, a2)
+            else:
+                np.savetxt(p, a2)
+            paths.append(p)
+        return paths
+
+    def write_bin(self) -> str:
+        """Raw binary dump of all storage planes (reference cbBIN,
+        src/Handlers.cpp.Rt:1011-1027)."""
+        p = self.out_path("BIN", "npz")
+        self.lattice.save(p[:-4])
+        return p
+
+
+# --------------------------------------------------------------------------- #
+# Config entry points (reference main(), src/main.cpp.Rt:172-346)
+# --------------------------------------------------------------------------- #
+
+
+def _read_units(root: ET.Element, solver: Solver) -> None:
+    """<Units><Params Re="100" gauge="1"/>...</Units> (reference readUnits,
+    src/main.cpp.Rt:35-62)."""
+    units = root.find("Units")
+    if units is None:
+        return
+    for p in units.findall("Params"):
+        gauge = p.get("gauge", "1")
+        rest = {k: v for k, v in p.attrib.items() if k != "gauge"}
+        if len(rest) != 1:
+            raise ValueError(
+                f"exactly one variable per Units/Params, got {sorted(rest)}")
+        (name, value), = rest.items()
+        solver.set_unit(name, value, gauge)
+    solver.gauge()
+
+
+def run_config_string(xml_text: str, model: Model, mesh: Any = None,
+                      dtype: Any = None, output: Optional[str] = None,
+                      conf_name: str = "run") -> Solver:
+    root = ET.fromstring(xml_text)
+    return _run_root(root, model, mesh, dtype, output, conf_name)
+
+
+def run_config(path: str, model: Model, mesh: Any = None,
+               dtype: Any = None, output: Optional[str] = None) -> Solver:
+    root = ET.parse(path).getroot()
+    name = os.path.splitext(os.path.basename(path))[0]
+    return _run_root(root, model, mesh, dtype, output, name)
+
+
+def _run_root(root: ET.Element, model: Model, mesh, dtype,
+              output: Optional[str], conf_name: str) -> Solver:
+    from tclb_tpu.control.handlers import MainContainer
+    if root.tag != "CLBConfig":
+        raise ValueError(f"config root must be <CLBConfig>, got <{root.tag}>")
+    solver = Solver(model,
+                    output=output or root.get("output", "output/"),
+                    mesh=mesh, dtype=dtype)
+    solver.conf_name = conf_name
+    _read_units(root, solver)
+    geom = root.find("Geometry")
+    if geom is None:
+        raise ValueError("config must contain a <Geometry> element")
+    if model.ndim == 2:
+        shape = (int(round(solver.units.alt(geom.get("ny", "1")))),
+                 int(round(solver.units.alt(geom.get("nx", "1")))))
+    else:
+        shape = (int(round(solver.units.alt(geom.get("nz", "1")))),
+                 int(round(solver.units.alt(geom.get("ny", "1")))),
+                 int(round(solver.units.alt(geom.get("nx", "1")))))
+    solver.set_size(shape)
+    MainContainer(root, solver).init()
+    return solver

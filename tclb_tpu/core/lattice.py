@@ -333,6 +333,45 @@ def make_iterate(model: Model, action: str = "Iteration",
     return iterate
 
 
+def make_sampled_iterate(model: Model, points: np.ndarray,
+                         quantities: Sequence[str],
+                         action: str = "Iteration",
+                         streaming: Optional[Streaming] = None) -> Callable:
+    """Like :func:`make_iterate` but also gathers the listed quantities at
+    fixed lattice points after every step, returned as the scan ys —
+    the functional equivalent of the reference Sampler's per-iteration GPU
+    ring buffer (reference updateAllSamples, src/Lattice.cu.Rt:1212-1225).
+
+    ``points`` is (npoints, ndim) in array index order (z, y, x / y, x).
+    Returns ``iterate(state, params, niter) -> (state, samples)`` with
+    samples shaped (niter, npoints, ncols); vector quantities contribute
+    their components as consecutive columns.
+    """
+    step = make_action_step(model, action, streaming)
+    idx = tuple(jnp.asarray(points[:, k].astype(np.int32))
+                for k in range(points.shape[1]))
+    qfns = [(q, model.quantity_fns[q]) for q in quantities]
+
+    def sample(state: LatticeState, params: SimParams) -> jnp.ndarray:
+        ctx = NodeCtx(model, state.fields, state.fields, state.flags, params)
+        cols = []
+        for _, fn in qfns:
+            plane = fn(ctx)
+            if plane.ndim == len(state.flags.shape):
+                cols.append(plane[idx][:, None])
+            else:  # vector: (ncomp, *shape) -> (npoints, ncomp)
+                cols.append(plane[(slice(None),) + idx].T)
+        return jnp.concatenate(cols, axis=-1)
+
+    def iterate(state: LatticeState, params: SimParams, niter: int):
+        def body(s, _):
+            s2 = step(s, params)
+            return s2, sample(s2, params)
+        return jax.lax.scan(body, state, None, length=niter)
+
+    return iterate
+
+
 # --------------------------------------------------------------------------- #
 # Host-side Lattice wrapper
 # --------------------------------------------------------------------------- #
@@ -379,6 +418,8 @@ class Lattice:
                                     donate_argnums=0)
             self._place = None
         self._init = jax.jit(make_action_step(model, "Init"), donate_argnums=0)
+        self.sampler = None
+        self._iterate_sampled = None
 
     # -- setup -------------------------------------------------------------- #
 
@@ -416,7 +457,23 @@ class Lattice:
     # -- running ------------------------------------------------------------ #
 
     def iterate(self, niter: int) -> None:
-        self.state = self._iterate(self.state, self.params, niter)
+        if self.sampler is not None:
+            it0 = int(self.state.iteration)
+            self.state, samples = self._iterate_sampled(
+                self.state, self.params, niter)
+            self.sampler.append(it0, np.asarray(samples))
+        else:
+            self.state = self._iterate(self.state, self.params, niter)
+
+    def attach_sampler(self, sampler) -> None:
+        """Register a point sampler: every subsequent step also gathers its
+        quantities at the sample points (reference Sampler, C16).  Sampled
+        iteration runs the global-view step (XLA partitions it over the mesh
+        automatically when state is sharded)."""
+        self.sampler = sampler
+        f = make_sampled_iterate(self.model, sampler.points,
+                                 sampler.quantities)
+        self._iterate_sampled = jax.jit(f, static_argnames=("niter",))
 
     # -- inspection --------------------------------------------------------- #
 

@@ -133,12 +133,26 @@ class HaloStreaming(Streaming):
 
     def make_loader(self, raw: jnp.ndarray) -> Callable:
         if not self._needs_loader:
-            return super().make_loader(raw)  # never called; keeps API uniform
+            # no Field declared a stencil: any ctx.load with a nonzero
+            # offset would silently wrap at the local shard edge, so fail
+            # loudly instead (the declared ranges size the halo)
+            def no_load(index: int, dx: int, dy: int, dz: int):
+                if dx or dy or dz:
+                    raise ValueError(
+                        "sharded ctx.load with nonzero offset requires the "
+                        "Field to declare its access stencil (add_field "
+                        "dx/dy/dz ranges)")
+                return raw[index]
+            return no_load
         w, names = self.width, self.mesh.axis_names
         local = raw.shape[1:]
         padded = halo_pad(raw, self.mesh, w)
 
         def load(index: int, dx: int, dy: int, dz: int) -> jnp.ndarray:
+            if max(abs(dx), abs(dy), abs(dz)) > w:
+                raise ValueError(
+                    f"ctx.load offset ({dx},{dy},{dz}) exceeds halo width "
+                    f"{w}; declare a wider stencil on the Field")
             d_by_name = {"x": dx, "y": dy, "z": dz}
             idx = []
             for k, name in enumerate(names):

@@ -44,23 +44,22 @@ def choose_decomposition(shape: Sequence[int], n_devices: int,
                 for rest in factorizations(n // d, k - 1):
                     yield (d,) + rest
 
-    best, best_cost = None, None
+    # two-tier search: any valid non-x-splitting decomposition beats every
+    # x-splitting one (x is the TPU lane dim, the reference's coalescing
+    # direction — src/Solver.cpp.Rt:284 keeps X whole unconditionally)
+    best, best_cost, best_tier = None, None, None
     for fac in factorizations(n_devices, len(names)):
         split = dict(zip(names, fac))
         if any(dims[a] % split[a] != 0 for a in names):
             continue
-        if keep_x and split["x"] > 1 and n_devices <= np.prod(
-                [dims[a] for a in names if a != "x"]):
-            penalty = 1e6  # only split x as a last resort
-        else:
-            penalty = 0.0
+        tier = 1 if (keep_x and split["x"] > 1) else 0
         total = np.prod(list(dims.values()))
-        cost = penalty
+        cost = 0.0
         for a in names:
             if split[a] > 1:
                 cost += (total / dims[a]) * split[a]  # halo area per axis
-        if best_cost is None or cost < best_cost:
-            best, best_cost = split, cost
+        if best_cost is None or (tier, cost) < (best_tier, best_cost):
+            best, best_cost, best_tier = split, cost, tier
     if best is None:
         raise ValueError(
             f"cannot decompose shape {tuple(shape)} over {n_devices} devices")

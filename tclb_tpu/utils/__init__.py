@@ -1,0 +1,1 @@
+"""Host-side utilities: units, geometry, output writers, samplers."""

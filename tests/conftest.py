@@ -1,11 +1,15 @@
 """Test environment: force CPU with 8 virtual devices (multi-chip emulation —
 the reference tests its MPI path by running any-rank-count CPU builds on one
 box, SURVEY.md §4.8; we do the same with XLA host devices) and enable f64 so
-goldens can use the reference's 1e-10 tolerance model (tools/csvdiff)."""
+goldens can use the reference's 1e-10 tolerance model (tools/csvdiff).
+
+Note: the environment's sitecustomize imports jax at interpreter startup, so
+plain env-var assignment here is too late; ``jax.config.update`` still works
+as long as no backend has been initialized yet.
+"""
 
 import os
 
-# force CPU (the environment pre-sets JAX_PLATFORMS=axon for the TPU tunnel)
 os.environ["JAX_PLATFORMS"] = "cpu"
 _flags = os.environ.get("XLA_FLAGS", "")
 if "xla_force_host_platform_device_count" not in _flags:
@@ -14,4 +18,5 @@ if "xla_force_host_platform_device_count" not in _flags:
 
 import jax  # noqa: E402
 
+jax.config.update("jax_platforms", "cpu")
 jax.config.update("jax_enable_x64", True)

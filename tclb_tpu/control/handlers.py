@@ -122,6 +122,8 @@ class acSolve(GenericAction):
 
     def init(self) -> int:
         Handler.init(self)
+        if not self.every_iter:
+            raise ValueError("<Solve> needs a positive Iterations attribute")
         ret = self.execute_internal()
         if ret not in (0, None):
             return ret
@@ -226,6 +228,111 @@ class acParams(Handler):
                 val = s.units.alt(raw)
                 s.lattice.set_setting(par, val, zone=zone)
         return 0
+
+
+class conControl(Handler):
+    """<Control Iterations="N"><CSV file="..." Time="col*1s"/>
+    <Params name-zone="col*1m/s+0.5"/></Control>
+
+    Time-dependent zonal settings (reference conControl,
+    src/Handlers.cpp.Rt:2213-2452): CSV columns are read through the units
+    engine into a context, linearly interpolated onto the iteration grid
+    [0, N), and <Params> attribute values are expressions
+    ``term + term + ...`` with each term ``variable*scale`` (variable from
+    the context) or a units-bearing constant.  The resulting per-iteration
+    series land in the lattice's zonal time tables."""
+
+    def init(self) -> int:
+        super().init()
+        s = self.solver
+        horizon = int(round(s.units.alt(self.node.get("Iterations", "0"))))
+        if horizon <= 0:
+            raise ValueError("<Control> needs a positive Iterations horizon")
+        self.horizon = horizon
+        context: dict[str, np.ndarray] = {}
+        for child in self.node:
+            if child.tag == "CSV":
+                self._load_csv(child, context)
+            elif child.tag == "Params":
+                self._params(child, context)
+            else:
+                raise ValueError(f"unknown element <{child.tag}> in Control")
+        return 0
+
+    def _eval(self, context: dict[str, np.ndarray], expr: str) -> np.ndarray:
+        """``var*scale+var2*scale2+const`` -> per-iteration array
+        (reference conControl::get, src/Handlers.cpp.Rt:2253-2310)."""
+        s = self.solver
+        out = np.zeros(self.horizon)
+        for term in expr.split("+"):
+            factors = term.split("*")
+            if factors[0].strip() in context:
+                val = context[factors[0].strip()].copy()
+                for f in factors[1:]:
+                    val = val * s.units.alt(f)
+            else:
+                v = 1.0
+                for f in factors:
+                    v *= s.units.alt(f)
+                val = v
+            out = out + val
+        return out
+
+    def _load_csv(self, node: ET.Element, context: dict) -> None:
+        """reference conControl::Internal (src/Handlers.cpp.Rt:2311-2452):
+        parse, convert through units, interpolate onto the iteration grid."""
+        s = self.solver
+        fn = node.get("file")
+        if not fn:
+            raise ValueError("<CSV> in Control needs file=")
+        with open(fn) as f:
+            header = [h.strip().strip('"') for h in
+                      f.readline().strip().split(",")]
+            rows = [[s.units.alt(tok) for tok in line.strip().split(",")]
+                    for line in f if line.strip()]
+        data = {name: np.array([r[i] for r in rows])
+                for i, name in enumerate(header)}
+        n = len(rows)
+        data["_index"] = np.arange(n, dtype=np.float64)
+        tattr = node.get("Time")
+        if tattr:
+            # time expression in iteration units (units.alt maps s -> iters);
+            # evaluate over the CSV rows, not the iteration grid
+            saved, self.horizon = self.horizon, n
+            t = self._eval(data, tattr)
+            self.horizon = saved
+        else:
+            t = data["_index"] * (self.horizon / n)
+        grid = np.arange(self.horizon, dtype=np.float64)
+        for name, col in data.items():
+            context[name] = np.interp(grid, t, col)
+        # the reference also accepts <Params> nested inside <CSV>
+        # (conControl::Internal tail, src/Handlers.cpp.Rt:2430-2450)
+        for child in node:
+            if child.tag == "Params":
+                self._params(child, context)
+
+    def _params(self, node: ET.Element, context: dict) -> None:
+        s = self.solver
+        for name, raw in node.attrib.items():
+            par, zones = name, None
+            if "-" in name:
+                par, zname = name.split("-", 1)
+                if zname in s.geometry.setting_zones:
+                    zones = [s.geometry.setting_zones[zname]]
+                else:
+                    print(f"WARNING: unknown zone {zname!r} (Control "
+                          f"setting {par})")
+                    continue
+            if par not in s.model.setting_index:
+                continue
+            if zones is None:
+                # zone-less: apply to every allocated zone (reference
+                # zSet.set with zone -1, src/ZoneSettings.h)
+                zones = sorted({0} | set(s.geometry.setting_zones.values()))
+            series = self._eval(context, raw)
+            for z in zones:
+                s.lattice.set_setting_series(par, series, zone=z)
 
 
 class cbVTK(Handler):
@@ -513,6 +620,7 @@ _HANDLERS = {
     "Model": acModel,
     "Init": acInit,
     "Params": acParams,
+    "Control": conControl,
     "VTK": cbVTK,
     "TXT": cbTXT,
     "BIN": cbBIN,

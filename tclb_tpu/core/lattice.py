@@ -43,10 +43,21 @@ class SimParams:
     """Runtime parameters: the reference's GPU-const-memory settings
     (src/LatticeContainer.inc.cpp.Rt:32-55) + zonal setting tables (C7,
     src/ZoneSettings.h).  ``zone_table[s, z]`` is the value of setting ``s``
-    in settings-zone ``z``; non-zonal settings read ``settings[s]``."""
+    in settings-zone ``z``; non-zonal settings read ``settings[s]``.
+
+    Time-dependent zonal settings (the reference's per-(setting, zone)
+    time tables, src/ZoneSettings.h:9-120) live in ``time_series``: row
+    ``r`` of the ``(n_series, T)`` array is the per-iteration value of the
+    (setting, zone) pair recorded in the static ``series_map`` as
+    ``(setting_index, zone, r)``.  At iteration ``t`` the effective value is
+    ``time_series[r, t % T]``, overriding ``zone_table``.  Gradients with
+    respect to ``time_series`` are the reference's GRAD planes (control
+    gradients) — free here because the whole step is differentiable."""
 
     settings: jnp.ndarray        # (n_settings,) real
     zone_table: jnp.ndarray      # (n_settings, zone_max) real
+    time_series: Optional[jnp.ndarray] = None   # (n_series, T) real
+    series_map: tuple = struct.field(pytree_node=False, default=())
 
 
 @struct.dataclass
@@ -140,13 +151,15 @@ class NodeCtx:
 
     def __init__(self, model: Model, fields: jnp.ndarray, raw: jnp.ndarray,
                  flags: jnp.ndarray, params: SimParams,
-                 loader: Optional[Callable] = None):
+                 loader: Optional[Callable] = None,
+                 iteration: Any = 0):
         self.model = model
         self._fields = fields      # pulled (streamed) storage
         self._raw = raw            # un-streamed storage (for Field loads)
         self._loader = loader or Streaming(model).make_loader(raw)
         self.flags = flags
         self.params = params
+        self.iteration = iteration
         self._globals: dict[str, jnp.ndarray] = {}
         self._zone_ids = None
 
@@ -188,15 +201,45 @@ class NodeCtx:
     def setting(self, name: str) -> jnp.ndarray:
         """Scalar for plain settings; per-node plane for zonal settings
         (gathered through the flag's zone bits — reference ``ZoneSetting()``
-        device accessor, src/LatticeContainer.h.Rt:89-108)."""
+        device accessor, src/LatticeContainer.h.Rt:89-108).  Zones with a
+        registered time series (``<Control>``) read the current iteration's
+        entry instead of the constant table."""
         m = self.model
         i = m.setting_index[name]
         spec = m.settings[i]
         if not spec.zonal:
             return self.params.settings[i]
+        zone_vals = self.params.zone_table[i]
+        rows = [(z, r) for (si, z, r) in self.params.series_map if si == i]
+        if rows and self.params.time_series is not None:
+            T = self.params.time_series.shape[1]
+            t = jnp.mod(jnp.asarray(self.iteration, jnp.int32), T)
+            for z, r in rows:
+                zone_vals = zone_vals.at[z].set(self.params.time_series[r, t])
+        return zone_vals[self._zones()]
+
+    def setting_dt(self, name: str) -> jnp.ndarray:
+        """Time derivative of a zonal setting: central difference over its
+        time series (reference ``<setting>_DT`` planes, the ``set_internal``
+        derivative at src/ZoneSettings.h:102-119); zero where no series."""
+        m = self.model
+        i = m.setting_index[name]
+        zone_vals = jnp.zeros((m.zone_max,), dtype=self._fields.dtype)
+        rows = [(z, r) for (si, z, r) in self.params.series_map if si == i]
+        if rows and self.params.time_series is not None:
+            ts = self.params.time_series
+            T = ts.shape[1]
+            t = jnp.mod(jnp.asarray(self.iteration, jnp.int32), T)
+            for z, r in rows:
+                d = (ts[r, jnp.mod(t + 1, T)] - ts[r, jnp.mod(t - 1, T)]) / 2.0
+                zone_vals = zone_vals.at[z].set(d)
+        return zone_vals[self._zones()]
+
+    def _zones(self) -> jnp.ndarray:
         if self._zone_ids is None:
-            self._zone_ids = (self.flags.astype(jnp.int32) >> m.zone_shift)
-        return self.params.zone_table[i][self._zone_ids]
+            self._zone_ids = (self.flags.astype(jnp.int32)
+                              >> self.model.zone_shift)
+        return self._zone_ids
 
     # -- node types --------------------------------------------------------- #
 
@@ -277,7 +320,8 @@ def make_stage_step(model: Model, stage_name: str,
         raw = state.fields
         pulled = streaming.pull(raw) if stage.load_densities else raw
         ctx = NodeCtx(model, pulled, raw, state.flags, params,
-                      loader=streaming.make_loader(raw))
+                      loader=streaming.make_loader(raw),
+                      iteration=state.iteration)
         new_fields = fn(ctx)
         # a stage may return a partial update: dict name->plane
         if isinstance(new_fields, dict):
@@ -353,7 +397,8 @@ def make_sampled_iterate(model: Model, points: np.ndarray,
     qfns = [(q, model.quantity_fns[q]) for q in quantities]
 
     def sample(state: LatticeState, params: SimParams) -> jnp.ndarray:
-        ctx = NodeCtx(model, state.fields, state.fields, state.flags, params)
+        ctx = NodeCtx(model, state.fields, state.fields, state.flags, params,
+                      iteration=state.iteration)
         cols = []
         for _, fn in qfns:
             plane = fn(ctx)
@@ -394,6 +439,7 @@ class Lattice:
         self.dtype = dtype
         self.mesh = mesh
         vec = model.settings_vector(settings)
+        self._series: dict[tuple[int, int], np.ndarray] = {}
         self.params = SimParams(
             settings=jnp.asarray(vec, dtype=dtype),
             zone_table=jnp.asarray(
@@ -445,8 +491,36 @@ class Lattice:
             table[m.setting_index[name], :] = vec[m.setting_index[name]]
         else:
             table[m.setting_index[name], zone] = float(value)
-        self.params = SimParams(settings=jnp.asarray(vec, dtype=self.dtype),
-                                zone_table=jnp.asarray(table, dtype=self.dtype))
+        self.params = self.params.replace(
+            settings=jnp.asarray(vec, dtype=self.dtype),
+            zone_table=jnp.asarray(table, dtype=self.dtype))
+        if self._place is not None:
+            self.state, self.params = self._place()
+
+    def set_setting_series(self, name: str, values: np.ndarray, zone: int = 0
+                           ) -> None:
+        """Attach a per-iteration time series to a zonal setting (reference
+        ``zSet.set(setting, zone, vector)`` filled by <Control>,
+        src/Handlers.cpp.Rt:2213-2452).  All series share one horizon length
+        (the reference's ``zSet.len``); iteration wraps modulo that length."""
+        m = self.model
+        i = m.setting_index[name]
+        if not m.settings[i].zonal:
+            raise ValueError(f"setting {name!r} is not zonal; Control time "
+                             "series apply to zonal settings")
+        values = np.asarray(values, dtype=np.float64).ravel()
+        for old in self._series.values():
+            if len(old) != len(values):
+                raise ValueError(
+                    f"all Control series must share one horizon: got "
+                    f"{len(values)}, existing {len(old)}")
+        self._series[(i, int(zone))] = values
+        keys = sorted(self._series)
+        series_map = tuple((si, z, r) for r, (si, z) in enumerate(keys))
+        ts = np.stack([self._series[k] for k in keys])
+        self.params = self.params.replace(
+            time_series=jnp.asarray(ts, dtype=self.dtype),
+            series_map=series_map)
         if self._place is not None:
             self.state, self.params = self._place()
 
@@ -482,7 +556,8 @@ class Lattice:
         Lattice::GetQuantity, src/Lattice.cu.Rt:1012-1036)."""
         fn = self.model.quantity_fns[name]
         ctx = NodeCtx(self.model, self.state.fields, self.state.fields,
-                      self.state.flags, self.params)
+                      self.state.flags, self.params,
+                      iteration=self.state.iteration)
         return fn(ctx)
 
     def get_density(self, name: str) -> jnp.ndarray:
@@ -515,13 +590,20 @@ class Lattice:
     # -- checkpoint --------------------------------------------------------- #
 
     def save(self, path: str) -> None:
-        """Full-state dump (reference Lattice::save, src/Lattice.cu.Rt:592-626)."""
+        """Full-state dump (reference Lattice::save, src/Lattice.cu.Rt:592-626),
+        including any Control time series."""
+        extra = {}
+        if self.params.time_series is not None:
+            extra["time_series"] = np.asarray(self.params.time_series)
+            extra["series_map"] = np.asarray(self.params.series_map,
+                                             dtype=np.int64)
         np.savez(path,
                  fields=np.asarray(self.state.fields),
                  flags=np.asarray(self.state.flags),
                  iteration=int(self.state.iteration),
                  settings=np.asarray(self.params.settings),
-                 zone_table=np.asarray(self.params.zone_table))
+                 zone_table=np.asarray(self.params.zone_table),
+                 **extra)
 
     def load(self, path: str) -> None:
         d = np.load(path if path.endswith(".npz") else path + ".npz")
@@ -531,8 +613,16 @@ class Lattice:
             globals_=self.state.globals_,
             iteration=jnp.asarray(d["iteration"], dtype=jnp.int32),
         )
+        self._series = {}
+        ts, smap = None, ()
+        if "time_series" in d:
+            ts = jnp.asarray(d["time_series"], dtype=self.dtype)
+            smap = tuple(tuple(int(v) for v in row) for row in d["series_map"])
+            for si, z, r in smap:
+                self._series[(si, z)] = np.asarray(d["time_series"][r])
         self.params = SimParams(
             settings=jnp.asarray(d["settings"], dtype=self.dtype),
-            zone_table=jnp.asarray(d["zone_table"], dtype=self.dtype))
+            zone_table=jnp.asarray(d["zone_table"], dtype=self.dtype),
+            time_series=ts, series_map=smap)
         if self._place is not None:
             self.state, self.params = self._place()

@@ -191,7 +191,9 @@ def make_sharded_iterate(model: Model, mesh: Mesh,
     state_specs = LatticeState(
         fields=field_spec(mesh), flags=flag_spec(mesh),
         globals_=P(), iteration=P())
-    param_specs = SimParams(settings=P(), zone_table=P())
+    # params are fully replicated; a single P() is a valid tree prefix for
+    # whatever SimParams contains (incl. Control time series)
+    param_specs = P()
 
     @lru_cache(maxsize=None)
     def _for_niter(niter: int):

@@ -101,6 +101,8 @@ def shard_state(state, params, mesh: Mesh):
     params = params.replace(
         settings=jax.device_put(params.settings, rep),
         zone_table=jax.device_put(params.zone_table, rep),
+        time_series=None if params.time_series is None
+        else jax.device_put(params.time_series, rep),
     )
     return state, params
 

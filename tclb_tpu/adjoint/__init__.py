@@ -1,0 +1,28 @@
+"""Adjoint & optimization — the TPU-native replacement for the reference's
+Tapenade machinery (reference tools/makeAD, src/ADTools.cu, the adjoint
+branches of src/Lattice.cu.Rt and the optimization handlers of
+src/Handlers.cpp.Rt:1571-2211).
+
+Where the reference source-transforms the generated CUDA ``Run()`` into
+``Run_b()`` and hand-manages a log-leveled snapshot tape (SnapLevel,
+src/Lattice.cu.Rt:34-49), here the whole iteration is a differentiable JAX
+program: ``jax.grad`` through a nested-checkpoint ``lax.scan`` reproduces the
+reverse sweep with the same O(T^(1/levels)) memory/recompute trade, and the
+"settings tape" (src/Lattice.cu.Rt:1048-1086) is free because parameters are
+explicit function inputs.
+"""
+
+from tclb_tpu.adjoint.run import (nested_checkpoint_scan, objective_weights,
+                                  make_objective_run, make_unsteady_gradient,
+                                  make_steady_gradient, fd_test)
+from tclb_tpu.adjoint.design import (Design, InternalTopology, OptimalControl,
+                                     Fourier, BSpline, RepeatControl,
+                                     CompositeDesign, threshold_topology)
+from tclb_tpu.adjoint.optimize import optimize
+
+__all__ = [
+    "nested_checkpoint_scan", "objective_weights", "make_objective_run",
+    "make_unsteady_gradient", "make_steady_gradient", "fd_test",
+    "Design", "InternalTopology", "OptimalControl", "Fourier", "BSpline",
+    "RepeatControl", "CompositeDesign", "threshold_topology", "optimize",
+]

@@ -1,0 +1,220 @@
+"""Design parameterizations: what the optimizer's vector theta means.
+
+Parity target: the reference's "Design" handler family, which unifies very
+different degrees of freedom behind one parameter-vector API
+(``GetParameters``/``SetParameters``, reference src/Handlers.cpp.Rt:166-846):
+
+* ``InternalTopology`` (:166) — per-node design densities masked by
+  NODE_DesignSpace;
+* ``OptimalControl``/``OptimalControlSecond`` (:201/:304) — a zonal
+  setting's time series with bounds;
+* ``Fourier`` (:431) — low-dimensional Fourier reparameterization of a
+  control series;
+* ``BSpline`` (:575) — B-spline control points (reference src/spline.h);
+* ``RepeatControl`` (:727) — one period tiled over the horizon.
+
+Every Design maps ``theta`` (a JAX pytree, usually one array) into the
+(state, params) pair *inside* the differentiated function, so gradients
+arrive already in theta-space — the reference needs explicit chain-rule
+code per handler (e.g. Fourier's ``ToParameters``); here it is ``jax.grad``
+through :meth:`Design.put`.
+"""
+
+from __future__ import annotations
+
+from typing import Optional, Sequence
+
+import jax.numpy as jnp
+import numpy as np
+
+from tclb_tpu.core.lattice import FLAG_DTYPE, LatticeState, SimParams
+from tclb_tpu.core.registry import Model
+
+
+class Design:
+    """theta <-> (state, params) mapping.  ``get`` extracts the current
+    value (host side); ``put`` injects (traced, differentiable)."""
+
+    def get(self, state: LatticeState, params: SimParams):
+        raise NotImplementedError
+
+    def put(self, theta, state: LatticeState, params: SimParams):
+        raise NotImplementedError
+
+    def bounds(self) -> tuple[Optional[float], Optional[float]]:
+        return (None, None)
+
+
+class InternalTopology(Design):
+    """Per-node design densities (``parameter=True`` storage planes) masked
+    by the DesignSpace node-type group (reference InternalTopology,
+    src/Handlers.cpp.Rt:166-200: the parameter space is exactly the design
+    field at NODE_DesignSpace nodes; bounds [0, 1])."""
+
+    def __init__(self, model: Model, names: Optional[Sequence[str]] = None):
+        self.model = model
+        if names is None:
+            names = [x.name for x in list(model.densities) + list(model.fields)
+                     if x.parameter]
+        if not names:
+            raise ValueError(f"model {model.name} declares no parameter=True "
+                             "densities/fields (no design space)")
+        self.idx = tuple(model.storage_index[n] for n in names)
+        self.names = tuple(names)
+
+    def _mask(self, state: LatticeState) -> jnp.ndarray:
+        m = self.model.group_masks["DESIGNSPACE"]
+        return (state.flags & FLAG_DTYPE(m)) != FLAG_DTYPE(0)
+
+    def get(self, state, params):
+        return state.fields[jnp.asarray(self.idx)]
+
+    def put(self, theta, state, params):
+        mask = self._mask(state)[None]
+        cur = state.fields[jnp.asarray(self.idx)]
+        new = jnp.where(mask, theta, cur)
+        return (state.replace(
+            fields=state.fields.at[jnp.asarray(self.idx)].set(new)), params)
+
+    def bounds(self):
+        return (0.0, 1.0)
+
+
+class OptimalControl(Design):
+    """A zonal setting's time series as parameters (reference OptimalControl,
+    src/Handlers.cpp.Rt:201-303).  The series must already exist in
+    ``params`` (register via ``Lattice.set_setting_series`` or <Control>)."""
+
+    def __init__(self, model: Model, setting: str, zone: int = 0,
+                 lower: Optional[float] = None,
+                 upper: Optional[float] = None):
+        self.model = model
+        self.sidx = model.setting_index[setting]
+        self.zone = int(zone)
+        self._bounds = (lower, upper)
+
+    def _row(self, params: SimParams) -> int:
+        for si, z, r in params.series_map:
+            if si == self.sidx and z == self.zone:
+                return r
+        raise ValueError(
+            f"no time series registered for setting index {self.sidx} "
+            f"zone {self.zone}; call set_setting_series first")
+
+    def get(self, state, params):
+        return params.time_series[self._row(params)]
+
+    def put(self, theta, state, params):
+        r = self._row(params)
+        return state, params.replace(
+            time_series=params.time_series.at[r].set(theta))
+
+    def bounds(self):
+        return self._bounds
+
+
+class Reparam(Design):
+    """Base for low-dimensional reparameterizations of a control series:
+    ``series = basis @ theta`` with a fixed (T, P) basis matrix."""
+
+    def __init__(self, inner: OptimalControl, basis: np.ndarray):
+        self.inner = inner
+        self.basis = jnp.asarray(basis)
+
+    def get(self, state, params):
+        # least-squares pullback of the current series onto the basis
+        series = np.asarray(self.inner.get(state, params))
+        coef, *_ = np.linalg.lstsq(np.asarray(self.basis), series, rcond=None)
+        return jnp.asarray(coef, dtype=series.dtype)
+
+    def put(self, theta, state, params):
+        series = self.basis.astype(theta.dtype) @ theta
+        return self.inner.put(series, state, params)
+
+    def bounds(self):
+        return self.inner.bounds()
+
+
+class Fourier(Reparam):
+    """theta = (a0, a1, b1, ..., aK, bK) -> series via a truncated Fourier
+    basis over the horizon (reference Fourier, src/Handlers.cpp.Rt:431-574)."""
+
+    def __init__(self, inner: OptimalControl, horizon: int, modes: int):
+        t = np.arange(horizon) * (2 * np.pi / horizon)
+        cols = [np.ones(horizon)]
+        for k in range(1, modes + 1):
+            cols.append(np.cos(k * t))
+            cols.append(np.sin(k * t))
+        super().__init__(inner, np.stack(cols, axis=1))
+
+
+class BSpline(Reparam):
+    """theta = P control points -> series via uniform cubic B-spline basis
+    (reference BSpline, src/Handlers.cpp.Rt:575-726, src/spline.h);
+    ``periodic`` wraps the control polygon."""
+
+    def __init__(self, inner: OptimalControl, horizon: int, points: int,
+                 periodic: bool = False):
+        B = np.zeros((horizon, points))
+        def b3(u):  # cubic B-spline segments on [0,4)
+            return np.where(
+                u < 0, 0.0, np.where(
+                    u < 1, u**3 / 6, np.where(
+                        u < 2, (-3*(u-1)**3 + 3*(u-1)**2 + 3*(u-1) + 1) / 6,
+                        np.where(
+                            u < 3, (3*(u-2)**3 - 6*(u-2)**2 + 4) / 6,
+                            np.where(u < 4, (1 - (u - 3))**3 / 6, 0.0)))))
+        t = np.arange(horizon) / horizon
+        if periodic:
+            x = t * points
+            for p in range(points):
+                for wrap in (-points, 0, points):
+                    B[:, p] += b3(x - (p + wrap) + 2)
+        else:
+            x = t * (points - 3)
+            for p in range(points):
+                B[:, p] = b3(x - p + 3)
+            # normalize the open-end partition of unity
+            B /= B.sum(axis=1, keepdims=True)
+        super().__init__(inner, B)
+
+
+class RepeatControl(Reparam):
+    """theta = one period of length P tiled over the horizon (reference
+    RepeatControl, src/Handlers.cpp.Rt:727-846)."""
+
+    def __init__(self, inner: OptimalControl, horizon: int, period: int):
+        B = np.zeros((horizon, period))
+        B[np.arange(horizon), np.arange(horizon) % period] = 1.0
+        super().__init__(inner, B)
+
+
+class CompositeDesign(Design):
+    """Concatenation of several designs into one theta tuple (the reference
+    concatenates all design handlers' parameters into one NLopt vector,
+    GenericOptimizer::Parameters, src/Handlers.cpp.Rt:1708-1775)."""
+
+    def __init__(self, designs: Sequence[Design]):
+        self.designs = tuple(designs)
+
+    def get(self, state, params):
+        return tuple(d.get(state, params) for d in self.designs)
+
+    def put(self, theta, state, params):
+        for d, th in zip(self.designs, theta):
+            state, params = d.put(th, state, params)
+        return state, params
+
+    def bounds(self):
+        return tuple(d.bounds() for d in self.designs)
+
+
+def threshold_topology(model: Model, state: LatticeState,
+                       level: float = 0.5) -> LatticeState:
+    """Binarize topology design fields at ``level`` (reference
+    acThreshold/acThresholdNow, src/Handlers.cpp.Rt:2100-2190)."""
+    topo = InternalTopology(model)
+    cur = topo.get(state, None)
+    binary = jnp.where(cur > level, jnp.ones_like(cur), jnp.zeros_like(cur))
+    state, _ = topo.put(binary, state, None)
+    return state

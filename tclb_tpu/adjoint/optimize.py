@@ -1,0 +1,95 @@
+"""Optimizer drivers over (design, gradient) — the reference's NLopt layer.
+
+Parity target: ``acOptimize``/``GenericOptimizer::Execute`` (reference
+src/Handlers.cpp.Rt:1708-1943): rank-0 runs NLopt (MMA et al.) over the
+concatenated parameter vector, evaluating (primal + adjoint) per step, with
+optional material constraints; plus the built-in simultaneous descent
+``Iteration_Opt`` (src/cuda.cu.Rt:224-234: steepest descent clamped to
+[0, 1]).
+
+NLopt is not in this environment; the method names map onto:
+
+* ``MMA`` / ``LBFGS`` -> scipy L-BFGS-B (bound-constrained quasi-Newton —
+  the same role MMA plays for topology optimization here),
+* ``DESCENT`` -> clamped steepest descent (== the reference's built-in
+  ``Iteration_Opt``),
+* ``ADAM`` -> optax Adam (TPU-idiomatic extra).
+"""
+
+from __future__ import annotations
+
+from typing import Any, Callable, Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.flatten_util import ravel_pytree
+
+
+def _clamp(theta, lo, hi):
+    if lo is None and hi is None:
+        return theta
+    return jax.tree_util.tree_map(
+        lambda x: jnp.clip(x, lo if lo is not None else -np.inf,
+                           hi if hi is not None else np.inf), theta)
+
+
+def optimize(grad_fn: Callable, theta0: Any, method: str = "MMA",
+             max_eval: int = 20, step: float = 1.0,
+             bounds: tuple = (None, None),
+             callback: Optional[Callable] = None) -> tuple[Any, float]:
+    """Minimize ``objective`` over theta.  ``grad_fn(theta) ->
+    (objective, grad_pytree)``; returns (theta_opt, best_objective).
+
+    ``callback(k, obj, theta)`` fires per accepted evaluation (the
+    reference's per-NLopt-iteration log/VTK hooks)."""
+    method = method.upper()
+    lo, hi = bounds if isinstance(bounds, tuple) and len(bounds) == 2 \
+        else (None, None)
+    if method in ("DESCENT", "STEEPEST"):
+        theta = theta0
+        obj = np.inf
+        for k in range(max_eval):
+            obj, g = grad_fn(theta)
+            theta = _clamp(jax.tree_util.tree_map(
+                lambda t, d: t - step * d, theta, g), lo, hi)
+            if callback:
+                callback(k, float(obj), theta)
+        return theta, float(obj)
+    if method == "ADAM":
+        import optax
+        opt = optax.adam(step)
+        opt_state = opt.init(theta0)
+        theta, obj = theta0, np.inf
+        for k in range(max_eval):
+            obj, g = grad_fn(theta)
+            upd, opt_state = opt.update(g, opt_state)
+            theta = _clamp(optax.apply_updates(theta, upd), lo, hi)
+            if callback:
+                callback(k, float(obj), theta)
+        return theta, float(obj)
+    if method in ("MMA", "LBFGS", "L-BFGS-B"):
+        from scipy.optimize import minimize
+        flat0, unravel = ravel_pytree(theta0)
+        flat0 = np.asarray(flat0, dtype=np.float64)
+        state = {"k": 0, "best": np.inf, "theta": theta0}
+
+        def f_and_g(x):
+            theta = unravel(jnp.asarray(x, dtype=flat0.dtype))
+            obj, g = grad_fn(theta)
+            gflat, _ = ravel_pytree(g)
+            state["k"] += 1
+            if float(obj) < state["best"]:
+                state["best"], state["theta"] = float(obj), theta
+            if callback:
+                callback(state["k"], float(obj), theta)
+            return float(obj), np.asarray(gflat, dtype=np.float64)
+
+        b = None
+        if lo is not None or hi is not None:
+            b = [(lo, hi)] * flat0.size
+        res = minimize(f_and_g, flat0, jac=True, method="L-BFGS-B",
+                       bounds=b, options={"maxfun": max_eval})
+        theta = unravel(jnp.asarray(res.x, dtype=flat0.dtype))
+        return theta, float(res.fun)
+    raise ValueError(f"unknown optimization method {method!r}")

@@ -651,3 +651,7 @@ def get_handler(node: ET.Element, solver: Solver) -> Optional[Handler]:
     if cls is None:
         raise ValueError(f"unknown config element <{node.tag}>")
     return cls(node, solver)
+
+
+# optimization/adjoint handlers register themselves on import
+from tclb_tpu.control import opt_handlers  # noqa: E402,F401  (registration)

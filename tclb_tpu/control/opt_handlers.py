@@ -1,0 +1,333 @@
+"""Optimization / adjoint XML handlers.
+
+Parity targets (reference src/Handlers.cpp.Rt): ``<Adjoint>`` (acUSAdjoint
+:1614 / acSAdjoint :1664), ``<Optimize>`` (acOptimize :1815), ``<FDTest>``
+(acFDTest :1944), ``<Threshold>``/``<ThresholdNow>`` (:2100/:2149),
+``<OptSolve>`` (acOptSolve :1571), and the design-parameter family
+``<InternalTopology>`` (:166), ``<OptimalControl>`` (:201), ``<Fourier>``
+(:431), ``<BSpline>`` (:575), ``<RepeatControl>`` (:727).
+
+The reference's imperative structure (NLopt calls back into the handler
+tree, workers follow rank 0 via MPI broadcast) becomes declarative: design
+handlers register :class:`~tclb_tpu.adjoint.design.Design` objects on the
+solver; <Adjoint>/<Optimize> build a differentiable objective over a fixed
+horizon and call the adjoint machinery.  There is no worker loop — the mesh
+parallelism lives inside the jitted objective itself.
+"""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+import numpy as np
+
+from tclb_tpu.adjoint import (BSpline, CompositeDesign, Fourier,
+                              InternalTopology, OptimalControl,
+                              RepeatControl, fd_test, make_objective_run,
+                              make_steady_gradient, make_unsteady_gradient,
+                              optimize, threshold_topology)
+from tclb_tpu.control.handlers import Handler, GenericAction, register_handler
+from tclb_tpu.control.solver import Solver
+
+
+def _active_design(solver: Solver):
+    """All registered designs (or the model's parameter fields if none was
+    declared — the reference errors instead; defaulting is kinder)."""
+    if solver.designs:
+        if len(solver.designs) == 1:
+            return solver.designs[0]
+        return CompositeDesign(solver.designs)
+    return InternalTopology(solver.model)
+
+
+def _design_bounds(design):
+    b = design.bounds()
+    if isinstance(b, tuple) and len(b) == 2 and not isinstance(b[0], tuple):
+        return b
+    # composite: use the tightest common box (scipy path needs one box)
+    los = [x[0] for x in b if x[0] is not None]
+    his = [x[1] for x in b if x[1] is not None]
+    return (max(los) if los else None, min(his) if his else None)
+
+
+class dInternalTopology(Handler):
+    """<InternalTopology/>: expose parameter=True fields as design variables
+    (reference InternalTopology, src/Handlers.cpp.Rt:166-200)."""
+
+    kind = "design"
+
+    def init(self) -> int:
+        super().init()
+        self.solver.designs.append(InternalTopology(self.solver.model))
+        return 0
+
+
+def _series_for(solver: Solver, what: str) -> tuple[str, int]:
+    par, zone = what, 0
+    if "-" in what:
+        par, zname = what.split("-", 1)
+        zone = solver.geometry.setting_zones[zname]
+    if par not in solver.model.setting_index:
+        raise ValueError(f"unknown setting {par!r} in design handler")
+    return par, zone
+
+
+class dOptimalControl(Handler):
+    """<OptimalControl what="Velocity-inlet" lower="..." upper="...">
+    (reference OptimalControl, src/Handlers.cpp.Rt:201-303).  If no series
+    exists yet, a constant series over Length= iterations is created."""
+
+    kind = "design"
+
+    def init(self) -> int:
+        super().init()
+        s = self.solver
+        par, zone = _series_for(s, self.node.get("what", ""))
+        sidx = s.model.setting_index[par]
+        have = any(si == sidx and z == zone
+                   for si, z, _ in s.lattice.params.series_map)
+        if not have:
+            T = int(round(s.units.alt(self.node.get("Length", "0"))))
+            if T <= 0:
+                raise ValueError("OptimalControl on a setting without a "
+                                 "<Control> series needs Length=")
+            cur = float(np.asarray(s.lattice.params.zone_table)[sidx, zone])
+            s.lattice.set_setting_series(par, np.full(T, cur), zone=zone)
+        lo = self.node.get("lower")
+        hi = self.node.get("upper")
+        self._register(OptimalControl(
+            s.model, par, zone,
+            lower=s.units.alt(lo) if lo else None,
+            upper=s.units.alt(hi) if hi else None))
+        return 0
+
+    def _register(self, inner) -> None:
+        self.solver.designs.append(inner)
+
+
+class dFourier(dOptimalControl):
+    """<Fourier what=... Modes="K">: truncated-Fourier reparameterization
+    (reference Fourier, src/Handlers.cpp.Rt:431-574)."""
+
+    def _register(self, inner) -> None:
+        T = self.solver.lattice.params.time_series.shape[1]
+        modes = int(self.node.get("Modes", "3"))
+        self.solver.designs.append(Fourier(inner, T, modes))
+
+
+class dBSpline(dOptimalControl):
+    """<BSpline what=... Points="P" periodic="true|false">
+    (reference BSpline, src/Handlers.cpp.Rt:575-726)."""
+
+    def _register(self, inner) -> None:
+        T = self.solver.lattice.params.time_series.shape[1]
+        pts = int(self.node.get("Points", "6"))
+        periodic = self.node.get("periodic", "false").lower() in ("1", "true")
+        self.solver.designs.append(BSpline(inner, T, pts, periodic=periodic))
+
+
+class dRepeatControl(dOptimalControl):
+    """<RepeatControl what=... Period="P"> (reference RepeatControl,
+    src/Handlers.cpp.Rt:727-846)."""
+
+    def _register(self, inner) -> None:
+        T = self.solver.lattice.params.time_series.shape[1]
+        period = int(round(self.solver.units.alt(
+            self.node.get("Period", "1"))))
+        self.solver.designs.append(RepeatControl(inner, T, period))
+
+
+class acAdjoint(GenericAction):
+    """<Adjoint type="unsteady|steady" Iterations="N">: children first
+    (reference runs the recorded primal there), then gradient of the
+    InObj-weighted objective wrt the active design; result stored as
+    ``solver.objective``/``solver.gradient`` and the primal state advances
+    (reference acUSAdjoint/acSAdjoint, src/Handlers.cpp.Rt:1614-1707)."""
+
+    def init(self) -> int:
+        Handler.init(self)
+        ret = self.execute_internal()
+        if ret not in (0, None):
+            return ret
+        s = self.solver
+        design = _active_design(s)
+        kind = self.node.get("type", "unsteady")
+        theta = design.get(s.lattice.state, s.lattice.params)
+        if kind == "steady":
+            n_adj = int(round(s.units.alt(self.node.get("NAdjoint", "100"))))
+            grad_fn = make_steady_gradient(s.model, design, n_adjoint=n_adj)
+            obj, g = grad_fn(theta, s.lattice.state, s.lattice.params)
+        else:
+            niter = int(round(s.units.alt(self.node.get("Iterations", "0"))))
+            if niter <= 0:
+                raise ValueError("unsteady <Adjoint> needs Iterations=")
+            grad_fn = make_unsteady_gradient(s.model, design, niter)
+            obj, g, final = grad_fn(theta, s.lattice.state, s.lattice.params)
+            s.lattice.state = final
+            s.iter += niter
+        s.objective = float(obj)
+        s.gradient = g
+        s.design = design
+        self.unstack()
+        return 0
+
+
+class acFDTest(GenericAction):
+    """<FDTest Iterations="N" Checks="K" Epsilon="eps">: compare the adjoint
+    gradient with central differences and store/print the verdict
+    (reference acFDTest, src/Handlers.cpp.Rt:1944-2099)."""
+
+    def init(self) -> int:
+        Handler.init(self)
+        s = self.solver
+        design = _active_design(s)
+        niter = int(round(s.units.alt(self.node.get("Iterations", "4"))))
+        checks = int(self.node.get("Checks", "5"))
+        eps = float(self.node.get("Epsilon", "1e-6"))
+        theta = design.get(s.lattice.state, s.lattice.params)
+        grad_fn = make_unsteady_gradient(s.model, design, niter)
+        obj, g, _ = grad_fn(theta, s.lattice.state, s.lattice.params)
+        run = make_objective_run(s.model, niter)
+
+        def loss(th):
+            st, pa = design.put(th, s.lattice.state, s.lattice.params)
+            return run(st, pa)[0]
+
+        records = fd_test(loss, g, theta, n_checks=checks, eps=eps)
+        s.fd_records = records
+        worst = max((r["rel_err"] for r in records
+                     if not (r["adjoint"] == 0 and abs(r["fd"]) < 1e-12)),
+                    default=0.0)
+        print(f"FDTest: objective={float(obj):.6g} worst rel err={worst:.3e}")
+        for r in records:
+            print(f"  component {r['index']}: adjoint={r['adjoint']:.8g} "
+                  f"fd={r['fd']:.8g} rel_err={r['rel_err']:.3e}")
+        return 0
+
+
+class acThresholdNow(Handler):
+    """<ThresholdNow Level="0.5"/>: binarize topology immediately
+    (reference acThresholdNow, src/Handlers.cpp.Rt:2149)."""
+
+    def init(self) -> int:
+        super().init()
+        self.do_threshold()
+        return 0
+
+    def do_threshold(self) -> None:
+        s = self.solver
+        level = float(self.node.get("Level", "0.5"))
+        s.lattice.state = threshold_topology(s.model, s.lattice.state, level)
+
+
+class acThreshold(acThresholdNow):
+    """<Threshold Iterations="N">: periodic binarization callback
+    (reference acThreshold, src/Handlers.cpp.Rt:2100)."""
+
+    kind = "callback"
+
+    def init(self) -> int:
+        Handler.init(self)
+        if not self.every_iter:
+            self.do_threshold()
+        return 0
+
+    def do_it(self) -> int:
+        self.do_threshold()
+        return 0
+
+
+class acOptimize(GenericAction):
+    """<Optimize Method="MMA" MaxEvaluations="20" Iterations="N" Step="1">
+    — outer optimization loop over the registered designs (reference
+    acOptimize + GenericOptimizer::Execute, src/Handlers.cpp.Rt:1708-1943).
+    Children register designs / configure; the objective is the
+    InObj-weighted globals integrated over ``Iterations`` steps from the
+    current state."""
+
+    def init(self) -> int:
+        Handler.init(self)
+        ret = self.execute_internal()
+        if ret not in (0, None):
+            return ret
+        s = self.solver
+        design = _active_design(s)
+        niter = int(round(s.units.alt(self.node.get("Iterations", "0"))))
+        if niter <= 0:
+            raise ValueError("<Optimize> needs Iterations= (objective "
+                             "horizon per evaluation)")
+        method = self.node.get("Method", "MMA")
+        max_eval = int(self.node.get("MaxEvaluations", "20"))
+        step = float(self.node.get("Step", "1.0"))
+        grad_full = make_unsteady_gradient(s.model, design, niter)
+
+        def grad_fn(theta):
+            obj, g, _ = grad_full(theta, s.lattice.state, s.lattice.params)
+            return obj, g
+
+        def cb(k, obj, theta):
+            s.opt_iter = k
+            print(f"Optimize[{method}] eval {k}: objective={obj:.8g}")
+
+        theta0 = design.get(s.lattice.state, s.lattice.params)
+        theta, obj = optimize(grad_fn, theta0, method=method,
+                              max_eval=max_eval, step=step,
+                              bounds=_design_bounds(design), callback=cb)
+        s.lattice.state, s.lattice.params = design.put(
+            theta, s.lattice.state, s.lattice.params)
+        s.objective = obj
+        self.unstack()
+        return 0
+
+
+class acOptSolve(GenericAction):
+    """<OptSolve Iterations="N" Chunk="C" Step="a">: simultaneous
+    primal+adjoint+descent (reference acOptSolve + ITER_OPT / Iteration_Opt,
+    src/Handlers.cpp.Rt:1571-1613, src/cuda.cu.Rt:224-234): every chunk of C
+    iterations, take one clamped descent step on the design using the
+    gradient over that chunk."""
+
+    def init(self) -> int:
+        Handler.init(self)
+        ret = self.execute_internal()
+        if ret not in (0, None):
+            return ret
+        s = self.solver
+        design = _active_design(s)
+        niter = int(round(s.units.alt(self.node.get("Iterations", "0"))))
+        chunk = int(round(s.units.alt(self.node.get("Chunk", "1"))))
+        step = float(self.node.get("Step", "1.0"))
+        if niter <= 0:
+            raise ValueError("<OptSolve> needs Iterations=")
+        grad_fn = make_unsteady_gradient(s.model, design, chunk)
+        lo, hi = _design_bounds(design)
+        done = 0
+        while done < niter:
+            theta = design.get(s.lattice.state, s.lattice.params)
+            obj, g, final = grad_fn(theta, s.lattice.state, s.lattice.params)
+            theta = jnp.clip(
+                theta - step * g,
+                lo if lo is not None else -np.inf,
+                hi if hi is not None else np.inf)
+            s.lattice.state, s.lattice.params = design.put(
+                theta, final, s.lattice.params)
+            done += chunk
+            s.iter += chunk
+            s.objective = float(obj)
+            for h in s.hands:
+                if h.now(s.iter):
+                    h.do_it()
+        self.unstack()
+        return 0
+
+
+register_handler("Adjoint", acAdjoint)
+register_handler("FDTest", acFDTest)
+register_handler("Threshold", acThreshold)
+register_handler("ThresholdNow", acThresholdNow)
+register_handler("Optimize", acOptimize)
+register_handler("OptSolve", acOptSolve)
+register_handler("InternalTopology", dInternalTopology)
+register_handler("OptimalControl", dOptimalControl)
+register_handler("Fourier", dFourier)
+register_handler("BSpline", dBSpline)
+register_handler("RepeatControl", dRepeatControl)

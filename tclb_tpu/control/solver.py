@@ -47,6 +47,10 @@ class Solver:
         self.iter_type = 0
         self.opt_iter = 0
         self.hands: list = []        # stacked periodic callbacks
+        self.designs: list = []      # registered design parameterizations
+        self.objective: Optional[float] = None
+        self.gradient = None
+        self.fd_records: Optional[list] = None
         self.log: Optional[CSVLog] = None
         self.start_walltime = time.time()
         self.conf_name = "run"

@@ -14,6 +14,7 @@ from tclb_tpu.core.registry import Model
 # importing all of them on package import is not needed)
 _REGISTRY: dict[str, str] = {
     "d2q9": "tclb_tpu.models.d2q9",
+    "d2q9_adj": "tclb_tpu.models.d2q9_adj",
 }
 
 _CACHE: dict[str, Model] = {}

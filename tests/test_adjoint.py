@@ -1,0 +1,230 @@
+"""Adjoint machinery tests — the reference validates its Tapenade gradients
+with the in-product <FDTest> handler (src/Handlers.cpp.Rt:1944); we do the
+same: adjoint gradient vs central finite differences, plus checkpointed-scan
+equivalence and the reparameterization algebra."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from tclb_tpu.adjoint import (BSpline, Fourier, InternalTopology,
+                              OptimalControl, RepeatControl, fd_test,
+                              make_objective_run, make_steady_gradient,
+                              make_unsteady_gradient, nested_checkpoint_scan,
+                              optimize, threshold_topology)
+from tclb_tpu.core.lattice import Lattice
+from tclb_tpu.models import get_model
+
+
+def _setup(ny=8, nx=16, drag=1.0, material=0.0):
+    m = get_model("d2q9_adj")
+    lat = Lattice(m, (ny, nx), dtype=jnp.float64,
+                  settings={"nu": 0.1, "Velocity": 0.05,
+                            "Porocity": 0.5,
+                            "DragInObj": drag, "MaterialInObj": material})
+    flags = np.full((ny, nx), m.flag_for("MRT"), dtype=np.uint16)
+    flags[:, 0] = m.flag_for("WVelocity", "MRT")
+    flags[:, -1] = m.flag_for("EPressure", "MRT")
+    flags[0, :] = m.flag_for("Wall")
+    flags[-1, :] = m.flag_for("Wall")
+    # design space: an interior block
+    flags[2:6, 5:10] |= m.flag_for("DesignSpace")
+    lat.set_flags(flags)
+    lat.init()
+    return m, lat
+
+
+def test_checkpoint_scan_matches_plain():
+    m, lat = _setup()
+    run1 = make_objective_run(m, 12, levels=1)
+    run3 = make_objective_run(m, 12, levels=3)
+    o1, s1 = jax.jit(run1)(lat.state, lat.params)
+    o3, s3 = jax.jit(run3)(lat.state, lat.params)
+    assert float(o1) == pytest.approx(float(o3), rel=1e-12)
+    np.testing.assert_allclose(np.asarray(s1.fields), np.asarray(s3.fields),
+                               rtol=1e-12)
+
+
+def test_unsteady_gradient_vs_fd():
+    """The FDTest of the framework (reference acFDTest): adjoint gradient of
+    the time-integrated Drag objective wrt the topology field."""
+    m, lat = _setup()
+    design = InternalTopology(m)
+    niter = 8
+    grad_fn = make_unsteady_gradient(m, design, niter, levels=2)
+    theta0 = design.get(lat.state, lat.params)
+    obj, g, _ = grad_fn(theta0, lat.state, lat.params)
+    assert np.isfinite(float(obj))
+    g = np.asarray(g)
+    # gradient confined to the design mask
+    mask = np.zeros((8, 16), dtype=bool)
+    mask[2:6, 5:10] = True
+    assert np.abs(g[0][~mask]).max() == 0.0
+    assert np.abs(g[0][mask]).max() > 0.0
+
+    run = make_objective_run(m, niter, levels=2)
+
+    @jax.jit
+    def loss(th):
+        s2, p2 = design.put(th, lat.state, lat.params)
+        return run(s2, p2)[0]
+
+    checks = fd_test(loss, jnp.asarray(g), theta0, n_checks=4, eps=1e-6)
+    for c in checks:
+        # probed indices may fall outside the design mask (both grads 0)
+        if c["adjoint"] == 0.0 and abs(c["fd"]) < 1e-9:
+            continue
+        assert c["rel_err"] < 1e-6, c
+
+
+def test_control_gradient_vs_fd():
+    """Gradient wrt a zonal control time series (the reference's
+    OptimalControl + GRAD planes, C7)."""
+    m, lat = _setup()
+    lat.set_setting_series("Velocity", np.full(16, 0.05), zone=0)
+    design = OptimalControl(m, "Velocity", zone=0)
+    niter = 8
+    grad_fn = make_unsteady_gradient(m, design, niter, levels=1)
+    theta0 = design.get(lat.state, lat.params)
+    obj, g, _ = grad_fn(theta0, lat.state, lat.params)
+    g = np.asarray(g)
+    assert g.shape == (16,)
+    # only the first `niter` entries can influence the objective
+    assert np.abs(g[:niter]).max() > 0
+    assert np.abs(g[niter:]).max() == 0
+
+    run = make_objective_run(m, niter, levels=1)
+
+    @jax.jit
+    def loss(th):
+        s2, p2 = design.put(th, lat.state, lat.params)
+        return run(s2, p2)[0]
+
+    checks = fd_test(loss, jnp.asarray(g), theta0, n_checks=3, eps=1e-6,
+                     seed=3)
+    for c in checks:
+        if c["adjoint"] == 0.0 and abs(c["fd"]) < 1e-9:
+            continue
+        assert c["rel_err"] < 1e-6, c
+
+
+def test_steady_gradient_finite_and_masked():
+    m, lat = _setup()
+    lat.iterate(200)          # approach steady state
+    design = InternalTopology(m)
+    grad_fn = make_steady_gradient(m, design, n_adjoint=50)
+    theta0 = design.get(lat.state, lat.params)
+    obj, g = grad_fn(theta0, lat.state, lat.params)
+    g = np.asarray(g)
+    assert np.isfinite(float(obj))
+    assert np.isfinite(g).all()
+    mask = np.zeros((8, 16), dtype=bool)
+    mask[2:6, 5:10] = True
+    assert np.abs(g[0][~mask]).max() == 0.0
+    assert np.abs(g[0][mask]).max() > 0.0
+
+
+def test_optimize_descent_reduces_drag():
+    m, lat = _setup(drag=1.0)
+    design = InternalTopology(m)
+    grad_full = make_unsteady_gradient(m, design, 10, levels=2)
+
+    def grad_fn(theta):
+        obj, g, _ = grad_full(theta, lat.state, lat.params)
+        return obj, g
+
+    theta0 = design.get(lat.state, lat.params)
+    o0, _ = grad_fn(theta0)
+    theta, obj = optimize(grad_fn, theta0, method="DESCENT", max_eval=5,
+                          step=5.0, bounds=design.bounds())
+    assert obj < float(o0)
+    # bounds respected
+    assert float(jnp.min(theta)) >= 0.0 and float(jnp.max(theta)) <= 1.0
+
+
+def test_optimize_lbfgs_runs():
+    m, lat = _setup(drag=1.0, material=0.01)
+    design = InternalTopology(m)
+    grad_full = make_unsteady_gradient(m, design, 6, levels=1)
+
+    def grad_fn(theta):
+        obj, g, _ = grad_full(theta, lat.state, lat.params)
+        return obj, g
+
+    theta0 = design.get(lat.state, lat.params)
+    o0, _ = grad_fn(theta0)
+    theta, obj = optimize(grad_fn, theta0, method="MMA", max_eval=8,
+                          bounds=design.bounds())
+    assert obj <= float(o0) + 1e-12
+
+
+def test_threshold():
+    m, lat = _setup()
+    st = threshold_topology(m, lat.state)
+    w = np.asarray(st.fields[m.storage_index["w"]])
+    mask = np.zeros((8, 16), dtype=bool)
+    mask[2:6, 5:10] = True
+    assert set(np.unique(w[mask])) <= {0.0, 1.0}
+
+
+def test_xml_optimize_pipeline(tmp_path):
+    """End-to-end: geometry with a DesignSpace block, <FDTest>, <Optimize>,
+    <ThresholdNow> via the XML control plane (reference heat_adj-style
+    configs, example/heat_adj.xml)."""
+    from tclb_tpu.control import run_config_string
+    xml = f"""<CLBConfig output="{tmp_path}/">
+    <Geometry nx="16" ny="8">
+        <MRT><Box/></MRT>
+        <WVelocity name="in"><Inlet/></WVelocity>
+        <EPressure name="out"><Outlet/></EPressure>
+        <Wall mask="ALL"><Channel/></Wall>
+        <DesignSpace><Box dx="5" nx="5" dy="2" ny="4"/></DesignSpace>
+    </Geometry>
+    <Model><Params Velocity="0.05" nu="0.1" Porocity="0.5"
+                   DragInObj="1.0"/></Model>
+    <FDTest Iterations="4" Checks="3"/>
+    <Optimize Method="DESCENT" MaxEvaluations="3" Iterations="6" Step="5.0">
+        <InternalTopology/>
+    </Optimize>
+    <ThresholdNow/>
+    <Solve Iterations="10"/>
+    </CLBConfig>"""
+    solver = run_config_string(xml, get_model("d2q9_adj"),
+                               dtype=jnp.float64)
+    assert solver.fd_records is not None
+    for r in solver.fd_records:
+        if r["adjoint"] == 0 and abs(r["fd"]) < 1e-9:
+            continue
+        assert r["rel_err"] < 1e-5
+    assert solver.objective is not None
+    w = np.asarray(solver.lattice.get_quantity("W"))
+    # thresholded inside the design block (untouched elsewhere)
+    assert set(np.unique(w[2:6, 5:10])) <= {0.0, 1.0}
+    u = np.asarray(solver.lattice.get_quantity("U"))
+    assert np.isfinite(u).all()
+
+
+def test_reparam_roundtrip():
+    m, lat = _setup()
+    T = 32
+    lat.set_setting_series("Velocity", np.zeros(T), zone=0)
+    inner = OptimalControl(m, "Velocity", zone=0)
+    for design, p in ((Fourier(inner, T, 3), 7),
+                      (BSpline(inner, T, 6), 6),
+                      (RepeatControl(inner, T, 8), 8)):
+        theta = jnp.asarray(np.linspace(0.1, 0.2, p))
+        _, params2 = design.put(theta, lat.state, lat.params)
+        series = np.asarray(inner.get(lat.state, params2))
+        assert series.shape == (T,)
+        assert np.isfinite(series).all()
+        # pullback of the pushed series recovers theta (basis full rank)
+        lat.params = params2
+        back = np.asarray(design.get(lat.state, lat.params))
+        np.testing.assert_allclose(back, np.asarray(theta), atol=1e-8)
+    # RepeatControl is an exact tiling
+    rc = RepeatControl(inner, T, 8)
+    th = jnp.asarray(np.arange(8.0))
+    _, p2 = rc.put(th, lat.state, lat.params)
+    series = np.asarray(p2.time_series[0])
+    np.testing.assert_allclose(series, np.tile(np.arange(8.0), 4))

@@ -323,9 +323,14 @@ def make_stage_step(model: Model, stage_name: str,
                       loader=streaming.make_loader(raw),
                       iteration=state.iteration)
         new_fields = fn(ctx)
-        # a stage may return a partial update: dict name->plane
+        # A stage may return a partial update (dict name->plane): only the
+        # named planes are saved, everything else keeps its UN-streamed
+        # storage — the reference's per-stage save set (AddStage save=...,
+        # e.g. d2q9_kuper's CalcPhi saves only phi while reading streamed f,
+        # src/d2q9_kuper/Dynamics.R:15-19).  A full-array return (ctx.store)
+        # is a streaming stage: it persists the pulled+collided populations.
         if isinstance(new_fields, dict):
-            buf = pulled
+            buf = raw
             for name, plane in new_fields.items():
                 buf = buf.at[model.storage_index[name]].set(plane)
             new_fields = buf
@@ -335,7 +340,7 @@ def make_stage_step(model: Model, stage_name: str,
             fields=new_fields,
             flags=state.flags,
             globals_=ctx.reduce_globals(),
-            iteration=state.iteration + (1 if stage.load_densities else 0),
+            iteration=state.iteration,
         )
 
     return step
@@ -348,10 +353,16 @@ def make_action_step(model: Model, action: str = "Iteration",
     src/Lattice.cu.Rt:414-457)."""
     steps = [make_stage_step(model, s, streaming)
              for s in model.actions[action]]
+    # one action == one lattice iteration (when it streams at all):
+    # the counter advances once per action, not per stage
+    advances = any(model.stages[s].load_densities
+                   for s in model.actions[action])
 
     def step(state: LatticeState, params: SimParams) -> LatticeState:
         for s in steps:
             state = s(state, params)
+        if advances:
+            state = state.replace(iteration=state.iteration + 1)
         return state
 
     return step

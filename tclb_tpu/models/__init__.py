@@ -12,9 +12,28 @@ from tclb_tpu.core.registry import Model
 
 # model name -> module path (lazy import; building a model is cheap but
 # importing all of them on package import is not needed)
+# entries are "module.path" (uses its build()) or "module.path:builder"
 _REGISTRY: dict[str, str] = {
     "d2q9": "tclb_tpu.models.d2q9",
     "d2q9_adj": "tclb_tpu.models.d2q9_adj",
+    "d2q9_SRT": "tclb_tpu.models.d2q9_srt",
+    "d2q9_cumulant": "tclb_tpu.models.d2q9_cumulant",
+    "d2q9_inc": "tclb_tpu.models.d2q9_inc",
+    "d2q9_les": "tclb_tpu.models.d2q9_les",
+    "d3q19": "tclb_tpu.models.d3q19",
+    "d3q19_les": "tclb_tpu.models.d3q19_les",
+    "d3q27": "tclb_tpu.models.d3q27",
+    "d3q27_BGK": "tclb_tpu.models.d3q27_bgk",
+    "d3q27_BGK_galcor": "tclb_tpu.models.d3q27_bgk:build_galcor",
+    "d3q27_cumulant": "tclb_tpu.models.d3q27_cumulant",
+    "d2q9_new": "tclb_tpu.models.d2q9_new",
+    "d2q9_heat": "tclb_tpu.models.d2q9_heat",
+    "d2q9_hb": "tclb_tpu.models.d2q9_hb",
+    "d2q9_diff": "tclb_tpu.models.d2q9_diff",
+    "d2q9_kuper": "tclb_tpu.models.d2q9_kuper",
+    "sw": "tclb_tpu.models.sw",
+    "wave": "tclb_tpu.models.wave",
+    "wave2d": "tclb_tpu.models.wave2d",
 }
 
 _CACHE: dict[str, Model] = {}
@@ -32,6 +51,8 @@ def get_model(name: str) -> Model:
     if name not in _CACHE:
         if name not in _REGISTRY:
             raise KeyError(f"unknown model {name!r}; known: {list_models()}")
-        mod = importlib.import_module(_REGISTRY[name])
-        _CACHE[name] = mod.build()
+        path = _REGISTRY[name]
+        modpath, _, builder = path.partition(":")
+        mod = importlib.import_module(modpath)
+        _CACHE[name] = getattr(mod, builder or "build")()
     return _CACHE[name]

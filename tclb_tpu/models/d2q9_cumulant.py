@@ -1,0 +1,51 @@
+"""d2q9_cumulant — 2D cumulant collision.
+
+Behavioral parity target: reference model ``d2q9_cumulant``
+(reference src/d2q9_cumulant/Dynamics.R, hand-written Dynamics.c).  The
+collision is the tensor-product central-moment transform with Isserlis
+closure (tclb_tpu/ops/cumulant.py) — the numerical equivalent of the
+reference's symbolically generated cumulant kernel.
+"""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+from tclb_tpu.core.lattice import NodeCtx
+from tclb_tpu.models import family
+from tclb_tpu.ops import cumulant, lbm
+
+E = cumulant.velocity_set(2)        # tensor order: (cx, cy), index -1,0,1
+W = lbm.weights(E)
+OPP = lbm.opposite(E)
+
+
+def _def():
+    d = family.base_def("d2q9_cumulant", E, "2D cumulant collision")
+    d.add_setting("omega_bulk", default=1.0,
+                  comment="bulk (trace) relaxation rate")
+    return d
+
+
+def run(ctx: NodeCtx) -> jnp.ndarray:
+    f = ctx.group("f")
+    f = family.apply_boundaries(ctx, f, E, W, OPP)
+    family.add_flux_objectives(ctx, f, E)
+    shape = f.shape[1:]
+    F = f.reshape((3, 3) + shape)
+    Fp, _, _ = cumulant.collide_d2q9(
+        F, ctx.setting("omega"), ctx.setting("omega_bulk"),
+        force=family.gravity_of(ctx))
+    f = jnp.where(ctx.nt_in_group("COLLISION")[None],
+                  Fp.reshape((9,) + shape), f)
+    return ctx.store({"f": f})
+
+
+def init(ctx: NodeCtx) -> jnp.ndarray:
+    return family.standard_init(ctx, E, W)
+
+
+def build():
+    return _def().finalize().bind(
+        run=run, init=init,
+        quantities=family.make_getters(E, force_of=family.gravity_of))

@@ -1,0 +1,84 @@
+"""d2q9_hb — thermal d2q9 with shear-driven material destruction
+(Herschel-Bulkley-type erosion model).
+
+Behavioral parity target: reference model ``d2q9_hb``
+(reference src/d2q9_hb/Dynamics.R, hand-written Dynamics.c): the d2q9_heat
+structure (flow f + advected scalar T) plus shear-stress quantities
+(Q/Qxx/Qxy/Qyy/SS from the non-equilibrium stress) and ``Destroy`` nodes
+where the scalar erodes at ``DestructionRate * SS^DestructionPower``;
+DestroyedCellFlux tracks the eroded amount.
+"""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+from tclb_tpu.core.lattice import NodeCtx
+from tclb_tpu.models import d2q9_heat
+from tclb_tpu.models.d2q9 import E
+from tclb_tpu.ops import lbm
+
+W = lbm.weights(E)
+
+
+def _def():
+    d = d2q9_heat._def()
+    d.name = "d2q9_hb"
+    d.description = "thermal d2q9 with shear-driven destruction"
+    d.add_quantity("Q")
+    d.add_quantity("Qxx")
+    d.add_quantity("Qxy")
+    d.add_quantity("Qyy")
+    d.add_quantity("SS", unit="N/m2")
+    d.add_setting("DestructionRate", default=0.0)
+    d.add_setting("DestructionPower", default=1.0)
+    d.add_global("DestroyedCellFlux")
+    d.add_node_type("Destroy", "ADDITIONALS")
+    d.add_node_type("Outlet2", "ADDITIONALS")
+    return d
+
+
+def _neq_stress(ctx: NodeCtx, f: jnp.ndarray):
+    dt = f.dtype
+    rho = jnp.sum(f, axis=0)
+    ux = jnp.tensordot(jnp.asarray(E[:, 0], dt), f, axes=1) / rho
+    uy = jnp.tensordot(jnp.asarray(E[:, 1], dt), f, axes=1) / rho
+    feq = lbm.equilibrium(E, W, rho, (ux, uy))
+    fneq = f - feq
+    qxx = jnp.tensordot(jnp.asarray(E[:, 0] * E[:, 0], dt), fneq, axes=1)
+    qxy = jnp.tensordot(jnp.asarray(E[:, 0] * E[:, 1], dt), fneq, axes=1)
+    qyy = jnp.tensordot(jnp.asarray(E[:, 1] * E[:, 1], dt), fneq, axes=1)
+    ss = jnp.sqrt(qxx * qxx + 2.0 * qxy * qxy + qyy * qyy)
+    return qxx, qxy, qyy, ss
+
+
+def run(ctx: NodeCtx) -> jnp.ndarray:
+    out = d2q9_heat.run(ctx)
+    # erosion: Destroy nodes lose scalar at rate * SS^power
+    m = ctx.model
+    fidx = jnp.asarray(m.groups["f"])
+    tidx = jnp.asarray(m.groups["T"])
+    f = out[fidx]
+    fT = out[tidx]
+    _, _, _, ss = _neq_stress(ctx, f)
+    rate = ctx.setting("DestructionRate") \
+        * jnp.power(jnp.maximum(ss, 1e-30), ctx.setting("DestructionPower"))
+    destroy = ctx.nt_is("Destroy")
+    scale = jnp.where(destroy, jnp.maximum(1.0 - rate, 0.0),
+                      jnp.ones_like(rate))
+    ctx.add_global("DestroyedCellFlux",
+                   jnp.sum(fT, axis=0) * (1.0 - scale), where=destroy)
+    return out.at[tidx].set(fT * scale[None])
+
+
+def build():
+    q = {"Rho": d2q9_heat.get_rho, "T": d2q9_heat.get_t,
+         "U": d2q9_heat.get_u}
+
+    def mk(i):
+        return lambda ctx: _neq_stress(ctx, ctx.group("f"))[i]
+
+    q.update({"Qxx": mk(0), "Qxy": mk(1), "Qyy": mk(2), "SS": mk(3),
+              "Q": mk(3)})
+    return _def().finalize().bind(run=run, init=d2q9_heat.init,
+                                  quantities=q)

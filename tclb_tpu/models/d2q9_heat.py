@@ -1,0 +1,136 @@
+"""d2q9_heat — coupled flow + temperature (double-distribution d2q9+d2q9).
+
+Behavioral parity target: reference model ``d2q9_heat``
+(reference src/d2q9_heat/Dynamics.R, Dynamics.c.Rt): a d2q9 ``f`` lattice
+for flow and a second d2q9 ``T`` lattice advecting temperature at the fluid
+velocity with diffusivity ``FluidAlfa``; ``Heater`` nodes
+(ADDITIONALS group) pin the relaxation target temperature (the reference
+hard-codes 100, src/d2q9_heat/Dynamics.c.Rt:257 — here it is the
+``HeaterTemperature`` setting with that default).
+"""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+from tclb_tpu.core.lattice import NodeCtx
+from tclb_tpu.core.registry import ModelDef
+from tclb_tpu.models.d2q9 import E, OPP, _zou_he_x
+from tclb_tpu.ops import lbm
+
+W = lbm.weights(E)
+
+
+def _def() -> ModelDef:
+    d = ModelDef("d2q9_heat", ndim=2,
+                 description="2D flow + temperature (double distribution)")
+    d.add_densities("f", E)
+    d.add_densities("T", E, group="T")
+    d.add_quantity("Rho", unit="kg/m3")
+    d.add_quantity("T", unit="K")
+    d.add_quantity("U", unit="m/s", vector=True)
+    d.add_setting("omega", default=1.0, comment="one over relaxation time")
+    d.add_setting("nu", default=1 / 6, comment="viscosity",
+                  derived={"omega": lambda nu: 1.0 / (3 * nu + 0.5)})
+    d.add_setting("InletVelocity", comment="inlet velocity")
+    d.add_setting("InletPressure", default=0.0, comment="inlet pressure",
+                  derived={"InletDensity": lambda p: 1.0 + p / 3.0})
+    d.add_setting("InletDensity", default=1.0)
+    d.add_setting("InletTemperature", default=1.0)
+    d.add_setting("InitTemperature", default=1.0)
+    d.add_setting("FluidAlfa", default=1.0, comment="thermal diffusivity")
+    d.add_setting("HeaterTemperature", default=100.0,
+                  comment="pinned temperature of Heater nodes")
+    d.add_global("OutFlux")
+    d.add_node_type("Heater", "ADDITIONALS")
+    return d
+
+
+def _t_eq(T, ux, uy):
+    dt = T.dtype
+    out = []
+    for i in range(9):
+        eu = float(E[i, 0]) * ux + float(E[i, 1]) * uy
+        out.append(jnp.asarray(float(W[i]), dt) * T * (1.0 + 3.0 * eu))
+    return jnp.stack(out)
+
+
+def run(ctx: NodeCtx) -> jnp.ndarray:
+    f = ctx.group("f")
+    fT = ctx.group("T")
+    dt = f.dtype
+    vel = ctx.setting("InletVelocity")
+    den = ctx.setting("InletDensity")
+    t_in = ctx.setting("InletTemperature")
+
+    f = ctx.boundary_case(f, {
+        ("Wall", "Solid"): lambda f: f[jnp.asarray(OPP)],
+        "WVelocity": lambda f: _zou_he_x(f, vel, "velocity", "W"),
+        "EVelocity": lambda f: _zou_he_x(f, vel, "velocity", "E"),
+        "WPressure": lambda f: _zou_he_x(f, den, "pressure", "W"),
+        "EPressure": lambda f: _zou_he_x(f, den, "pressure", "E"),
+    })
+    # temperature boundaries: bounce-back at walls (adiabatic), fixed
+    # inlet temperature at velocity inlets
+    fT = ctx.boundary_case(fT, {
+        ("Wall", "Solid"): lambda t: t[jnp.asarray(OPP)],
+        ("WVelocity", "EPressure"): lambda t: _t_eq(
+            jnp.broadcast_to(t_in, t.shape[1:]).astype(dt),
+            jnp.zeros(t.shape[1:], dt), jnp.zeros(t.shape[1:], dt)),
+    })
+
+    rho = jnp.sum(f, axis=0)
+    ux = jnp.tensordot(jnp.asarray(E[:, 0], dt), f, axes=1) / rho
+    uy = jnp.tensordot(jnp.asarray(E[:, 1], dt), f, axes=1) / rho
+
+    om = ctx.setting("omega")
+    feq = lbm.equilibrium(E, W, rho, (ux, uy))
+    fc = f + om * (feq - f)
+
+    temp = jnp.sum(fT, axis=0)
+    # Heater nodes relax toward the pinned temperature
+    # (reference src/d2q9_heat/Dynamics.c.Rt:257: d=100)
+    target = jnp.where(ctx.nt_is("Heater"),
+                       ctx.setting("HeaterTemperature"), temp)
+    om_t = 1.0 / (3.0 * ctx.setting("FluidAlfa") + 0.5)
+    tc = fT + om_t * (_t_eq(target, ux, uy) - fT)
+
+    coll = ctx.nt_in_group("COLLISION")[None]
+    f = jnp.where(coll, fc, f)
+    fT = jnp.where(coll, tc, fT)
+    ctx.add_global("OutFlux", temp * ux, where=ctx.nt_is("Outlet"))
+    return ctx.store({"f": f, "T": fT})
+
+
+def init(ctx: NodeCtx) -> jnp.ndarray:
+    shape = ctx.flags.shape
+    dt = ctx._fields.dtype
+    rho = jnp.ones(shape, dt)
+    ux = jnp.broadcast_to(ctx.setting("InletVelocity"), shape).astype(dt)
+    f = lbm.equilibrium(E, W, rho, (ux, jnp.zeros(shape, dt)))
+    t0 = jnp.broadcast_to(ctx.setting("InitTemperature"), shape).astype(dt)
+    fT = _t_eq(t0, jnp.zeros(shape, dt), jnp.zeros(shape, dt))
+    return ctx.store({"f": f, "T": fT})
+
+
+def get_rho(ctx):
+    return jnp.sum(ctx.group("f"), axis=0)
+
+
+def get_t(ctx):
+    return jnp.sum(ctx.group("T"), axis=0)
+
+
+def get_u(ctx):
+    f = ctx.group("f")
+    dt = f.dtype
+    rho = jnp.sum(f, axis=0)
+    ux = jnp.tensordot(jnp.asarray(E[:, 0], dt), f, axes=1) / rho
+    uy = jnp.tensordot(jnp.asarray(E[:, 1], dt), f, axes=1) / rho
+    return jnp.stack([ux, uy, jnp.zeros_like(ux)])
+
+
+def build():
+    return _def().finalize().bind(
+        run=run, init=init,
+        quantities={"Rho": get_rho, "T": get_t, "U": get_u})

@@ -1,0 +1,197 @@
+"""d2q9_kuper — Kupershtokh pseudopotential multiphase (phase change).
+
+Behavioral parity target: reference model ``d2q9_kuper``
+(reference src/d2q9_kuper/Dynamics.R, Dynamics.c.Rt): two-stage iteration —
+``CalcPhi`` computes the pseudopotential
+``phi = FAcc sqrt(rho/3 - Magic p_vdW(rho, T))`` from the streamed density
+(src/d2q9_kuper/Dynamics.c.Rt:290-321), then ``Run`` assembles the
+Kupershtokh exact-difference force from neighbor phi
+(:57-127: ``R_i = A phi_i^2 + (1-2A) phi_i phi_0``, shell weights
+(1, 1/4)), and collides with a settings-driven MRT.  The ``phi`` Field with
+a +-1 stencil exercises the framework's non-streamed neighbor access
+(reference AddField stencil2d=1, src/d2q9_kuper/Dynamics.R:12).
+"""
+
+from __future__ import annotations
+
+import numpy as np
+import jax.numpy as jnp
+
+from tclb_tpu.core.lattice import NodeCtx
+from tclb_tpu.core.registry import ModelDef
+from tclb_tpu.models.d2q9 import E, M, OPP, _equilibrium
+from tclb_tpu.ops import lbm
+
+W = lbm.weights(E)
+# shell force weights gs (reference src/d2q9_kuper/Dynamics.c.Rt:115)
+GS = np.array([0.0, 1.0, 1.0, 1.0, 1.0, 0.25, 0.25, 0.25, 0.25])
+# van der Waals EOS constants (reference src/d2q9_kuper/Dynamics.c.Rt:291-293)
+A2 = 3.852462271644162
+B2 = 0.1304438860971524 * 4.0
+C2 = 2.785855170470555
+
+
+def _def() -> ModelDef:
+    d = ModelDef("d2q9_kuper", ndim=2,
+                 description="Kupershtokh pseudopotential multiphase")
+    d.add_densities("f", E)
+    d.add_field("phi", dx=(-1, 1), dy=(-1, 1))
+    d.add_stage("BaseIteration", "Run")
+    d.add_stage("CalcPhi", "CalcPhi")
+    d.add_stage("BaseInit", "Init", load_densities=False)
+    d.add_action("Iteration", ("BaseIteration", "CalcPhi"))
+    d.add_action("Init", ("BaseInit", "CalcPhi"))
+    d.add_quantity("Rho", unit="kg/m3")
+    d.add_quantity("U", unit="m/s", vector=True)
+    d.add_quantity("P", unit="Pa")
+    d.add_quantity("F", unit="N", vector=True)
+    d.add_setting("omega", default=1.0)
+    d.add_setting("nu", default=1 / 6,
+                  derived={"omega": lambda nu: 1.0 / (3 * nu + 0.5),
+                           "S7": lambda nu: 1.0 - 1.0 / (3 * nu + 0.5),
+                           "S8": lambda nu: 1.0 - 1.0 / (3 * nu + 0.5)})
+    d.add_setting("InletVelocity")
+    d.add_setting("Temperature", default=0.9,
+                  comment="temperature of the liquid/gas")
+    d.add_setting("FAcc", default=1.0, comment="multiplier of potential")
+    d.add_setting("Magic", default=0.01)
+    d.add_setting("MagicA", default=-0.152, comment="A in force calc")
+    d.add_setting("MagicF", default=-2.0 / 3.0, comment="force multiplier")
+    d.add_setting("GravitationX")
+    d.add_setting("GravitationY")
+    d.add_setting("MovingWallVelocity")
+    d.add_setting("Density", default=1.0, zonal=True)
+    d.add_setting("Wetting", default=1.0)
+    for i, dflt in enumerate([0, 0, 0, -1 / 3, 0, 0, 0, 0, 0]):
+        d.add_setting(f"S{i}", default=dflt, comment="MRT keep factor")
+    d.add_global("WallForceX")
+    d.add_global("WallForceY")
+    d.add_node_type("NSymmetry", "BOUNDARY")
+    d.add_node_type("SSymmetry", "BOUNDARY")
+    d.add_node_type("MovingWall", "BOUNDARY")
+    return d
+
+
+def _eos_pressure(rho, t):
+    """Magic-scaled van der Waals pressure
+    (reference src/d2q9_kuper/Dynamics.c.Rt:317-318)."""
+    br = B2 * rho / 4.0
+    p = ((rho * (-br ** 3 + br * br + br + 1.0) * t * C2)
+         / (1.0 - br) ** 3 - A2 * rho * rho)
+    return p
+
+
+def calc_phi(ctx: NodeCtx):
+    """CalcPhi stage: pseudopotential from the streamed density; boundary
+    nodes use the zonal Density setting (reference
+    src/d2q9_kuper/Dynamics.c.Rt:290-321)."""
+    f = ctx.group("f")
+    rho = jnp.sum(f, axis=0)
+    bound = ctx.nt_in_group("BOUNDARY") \
+        & ~(ctx.nt_is("NSymmetry") | ctx.nt_is("SSymmetry"))
+    rho = jnp.where(bound, ctx.setting("Density"), rho)
+    p = ctx.setting("Magic") * _eos_pressure(rho, ctx.setting("Temperature"))
+    phi = ctx.setting("FAcc") * jnp.sqrt(jnp.maximum(rho / 3.0 - p, 0.0))
+    return {"phi": phi}
+
+
+def _force(ctx: NodeCtx, f: jnp.ndarray):
+    """Kupershtokh exact-difference force from neighbor phi
+    (reference src/d2q9_kuper/Dynamics.c.Rt:57-127)."""
+    dt = f.dtype
+    a = ctx.setting("MagicA")
+    phi0 = ctx.load("phi")
+    fx = jnp.zeros_like(phi0)
+    fy = jnp.zeros_like(phi0)
+    for i in range(1, 9):
+        phii = ctx.load("phi", int(E[i, 0]), int(E[i, 1]))
+        r = a * phii * phii + (1.0 - 2.0 * a) * phii * phi0
+        g = float(GS[i])
+        fx = fx + g * r * float(E[i, 0])
+        fy = fy + g * r * float(E[i, 1])
+    scale = ctx.setting("MagicF")
+    fx, fy = scale * fx, scale * fy
+    # wall momentum term (reference :60-66) + wall force objectives
+    ex = jnp.tensordot(jnp.asarray(E[:, 0], dt), f, axes=1)
+    ey = jnp.tensordot(jnp.asarray(E[:, 1], dt), f, axes=1)
+    wall = ctx.nt_is("Wall")
+    fx = jnp.where(wall, fx + 2.0 * ex, fx)
+    fy = jnp.where(wall, fy + 2.0 * ey, fy)
+    ctx.add_global("WallForceX", ex, where=wall)
+    ctx.add_global("WallForceY", ey, where=wall)
+    return fx, fy
+
+
+def run(ctx: NodeCtx) -> jnp.ndarray:
+    f = ctx.group("f")
+    dt = f.dtype
+    mwv = ctx.setting("MovingWallVelocity")
+
+    def moving_wall(f):
+        # bounce-back with tangential wall momentum (Ladd correction)
+        fb = f[jnp.asarray(OPP)]
+        corr = jnp.stack([6.0 * float(W[i]) * float(E[i, 0]) * mwv
+                          * jnp.ones(f.shape[1:], dt) for i in range(9)])
+        return fb + corr
+
+    def mirror(perm):
+        return lambda f: f[jnp.asarray(perm)]
+
+    from tclb_tpu.models.family import mirror_perm
+    f = ctx.boundary_case(f, {
+        ("Wall", "Solid"): lambda f: f[jnp.asarray(OPP)],
+        "MovingWall": moving_wall,
+        "NSymmetry": mirror(mirror_perm(E, 1)),
+        "SSymmetry": mirror(mirror_perm(E, 1)),
+    })
+
+    rho = jnp.sum(f, axis=0)
+    ux = jnp.tensordot(jnp.asarray(E[:, 0], dt), f, axes=1) / rho
+    uy = jnp.tensordot(jnp.asarray(E[:, 1], dt), f, axes=1) / rho
+    keep = jnp.stack([ctx.setting(f"S{i}") for i in range(9)]).astype(dt)
+    feq = _equilibrium(rho, ux, uy)
+    m_neq = lbm.moments(M, f - feq) * keep.reshape((9,) + (1,) * (f.ndim - 1))
+    fx, fy = _force(ctx, f)
+    ux2 = ux + fx / rho + ctx.setting("GravitationX")
+    uy2 = uy + fy / rho + ctx.setting("GravitationY")
+    m_post = m_neq + lbm.moments(M, _equilibrium(rho, ux2, uy2))
+    fc = lbm.from_moments(M, m_post)
+    f = jnp.where(ctx.nt_in_group("COLLISION")[None], fc, f)
+    return ctx.store({"f": f})
+
+
+def init(ctx: NodeCtx) -> jnp.ndarray:
+    shape = ctx.flags.shape
+    dt = ctx._fields.dtype
+    rho = jnp.broadcast_to(ctx.setting("Density"), shape).astype(dt)
+    ux = jnp.broadcast_to(ctx.setting("InletVelocity"), shape).astype(dt)
+    f = _equilibrium(rho, ux, jnp.zeros(shape, dt))
+    return ctx.store({"f": f})
+
+
+def get_u(ctx):
+    f = ctx.group("f")
+    dt = f.dtype
+    rho = jnp.sum(f, axis=0)
+    ux = jnp.tensordot(jnp.asarray(E[:, 0], dt), f, axes=1) / rho
+    uy = jnp.tensordot(jnp.asarray(E[:, 1], dt), f, axes=1) / rho
+    return jnp.stack([ux, uy, jnp.zeros_like(ux)])
+
+
+def get_p(ctx):
+    rho = jnp.sum(ctx.group("f"), axis=0)
+    return ctx.setting("Magic") * _eos_pressure(rho,
+                                                ctx.setting("Temperature"))
+
+
+def get_f(ctx):
+    fx, fy = _force(ctx, ctx.group("f"))
+    return jnp.stack([fx, fy, jnp.zeros_like(fx)])
+
+
+def build():
+    return _def().finalize().bind(
+        run=run, init=init,
+        stages={"CalcPhi": calc_phi},
+        quantities={"Rho": lambda c: jnp.sum(c.group("f"), axis=0),
+                    "U": get_u, "P": get_p, "F": get_f})

@@ -1,0 +1,20 @@
+"""d2q9_new — the reference's newer d2q9 variant.
+
+Behavioral parity target: reference model ``d2q9_new``
+(reference src/d2q9_new/Dynamics.R, Dynamics.c.Rt): same physics family as
+``d2q9`` (MRT, Zou/He faces, body force) with the modernized settings
+surface; realized here as the d2q9 physics under its own registry name.
+"""
+
+from __future__ import annotations
+
+from tclb_tpu.models import d2q9
+
+
+def build():
+    d = d2q9._def()
+    d.name = "d2q9_new"
+    d.description = "2D MRT (newer variant)"
+    return d.finalize().bind(
+        run=d2q9.run, init=d2q9.init,
+        quantities={"Rho": d2q9.get_rho, "U": d2q9.get_u})

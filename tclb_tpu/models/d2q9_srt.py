@@ -1,0 +1,47 @@
+"""d2q9_SRT — 2D single-relaxation-time BGK.
+
+Behavioral parity target: reference model ``d2q9_SRT``
+(reference src/d2q9_SRT/Dynamics.R, hand-written Dynamics.c): the simplest
+hydrodynamic model — BGK collision, bounce-back walls, Zou/He-style
+velocity/pressure faces, body force.
+"""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+from tclb_tpu.core.lattice import NodeCtx
+from tclb_tpu.models import family
+from tclb_tpu.models.d2q9 import E
+from tclb_tpu.ops import lbm
+
+W = lbm.weights(E)
+OPP = lbm.opposite(E)
+
+
+def _def():
+    d = family.base_def("d2q9_SRT", E,
+                        "2D single-relaxation-time BGK")
+    d.add_node_type("TopSymmetry", "BOUNDARY")
+    d.add_node_type("BottomSymmetry", "BOUNDARY")
+    return d
+
+
+def run(ctx: NodeCtx) -> jnp.ndarray:
+    f = ctx.group("f")
+    f = family.apply_boundaries(ctx, f, E, W, OPP)
+    family.add_flux_objectives(ctx, f, E)
+    fc, _, _ = lbm.bgk_collide(E, W, f, ctx.setting("omega"),
+                               force=family.gravity_of(ctx))
+    f = jnp.where(ctx.nt_in_group("COLLISION")[None], fc, f)
+    return ctx.store({"f": f})
+
+
+def init(ctx: NodeCtx) -> jnp.ndarray:
+    return family.standard_init(ctx, E, W)
+
+
+def build():
+    return _def().finalize().bind(
+        run=run, init=init,
+        quantities=family.make_getters(E, force_of=family.gravity_of))

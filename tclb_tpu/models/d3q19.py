@@ -1,0 +1,73 @@
+"""d3q19 — 3D MRT.
+
+Behavioral parity target: reference model ``d3q19``
+(reference src/d3q19/Dynamics.R, Dynamics.c.Rt): 19-velocity MRT with
+velocity/pressure faces and body force.  The moment basis is built
+numerically by Gram-Schmidt over the monomials (the reference builds the
+equivalent basis symbolically, src/lib/d3q19.R + lib/feq.R); conserved
+moments are untouched, the stress moments relax with ``omega``, higher
+moments with the free rates ``S_high`` (default 1 = project to equilibrium).
+"""
+
+from __future__ import annotations
+
+import numpy as np
+import jax.numpy as jnp
+
+from tclb_tpu.core.lattice import NodeCtx
+from tclb_tpu.models import family
+from tclb_tpu.ops import lbm
+
+E = lbm.d3q19_velocities()
+W = lbm.weights(E)
+OPP = lbm.opposite(E)
+M = lbm.gram_schmidt_basis(E)
+
+def _keep_vector(omega, s_high, dt):
+    """Per-moment keep factor (1 - rate).  The Gram-Schmidt builder orders
+    rows by monomial degree: 0 = rho, 1-3 = momentum (conserved), 4-9 = the
+    six degree-2 (stress) moments relaxing with ``omega``, the rest are
+    higher moments relaxing with ``S_high``."""
+    idx = np.arange(19)
+    return jnp.where(idx < 4, jnp.zeros((), dt),
+                     jnp.where(idx < 10, 1.0 - omega, 1.0 - s_high)
+                     ).astype(dt)
+
+
+def _def():
+    d = family.base_def("d3q19", E, "3D MRT", faces="WE", symmetries="NS")
+    d.add_setting("S_high", default=1.0,
+                  comment="relaxation rate of the higher moments")
+    return d
+
+
+def collide(ctx: NodeCtx, f: jnp.ndarray) -> jnp.ndarray:
+    dt = f.dtype
+    rho = jnp.sum(f, axis=0)
+    u = tuple(jnp.tensordot(jnp.asarray(E[:, a], dt), f, axes=1) / rho
+              for a in range(3))
+    feq = lbm.equilibrium(E, W, rho, u)
+    keep = _keep_vector(ctx.setting("omega"), ctx.setting("S_high"), dt)
+    m_neq = lbm.moments(M, f - feq) * keep.reshape((19,) + (1,) * (f.ndim - 1))
+    g = family.gravity_of(ctx)
+    u2 = tuple(u[a] + g[a] for a in range(3))
+    m_post = m_neq + lbm.moments(M, lbm.equilibrium(E, W, rho, u2))
+    return lbm.from_moments(M, m_post)
+
+
+def run(ctx: NodeCtx) -> jnp.ndarray:
+    f = ctx.group("f")
+    f = family.apply_boundaries(ctx, f, E, W, OPP)
+    family.add_flux_objectives(ctx, f, E)
+    f = jnp.where(ctx.nt_in_group("COLLISION")[None], collide(ctx, f), f)
+    return ctx.store({"f": f})
+
+
+def init(ctx: NodeCtx) -> jnp.ndarray:
+    return family.standard_init(ctx, E, W)
+
+
+def build():
+    return _def().finalize().bind(
+        run=run, init=init,
+        quantities=family.make_getters(E, force_of=family.gravity_of))

@@ -1,0 +1,50 @@
+"""d3q19_les — 3D BGK with Smagorinsky subgrid closure.
+
+Behavioral parity target: reference model ``d3q19_les``
+(reference src/d3q19_les/Dynamics.R, Dynamics.c.Rt).
+"""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+from tclb_tpu.core.lattice import NodeCtx
+from tclb_tpu.models import family
+from tclb_tpu.models.d3q19 import E, OPP, W
+from tclb_tpu.ops import lbm
+
+
+def _def():
+    d = family.base_def("d3q19_les", E, "3D BGK + Smagorinsky LES",
+                        faces="WE", symmetries="NS")
+    d.add_setting("Smag", default=0.16, comment="Smagorinsky constant")
+    return d
+
+
+def run(ctx: NodeCtx) -> jnp.ndarray:
+    f = ctx.group("f")
+    f = family.apply_boundaries(ctx, f, E, W, OPP)
+    family.add_flux_objectives(ctx, f, E)
+    dt = f.dtype
+    rho = jnp.sum(f, axis=0)
+    u = tuple(jnp.tensordot(jnp.asarray(E[:, a], dt), f, axes=1) / rho
+              for a in range(3))
+    feq = lbm.equilibrium(E, W, rho, u)
+    om_eff = lbm.smagorinsky_omega(E, f, feq, rho, ctx.setting("omega"),
+                                   ctx.setting("Smag"))
+    fc = f + om_eff[None] * (feq - f)
+    g = family.gravity_of(ctx)
+    u2 = tuple(u[a] + g[a] for a in range(3))
+    fc = fc + (lbm.equilibrium(E, W, rho, u2) - feq)
+    f = jnp.where(ctx.nt_in_group("COLLISION")[None], fc, f)
+    return ctx.store({"f": f})
+
+
+def init(ctx: NodeCtx) -> jnp.ndarray:
+    return family.standard_init(ctx, E, W)
+
+
+def build():
+    return _def().finalize().bind(
+        run=run, init=init,
+        quantities=family.make_getters(E, force_of=family.gravity_of))

@@ -1,0 +1,80 @@
+"""d3q27_BGK and d3q27_BGK_galcor — 3D 27-velocity BGK, optionally with the
+third-order (Galilean-invariance) equilibrium correction.
+
+Behavioral parity targets: reference models ``d3q27_BGK`` and
+``d3q27_BGK_galcor`` (reference src/d3q27_BGK/Dynamics.R,
+src/d3q27_BGK_galcor — hand-written C).  The "galcor" variant extends the
+equilibrium with the third-order Hermite term
+``(e.u)^3/(6 cs^6) - (e.u) u^2/(2 cs^4)``, removing the cubic
+Galilean-invariance defect of the standard second-order equilibrium.
+"""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+from tclb_tpu.core.lattice import NodeCtx
+from tclb_tpu.models import family
+from tclb_tpu.ops import cumulant, lbm
+
+E = cumulant.velocity_set(3)
+W = lbm.weights(E)
+OPP = lbm.opposite(E)
+CS2 = lbm.CS2
+
+
+def _equilibrium(rho, u, galcor: bool):
+    dt = rho.dtype
+    usq = sum(c * c for c in u)
+    out = []
+    for i in range(27):
+        eu = sum(float(E[i, a]) * u[a] for a in range(3) if E[i, a])
+        if isinstance(eu, int):
+            common = 1.0 - usq / (2 * CS2)
+        else:
+            common = (1.0 + eu / CS2 + eu * eu / (2 * CS2 * CS2)
+                      - usq / (2 * CS2))
+            if galcor:
+                common = common + (eu * eu * eu / (6 * CS2 ** 3)
+                                   - eu * usq / (2 * CS2 * CS2))
+        out.append(jnp.asarray(float(W[i]), dt) * rho * common)
+    return jnp.stack(out)
+
+
+def _make(name: str, galcor: bool):
+    def _def():
+        return family.base_def(name, E,
+                               "3D BGK" + (" + Galilean correction"
+                                           if galcor else ""),
+                               faces="WE", symmetries="NS")
+
+    def run(ctx: NodeCtx) -> jnp.ndarray:
+        f = ctx.group("f")
+        f = family.apply_boundaries(ctx, f, E, W, OPP)
+        family.add_flux_objectives(ctx, f, E)
+        dt = f.dtype
+        rho = jnp.sum(f, axis=0)
+        u = tuple(jnp.tensordot(jnp.asarray(E[:, a], dt), f, axes=1) / rho
+                  for a in range(3))
+        om = ctx.setting("omega")
+        feq = _equilibrium(rho, u, galcor)
+        fc = f + om * (feq - f)
+        g = family.gravity_of(ctx)
+        u2 = tuple(u[a] + g[a] for a in range(3))
+        fc = fc + (_equilibrium(rho, u2, galcor) - feq)
+        f = jnp.where(ctx.nt_in_group("COLLISION")[None], fc, f)
+        return ctx.store({"f": f})
+
+    def init(ctx: NodeCtx) -> jnp.ndarray:
+        return family.standard_init(ctx, E, W)
+
+    def build():
+        return _def().finalize().bind(
+            run=run, init=init,
+            quantities=family.make_getters(E, force_of=family.gravity_of))
+
+    return build
+
+
+build = _make("d3q27_BGK", galcor=False)
+build_galcor = _make("d3q27_BGK_galcor", galcor=True)

@@ -1,0 +1,128 @@
+"""d3q27_cumulant — the flagship 3D cumulant model (forced-channel
+benchmark family).
+
+Behavioral parity target: reference model ``d3q27_cumulant``
+(reference src/d3q27_cumulant/Dynamics.R, Dynamics.c.Rt): Geier-style
+cumulant collision, zonal Velocity/Pressure/Turbulence, ForceX/Y/Z body
+force, N/S symmetry + velocity/pressure faces, a turbulent-inlet node type
+fed by the synthetic-turbulence coupling densities ``SynthT{X,Y,Z}``
+(src/d3q27_cumulant/Dynamics.R:41-43), volume-flux global, and running
+averages of velocity/pressure (``average=True`` densities,
+src/d3q27_cumulant/Dynamics.R:54-60).
+"""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+from tclb_tpu.core.lattice import NodeCtx
+from tclb_tpu.models import family
+from tclb_tpu.ops import cumulant, lbm
+
+E = cumulant.velocity_set(3)
+W = lbm.weights(E)
+OPP = lbm.opposite(E)
+
+
+def _def():
+    d = family.base_def("d3q27_cumulant", E, "3D cumulant collision",
+                        faces="WENS", symmetries="NS", objectives=False)
+    d.add_setting("nubuffer", default=0.01,
+                  comment="viscosity in the buffer layer")
+    d.add_setting("Turbulence", default=0.0, zonal=True,
+                  comment="inlet turbulence intensity")
+    d.add_setting("GalileanCorrection", default=1.0,
+                  comment="Galilean correction term")
+    d.add_setting("omega_bulk", default=1.0)
+    for ax in ("X", "Y", "Z"):
+        d.add_setting(f"Force{ax}")
+    d.add_global("Flux", unit="m3/s", comment="volume flux")
+    d.add_node_type("WVelocityTurbulent", "BOUNDARY")
+    d.add_node_type("Buffer", "ADDITIONALS")
+    # synthetic-turbulence coupling buffers (filled by the
+    # SyntheticTurbulence handler each iteration)
+    d.add_density("SynthTX", group="SynthT")
+    d.add_density("SynthTY", group="SynthT")
+    d.add_density("SynthTZ", group="SynthT")
+    d.add_quantity("P", unit="Pa")
+    # averaged fields (running averages via the <Average> machinery)
+    d.add_density("avgP", group="avg", average=True)
+    d.add_density("avgUX", group="avgU", average=True)
+    d.add_density("avgUY", group="avgU", average=True)
+    d.add_density("avgUZ", group="avgU", average=True)
+    d.add_quantity("avgU", unit="m/s", vector=True)
+    d.add_quantity("averageP", unit="Pa")
+    return d
+
+
+def _force(ctx: NodeCtx):
+    return tuple(ctx.setting(f"Force{ax}") + g for ax, g in
+                 zip(("X", "Y", "Z"), family.gravity_of(ctx)))
+
+
+def run(ctx: NodeCtx) -> jnp.ndarray:
+    f = ctx.group("f")
+    dt = f.dtype
+    vel = ctx.setting("Velocity")
+    # turbulent inlet: mean + synthetic fluctuation from the coupling
+    # buffers scaled by the zonal Turbulence intensity
+    # (reference WVelocityTurbulent, src/d3q27_cumulant/Dynamics.c.Rt)
+    turb_u = vel + ctx.setting("Turbulence") * ctx.density("SynthTX")
+    extra = {
+        "WVelocityTurbulent": lambda f: lbm.nebb_boundary(
+            E, W, OPP, f, 0, +1, "velocity", turb_u),
+    }
+    f = family.apply_boundaries(ctx, f, E, W, OPP, extra=extra)
+
+    shape = f.shape[1:]
+    # buffer layer runs at nubuffer viscosity (sponge), the bulk at nu
+    om_bulk_visc = ctx.setting("omega")
+    om_buffer = 1.0 / (3.0 * ctx.setting("nubuffer") + 0.5)
+    om = jnp.where(ctx.nt_is("Buffer"), om_buffer, om_bulk_visc).astype(dt)
+    F = f.reshape((3, 3, 3) + shape)
+    Fp, rho, (ux, uy, uz) = cumulant.collide_d3q27(
+        F, om, ctx.setting("omega_bulk"), force=_force(ctx),
+        correlated=True)
+    coll = ctx.nt_in_group("COLLISION")
+    f = jnp.where(coll[None], Fp.reshape((27,) + shape), f)
+    ctx.add_global("Flux", ux, where=coll)
+
+    # running averages accumulate per step; <Average> resets and rescales
+    # (reference average=T densities, src/conf.R + Lattice::resetAverage)
+    avg = jnp.stack([ux, uy, uz])
+    return ctx.store({
+        "f": f,
+        "avg": ((rho - 1.0) / 3.0)[None] + ctx.group("avg"),
+        "avgU": avg + ctx.group("avgU"),
+    })
+
+
+def init(ctx: NodeCtx) -> jnp.ndarray:
+    shape = ctx.flags.shape
+    dt = ctx._fields.dtype
+    z = jnp.zeros((1,) + shape, dt)
+    return family.standard_init(
+        ctx, E, W, extra={"SynthT": jnp.zeros((3,) + shape, dt),
+                          "avg": z, "avgU": jnp.zeros((3,) + shape, dt)})
+
+
+def get_p(ctx: NodeCtx) -> jnp.ndarray:
+    return (jnp.sum(ctx.group("f"), axis=0) - 1.0) / 3.0
+
+
+def get_avg_u(ctx: NodeCtx) -> jnp.ndarray:
+    n = jnp.maximum(ctx.iteration.astype(ctx._fields.dtype)
+                    if hasattr(ctx.iteration, "astype") else 1.0, 1.0)
+    return ctx.group("avgU") / n
+
+
+def get_avg_p(ctx: NodeCtx) -> jnp.ndarray:
+    n = jnp.maximum(ctx.iteration.astype(ctx._fields.dtype)
+                    if hasattr(ctx.iteration, "astype") else 1.0, 1.0)
+    return ctx.density("avgP") / n
+
+
+def build():
+    q = family.make_getters(E, force_of=_force)
+    q.update({"P": get_p, "avgU": get_avg_u, "averageP": get_avg_p})
+    return _def().finalize().bind(run=run, init=init, quantities=q)

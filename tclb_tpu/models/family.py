@@ -1,0 +1,186 @@
+"""Shared model-building blocks for the standard hydrodynamic families.
+
+The reference repeats the same structure in every ``src/<model>/Dynamics.R``
++ ``Dynamics.c.Rt`` pair: f-densities over a velocity set, Rho/U getters,
+Velocity/Pressure(Density) zonal settings, a boundary ``switch`` with
+bounce-back / Zou-He faces / symmetry mirrors, then a collision.  Here that
+skeleton is data: :func:`base_def` declares the common registry entries and
+:func:`apply_boundaries` builds the mask-dispatch from whatever boundary
+node types the model declares (reference boundary library,
+src/lib/boundary.R).
+"""
+
+from __future__ import annotations
+
+from typing import Callable, Optional
+
+import numpy as np
+import jax.numpy as jnp
+
+from tclb_tpu.core.lattice import NodeCtx
+from tclb_tpu.core.registry import ModelDef
+from tclb_tpu.ops import lbm
+
+# face name -> (E-column axis, side): side +1 = fluid lies toward +axis
+FACES = {
+    "W": (0, +1), "E": (0, -1),
+    "S": (1, +1), "N": (1, -1),
+    "B": (2, +1), "T": (2, -1),
+}
+
+
+def mirror_perm(E: np.ndarray, axis: int) -> np.ndarray:
+    """Population permutation mirroring velocity component ``axis``."""
+    Em = E.copy()
+    Em[:, axis] = -Em[:, axis]
+    perm = np.zeros(len(E), dtype=np.int32)
+    for i, e in enumerate(Em):
+        (j,) = np.where((E == e).all(axis=1))
+        perm[i] = j[0]
+    return perm
+
+
+def base_def(name: str, E: np.ndarray, description: str = "",
+             faces: str = "WE", symmetries: str = "",
+             objectives: bool = True) -> ModelDef:
+    """Common registry skeleton: f densities, Rho/U quantities,
+    nu/Velocity/Density settings, gravity, in/outlet flux objectives.
+
+    ``faces`` lists the boundary faces with Velocity/Pressure BCs
+    ('W','E','N','S','T','B'); W/E (x faces) reuse the reference's default
+    node types, others add <F>Velocity/<F>Pressure types (reference
+    d3q27_cumulant adds NVelocity etc., src/d3q27_cumulant/Dynamics.R:34-37).
+    ``symmetries`` adds <F>Symmetry mirror types.
+    """
+    ndim = E.shape[1]
+    d = ModelDef(name, ndim=ndim, description=description or name)
+    d.add_densities("f", E)
+    d.add_quantity("Rho", unit="kg/m3")
+    d.add_quantity("U", unit="m/s", vector=True)
+    d.add_setting("nu", default=1 / 6, comment="viscosity",
+                  derived={"omega": lambda nu: 1.0 / (3 * nu + 0.5)})
+    d.add_setting("omega", default=1.0, comment="one over relaxation time")
+    d.add_setting("Velocity", default=0.0, zonal=True,
+                  comment="inlet/outlet/init velocity")
+    d.add_setting("Density", default=1.0, zonal=True,
+                  comment="inlet/outlet/init density")
+    for ax in ("X", "Y", "Z")[:ndim]:
+        d.add_setting(f"Gravitation{ax}")
+    if objectives:
+        d.add_global("PressureLoss", unit="1mPa")
+        d.add_global("OutletFlux", unit="1m2/s")
+        d.add_global("InletFlux", unit="1m2/s")
+    for face in faces:
+        if face not in "WE":   # WVelocity/EPressure/... are defaults
+            d.add_node_type(f"{face}Velocity", "BOUNDARY")
+            d.add_node_type(f"{face}Pressure", "BOUNDARY")
+    for face in symmetries:
+        d.add_node_type(f"{face}Symmetry", "BOUNDARY")
+    return d
+
+
+def apply_boundaries(ctx: NodeCtx, f: jnp.ndarray, E: np.ndarray,
+                     W: np.ndarray, OPP: np.ndarray,
+                     extra: Optional[dict] = None) -> jnp.ndarray:
+    """Mask-dispatch every boundary node type the model declares:
+    Wall/Solid bounce-back, <F>Velocity / <F>Pressure faces via
+    non-equilibrium bounce-back, <F>Symmetry mirrors (the reference's
+    per-node boundary switch, e.g. src/d2q9/Dynamics.c.Rt:121-150)."""
+    vel = ctx.setting("Velocity")
+    den = ctx.setting("Density")
+    cases: dict = {("Wall", "Solid"): lambda f: f[jnp.asarray(OPP)]}
+    known = ctx.model.node_types
+    for face, (axis, side) in FACES.items():
+        if axis >= E.shape[1]:
+            continue
+        vname, pname = f"{face}Velocity", f"{face}Pressure"
+        if vname in known:
+            cases[vname] = (lambda f, a=axis, s=side:
+                            lbm.nebb_boundary(E, W, OPP, f, a, s,
+                                              "velocity", vel * s))
+        if pname in known:
+            cases[pname] = (lambda f, a=axis, s=side:
+                            lbm.nebb_boundary(E, W, OPP, f, a, s,
+                                              "pressure", den))
+        sname = f"{face}Symmetry"
+        if sname in known:
+            perm = mirror_perm(E, axis)
+            cases[sname] = lambda f, p=perm: f[jnp.asarray(p)]
+    # legacy d2q9 names for y-mirrors
+    for nm, axis in (("TopSymmetry", 1), ("BottomSymmetry", 1)):
+        if nm in known and axis < E.shape[1]:
+            perm = mirror_perm(E, axis)
+            cases[nm] = lambda f, p=perm: f[jnp.asarray(p)]
+    if extra:
+        cases.update(extra)
+    return ctx.boundary_case(f, cases)
+
+
+def add_flux_objectives(ctx: NodeCtx, f: jnp.ndarray, E: np.ndarray) -> None:
+    """Inlet/Outlet flux + pressure-loss globals on OBJECTIVE-tagged nodes
+    (reference src/d2q9/Dynamics.c.Rt:250-270)."""
+    if "OutletFlux" not in ctx.model.global_index:
+        return
+    dt = f.dtype
+    rho = jnp.sum(f, axis=0)
+    ux = jnp.tensordot(jnp.asarray(E[:, 0], dt), f, axes=1) / rho
+    uy = jnp.tensordot(jnp.asarray(E[:, 1], dt), f, axes=1) / rho
+    usq = ux * ux + uy * uy
+    if E.shape[1] == 3:
+        uz = jnp.tensordot(jnp.asarray(E[:, 2], dt), f, axes=1) / rho
+        usq = usq + uz * uz
+    coll = ctx.nt_in_group("COLLISION")
+    ploss = ux / rho * ((rho - 1.0) / 3.0 + usq / rho * 0.5)
+    ctx.add_global("OutletFlux", ux / rho, where=ctx.nt_is("Outlet") & coll)
+    ctx.add_global("InletFlux", ux / rho, where=ctx.nt_is("Inlet") & coll)
+    ctx.add_global("PressureLoss",
+                   jnp.where(ctx.nt_is("Inlet"), ploss, -ploss),
+                   where=(ctx.nt_is("Inlet") | ctx.nt_is("Outlet")) & coll)
+
+
+def standard_init(ctx: NodeCtx, E: np.ndarray, W: np.ndarray,
+                  extra: Optional[dict] = None) -> jnp.ndarray:
+    """Equilibrium init from the zonal Density/Velocity settings (the common
+    ``Init()`` of the reference models)."""
+    shape = ctx.flags.shape
+    dt = ctx._fields.dtype
+    ndim = E.shape[1]
+    rho = jnp.broadcast_to(ctx.setting("Density"), shape).astype(dt)
+    ux = jnp.broadcast_to(ctx.setting("Velocity"), shape).astype(dt)
+    u = (ux,) + tuple(jnp.zeros(shape, dt) for _ in range(ndim - 1))
+    f = lbm.equilibrium(E, W, rho, u)
+    groups = {"f": f}
+    if extra:
+        groups.update(extra)
+    return ctx.store(groups)
+
+
+def make_getters(E: np.ndarray, force_of=None) -> dict[str, Callable]:
+    """Rho and U quantity getters; ``force_of(ctx)`` (acceleration tuple)
+    shifts measured U by half the force (reference convention,
+    src/d2q9/Dynamics.c.Rt:43-49)."""
+
+    def get_rho(ctx: NodeCtx) -> jnp.ndarray:
+        return jnp.sum(ctx.group("f"), axis=0)
+
+    def get_u(ctx: NodeCtx) -> jnp.ndarray:
+        f = ctx.group("f")
+        dt = f.dtype
+        rho = jnp.sum(f, axis=0)
+        comps = [jnp.tensordot(jnp.asarray(E[:, a], dt), f, axes=1) / rho
+                 for a in range(E.shape[1])]
+        if force_of is not None:
+            frc = force_of(ctx)
+            comps = [c + 0.5 * g for c, g in zip(comps, frc)]
+        while len(comps) < 3:
+            comps.append(jnp.zeros_like(comps[0]))
+        return jnp.stack(comps)
+
+    return {"Rho": get_rho, "U": get_u}
+
+
+def gravity_of(ctx: NodeCtx):
+    """Acceleration tuple from the Gravitation* settings."""
+    names = [f"Gravitation{a}" for a in ("X", "Y", "Z")]
+    return tuple(ctx.setting(n) for n in names
+                 if n in ctx.model.setting_index)

@@ -1,0 +1,148 @@
+"""sw — shallow-water equations on d2q9 with adjoint energy optimization.
+
+Behavioral parity target: reference model ``sw``
+(reference src/sw/Dynamics.R, Dynamics.c.Rt): MRT whose equilibrium energy
+moments carry the shallow-water pressure ``g h^2`` terms
+(src/sw/Dynamics.c.Rt:228-241), a ``w`` design field damping momentum
+(energy extraction), and EnergyGain/TotalDiff/Material objectives on Obj1
+nodes — the reference's wave-energy-harvesting optimization case.
+"""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+from tclb_tpu.core.lattice import NodeCtx
+from tclb_tpu.core.registry import ModelDef
+from tclb_tpu.models.d2q9 import E, M, OPP, _zou_he_x
+from tclb_tpu.ops import lbm
+
+W = lbm.weights(E)
+
+
+def _def() -> ModelDef:
+    d = ModelDef("sw", ndim=2, description="Shallow water equation")
+    d.add_densities("f", E)
+    d.add_density("w", group="w", parameter=True)
+    d.add_quantity("Rho", unit="m")
+    d.add_quantity("U", unit="m/s", vector=True)
+    d.add_quantity("RhoB", adjoint=True)
+    d.add_quantity("UB", adjoint=True, vector=True)
+    d.add_quantity("W")
+    d.add_quantity("WB", adjoint=True)
+    d.add_setting("omega", default=1.0,
+                  comment="one over relaxation time")
+    d.add_setting("nu", default=1 / 6, comment="viscosity",
+                  derived={"omega": lambda nu: 1.0 / (3 * nu + 0.5),
+                           "S8": lambda nu: 1.0 / (3 * nu + 0.5),
+                           "S9": lambda nu: 1.0 / (3 * nu + 0.5)})
+    d.add_setting("InletVelocity")
+    d.add_setting("InletPressure", default=0.0,
+                  derived={"InletDensity": lambda p: 1.0 + p / 3.0})
+    d.add_setting("InletDensity", default=1.0)
+    d.add_setting("Gravity", default=1.0)
+    d.add_setting("SolidH", default=1.0)
+    d.add_setting("EnergySink", default=0.0)
+    d.add_setting("Height", default=0.0, zonal=True)
+    # relaxation rates of the non-conserved moments (e, eps, qx, qy, pxx,
+    # pxy) — reference S2..S9 (src/sw/Dynamics.c.Rt:206-248)
+    for nm in ("S2", "S3", "S5", "S7"):
+        d.add_setting(nm, default=1.0)
+    d.add_setting("S8", default=1.0)
+    d.add_setting("S9", default=1.0)
+    d.add_global("PressDiff")
+    d.add_global("TotalDiff", comment="total variation of velocity")
+    d.add_global("Material", comment="total material")
+    d.add_global("EnergyGain")
+    d.add_node_type("Obj1", "OBJECTIVE")
+    return d
+
+
+def _eq_moments(dd, jx, jy, g):
+    """Shallow-water equilibrium moments in the (rho, jx, jy, e, eps, qx,
+    qy, pxx, pxy) basis (reference Req, src/sw/Dynamics.c.Rt:228-241)."""
+    inv = 1.0 / dd
+    usq = (jx * jx + jy * jy) * inv
+    return [dd, jx, jy,
+            -4.0 * dd + 3.0 * usq + 3.0 * dd * dd * g,
+            4.0 * dd - 3.0 * usq - 4.5 * dd * dd * g,
+            -jx, -jy,
+            (jx * jx - jy * jy) * inv,
+            jx * jy * inv]
+
+
+def run(ctx: NodeCtx) -> jnp.ndarray:
+    f = ctx.group("f")
+    w = ctx.density("w")
+    dt = f.dtype
+    vel = ctx.setting("InletVelocity")
+    den = ctx.setting("InletDensity")
+    f = ctx.boundary_case(f, {
+        "Wall": lambda f: f[jnp.asarray(OPP)],
+        "EVelocity": lambda f: _zou_he_x(f, vel, "velocity", "E"),
+        "WPressure": lambda f: _zou_he_x(f, den, "pressure", "W"),
+        "WVelocity": lambda f: _zou_he_x(f, vel, "velocity", "W"),
+        "EPressure": lambda f: _zou_he_x(f, den, "pressure", "E"),
+    })
+    g = ctx.setting("Gravity")
+    m = lbm.moments(M, f)
+    dd, jx, jy = m[0], m[1], m[2]
+    rates = jnp.stack([jnp.zeros((), dt), jnp.zeros((), dt),
+                       jnp.zeros((), dt),
+                       ctx.setting("S2"), ctx.setting("S3"),
+                       ctx.setting("S5"), ctx.setting("S7"),
+                       ctx.setting("S8"), ctx.setting("S9")]).astype(dt)
+    req = _eq_moments(dd, jx, jy, g)
+    # keep (1-S) of the non-equilibrium part
+    m_rel = [m[i] if i < 3 else
+             (1.0 - rates[i]) * (m[i] - req[i])
+             for i in range(9)]
+    obj = ctx.nt_is("Obj1")
+    ctx.add_global("TotalDiff", jx * jx + jy * jy, where=obj)
+    pre = jx * jx + jy * jy
+    # momentum damping by the design field = energy extraction
+    jx2, jy2 = jx * w, jy * w
+    ctx.add_global("EnergyGain", pre - (jx2 * jx2 + jy2 * jy2), where=obj)
+    ctx.add_global("Material", w)
+    req2 = _eq_moments(dd, jx2, jy2, g)
+    m_post = jnp.stack([(dd, jx2, jy2)[i] if i < 3
+                        else m_rel[i] + req2[i]
+                        for i in range(9)])
+    fc = lbm.from_moments(M, m_post)
+    f = jnp.where(ctx.nt_in_group("COLLISION")[None], fc, f)
+    return ctx.store({"f": f})
+
+
+def init(ctx: NodeCtx) -> jnp.ndarray:
+    shape = ctx.flags.shape
+    dt = ctx._fields.dtype
+    h = ctx.setting("Height")
+    dd = jnp.where(h > 0, h, jnp.ones(shape, dt)).astype(dt)
+    dd = jnp.where(ctx.nt_is("Solid"),
+                   jnp.broadcast_to(ctx.setting("SolidH"), shape), dd)
+    ux = jnp.broadcast_to(ctx.setting("InletVelocity"), shape).astype(dt)
+    g = ctx.setting("Gravity")
+    req = _eq_moments(dd, dd * ux, jnp.zeros(shape, dt), g)
+    f = lbm.from_moments(M, jnp.stack(req))
+    w = jnp.where(ctx.nt_is("Solid") | ctx.nt_is("Wall"),
+                  jnp.zeros(shape, dt),
+                  jnp.full(shape, 1.0 - ctx.setting("EnergySink"), dt))
+    return ctx.store({"f": f, "w": w[None]})
+
+
+def get_u(ctx):
+    f = ctx.group("f")
+    dt = f.dtype
+    rho = jnp.sum(f, axis=0)
+    ux = jnp.tensordot(jnp.asarray(E[:, 0], dt), f, axes=1) / rho
+    uy = jnp.tensordot(jnp.asarray(E[:, 1], dt), f, axes=1) / rho
+    return jnp.stack([ux, uy, jnp.zeros_like(ux)])
+
+
+def build():
+    rhoq = lambda c: jnp.sum(c.group("f"), axis=0)   # noqa: E731
+    wq = lambda c: c.density("w")                    # noqa: E731
+    return _def().finalize().bind(
+        run=run, init=init,
+        quantities={"Rho": rhoq, "U": get_u, "W": wq,
+                    "RhoB": rhoq, "UB": get_u, "WB": wq})

@@ -1,0 +1,51 @@
+"""wave — scalar wave equation as a first-order system on Fields.
+
+Behavioral parity target: reference model ``wave``
+(reference src/wave/Dynamics.R — an R-only skeleton with no kernel file:
+``u'' = c (u_xx + u_yy)`` via fields u, v with a +-1 stencil, Dirichlet
+boundary pinning u to the zonal ``Value``).  The reference ships no
+Dynamics.c for this model; this is the natural realization of its registry.
+"""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+from tclb_tpu.core.lattice import NodeCtx
+from tclb_tpu.core.registry import ModelDef
+
+
+def _def() -> ModelDef:
+    d = ModelDef("wave", ndim=2, description="wave equation on fields")
+    d.add_field("u", dx=(-1, 1), dy=(-1, 1))
+    d.add_field("v", dx=(-1, 1), dy=(-1, 1))
+    d.add_quantity("U")
+    d.add_setting("Speed", default=0.1)
+    d.add_setting("Value", default=0.0, zonal=True)
+    d.add_setting("Viscosity", default=0.0)
+    d.add_node_type("Dirichlet", "BOUNDARY")
+    return d
+
+
+def run(ctx: NodeCtx) -> jnp.ndarray:
+    u = ctx.load("u")
+    v = ctx.load("v")
+    lap = (ctx.load("u", 1, 0) + ctx.load("u", -1, 0)
+           + ctx.load("u", 0, 1) + ctx.load("u", 0, -1) - 4.0 * u)
+    v = v + ctx.setting("Speed") * lap - ctx.setting("Viscosity") * v
+    u = u + v
+    u = jnp.where(ctx.nt_is("Dirichlet"), ctx.setting("Value"), u)
+    v = jnp.where(ctx.nt_is("Dirichlet"), jnp.zeros_like(v), v)
+    return {"u": u, "v": v}
+
+
+def init(ctx: NodeCtx):
+    shape = ctx.flags.shape
+    dt = ctx._fields.dtype
+    u = jnp.broadcast_to(ctx.setting("Value"), shape).astype(dt)
+    return {"u": u, "v": jnp.zeros(shape, dt)}
+
+
+def build():
+    return _def().finalize().bind(
+        run=run, init=init, quantities={"U": lambda c: c.load("u")})

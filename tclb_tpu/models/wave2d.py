@@ -1,0 +1,73 @@
+"""wave2d — 2D scalar wave equation with adjoint support.
+
+Behavioral parity target: reference model ``wave2d``
+(reference src/wave2d/Dynamics.R, Dynamics.c.Rt): a finite-difference wave
+equation carried on the lattice machinery — four streamed copies
+``h1..h4`` of the height deliver the 5-point Laplacian, ``u`` is the time
+derivative, ``w`` masks the domain (0 at walls), ``Loss`` damps.  Obj1
+nodes accumulate the squared Laplacian (TotalDiff objective,
+src/wave2d/Dynamics.c.Rt:59-66).
+"""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+from tclb_tpu.core.lattice import NodeCtx
+from tclb_tpu.core.registry import ModelDef
+
+
+def _def() -> ModelDef:
+    d = ModelDef("wave2d", ndim=2, description="2D wave equation")
+    d.add_density("h", group="state")
+    d.add_density("u", group="state")
+    d.add_density("h1", dx=1, dy=0, group="hn")
+    d.add_density("h2", dx=0, dy=1, group="hn")
+    d.add_density("h3", dx=-1, dy=0, group="hn")
+    d.add_density("h4", dx=0, dy=-1, group="hn")
+    d.add_density("w", group="w", parameter=True)
+    d.add_quantity("H")
+    d.add_quantity("W")
+    d.add_quantity("WB", adjoint=True)
+    d.add_quantity("HB", adjoint=True)
+    d.add_setting("WaveK", default=0.1, comment="wave speed coefficient")
+    d.add_setting("SolidH", default=0.0, comment="H of solid nodes")
+    d.add_setting("Loss", default=1.0, comment="u multiplier")
+    d.add_global("TotalDiff", comment="total diff")
+    d.add_node_type("Obj1", "OBJECTIVE")
+    return d
+
+
+def run(ctx: NodeCtx) -> jnp.ndarray:
+    h = ctx.density("h")
+    u = ctx.density("u")
+    w = ctx.density("w")
+    h1, h2 = ctx.density("h1"), ctx.density("h2")
+    h3, h4 = ctx.density("h3"), ctx.density("h4")
+    du = h1 + h2 + h3 + h4 - 4.0 * h
+    ctx.add_global("TotalDiff", du * du, where=ctx.nt_is("Obj1"))
+    u = u + du * ctx.setting("WaveK")
+    h = (h + u) * w
+    u = u * ctx.setting("Loss")
+    hn = jnp.stack([h, h, h, h])
+    return ctx.store({"state": jnp.stack([h, u]), "hn": hn, "w": w[None]})
+
+
+def init(ctx: NodeCtx) -> jnp.ndarray:
+    shape = ctx.flags.shape
+    dt = ctx._fields.dtype
+    w = jnp.where(ctx.nt_is("Wall"), 0.0, 1.0).astype(dt)
+    h = jnp.where(ctx.nt_is("Solid"),
+                  jnp.broadcast_to(ctx.setting("SolidH"), shape),
+                  jnp.zeros(shape, dt)).astype(dt)
+    z = jnp.zeros(shape, dt)
+    return ctx.store({"state": jnp.stack([h, z]),
+                      "hn": jnp.stack([h, h, h, h]), "w": w[None]})
+
+
+def build():
+    hq = lambda c: c.density("h")        # noqa: E731
+    wq = lambda c: c.density("w")        # noqa: E731
+    return _def().finalize().bind(
+        run=run, init=init,
+        quantities={"H": hq, "W": wq, "HB": hq, "WB": wq})

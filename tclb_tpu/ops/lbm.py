@@ -7,6 +7,8 @@ with numpy (constants) + jnp (traced), and let XLA do the fusing.
 
 from __future__ import annotations
 
+import math
+
 import numpy as np
 import jax.numpy as jnp
 
@@ -78,6 +80,154 @@ def mrt_basis_d2q9(E: np.ndarray) -> np.ndarray:
     g = M @ M.T
     assert np.allclose(g - np.diag(np.diag(g)), 0.0), "basis not orthogonal"
     return M
+
+
+def d3q19_velocities() -> np.ndarray:
+    """Standard 19-velocity set: rest, 6 axis, 12 edge vectors (reference
+    src/lib/d3q19.R ordering is its own; ours is shell-ordered)."""
+    E = [(0, 0, 0)]
+    for a in range(3):
+        for s in (1, -1):
+            v = [0, 0, 0]
+            v[a] = s
+            E.append(tuple(v))
+    for a in range(3):
+        for b in range(a + 1, 3):
+            for sa in (1, -1):
+                for sb in (1, -1):
+                    v = [0, 0, 0]
+                    v[a], v[b] = sa, sb
+                    E.append(tuple(v))
+    return np.array(E, dtype=np.int32)
+
+
+def d3q27_velocities() -> np.ndarray:
+    """Tensor-product 27-velocity set (cumulant reshape order)."""
+    from tclb_tpu.ops.cumulant import velocity_set
+    return velocity_set(3)
+
+
+def gram_schmidt_basis(E: np.ndarray) -> np.ndarray:
+    """Orthogonal moment basis over a velocity set by Gram-Schmidt on the
+    monomials 1, ex, ey[, ez], exey, ... in graded order — the numerical
+    equivalent of the reference's symbolically-built MRT bases
+    (src/lib/feq.R MRT_polyMatrix).  Rows ordered by total degree; the
+    first 1+d rows are the conserved (rho, j) moments."""
+    q, d = E.shape
+    polys = []
+    degs = []
+    for total in range(0, 3 * d + 1):
+        for px in range(total + 1):
+            for py in range(total - px + 1):
+                pz = total - px - py
+                if d == 2 and pz:
+                    continue
+                p = (px, py) if d == 2 else (px, py, pz)
+                if max(p) > 2:   # velocities in {-1,0,1}: e^3 == e
+                    continue
+                polys.append(p)
+                degs.append(total)
+    cols = []
+    M = []
+    for p in polys:
+        row = np.ones(q)
+        for a, pw in enumerate(p):
+            row = row * E[:, a].astype(np.float64) ** pw
+        # orthogonalize against accepted rows
+        for r in M:
+            row = row - r * (row @ r) / (r @ r)
+        if (np.abs(row) > 1e-9).any():
+            M.append(row)
+            cols.append(p)
+        if len(M) == q:
+            break
+    assert len(M) == q, f"basis incomplete: {len(M)}/{q}"
+    return np.stack(M)
+
+
+def bgk_collide(E: np.ndarray, W: np.ndarray, f: jnp.ndarray, omega,
+                force=None, rho_u=None):
+    """Plain BGK with optional velocity-shift (exact-difference) forcing.
+    Returns (f', rho, u-tuple)."""
+    dt = f.dtype
+    rho = jnp.sum(f, axis=0)
+    d = E.shape[1]
+    u = tuple(jnp.tensordot(jnp.asarray(E[:, a], dt), f, axes=1) / rho
+              for a in range(d))
+    feq = equilibrium(E, W, rho, u)
+    out = f + omega * (feq - f)
+    if force is not None:
+        u2 = tuple(u[a] + force[a] for a in range(d))
+        out = out + (equilibrium(E, W, rho, u2) - feq)
+    return out, rho, u
+
+
+def nebb_boundary(E: np.ndarray, W: np.ndarray, OPP: np.ndarray,
+                  f: jnp.ndarray, axis: int, side: int, kind: str, value):
+    """Generic straight-wall velocity/pressure boundary by non-equilibrium
+    bounce-back (Zou & He's closure generalized to any face/velocity set —
+    the role of the reference's per-model ZouHe() template,
+    src/lib/boundary.R).
+
+    ``axis``: face normal axis (0=x, 1=y, 2=z); ``side``: +1 if the fluid
+    lies in +axis direction (a "low" face), -1 for a "high" face;
+    ``kind``: 'velocity' (``value`` = normal velocity, positive into the
+    domain) or 'pressure' (``value`` = density).  Unknown populations
+    (e.axis == side) get ``f_opp + 2 w rho (e.u)/cs2`` evaluated for the
+    normal-only velocity — exact mass/momentum closure on straight walls.
+    """
+    dt = f.dtype
+    en = E[:, axis].astype(np.int64)
+    tang = jnp.asarray((en == 0), dt)
+    into = jnp.asarray((en == side), dt)      # unknowns, leaving the wall
+    outof = jnp.asarray((en == -side), dt)    # known, entering the wall
+    nd = f.ndim - 1
+    sh = (len(E),) + (1,) * nd
+    s_t = jnp.sum(tang.reshape(sh) * f, axis=0)
+    s_o = jnp.sum(outof.reshape(sh) * f, axis=0)
+    if kind == "velocity":
+        # value is the signed +axis velocity component at the wall
+        un = value
+        rho = (s_t + 2.0 * s_o) / (1.0 - side * un)
+    else:
+        rho = value
+        un = side * (1.0 - (s_t + 2.0 * s_o) / rho)
+    # non-equilibrium bounce-back: f_i = f_opp(i) + 6 w_i rho e_i.u
+    eu = jnp.asarray(en, dt).reshape(sh) * un
+    corr = 6.0 * jnp.asarray(W, dt).reshape(sh) * rho * eu
+    f_bb = f[jnp.asarray(OPP)]
+    return jnp.where(jnp.asarray(en == side).reshape(sh), f_bb + corr, f)
+
+
+def smagorinsky_omega(E: np.ndarray, f: jnp.ndarray, feq: jnp.ndarray,
+                      rho: jnp.ndarray, omega0, smag):
+    """Effective relaxation rate with the Smagorinsky eddy viscosity closed
+    in terms of the non-equilibrium stress (Hou et al.): the reference's
+    LES models compute the same closed form in-kernel
+    (src/d2q9_les/Dynamics.c.Rt, src/d3q19_les).
+
+    tau_eff = (tau0 + sqrt(tau0^2 + 18 sqrt(2) Cs^2 |Pi|/rho)) / 2,
+    with tau0 = 1/omega0 and |Pi| the Frobenius norm of the non-equilibrium
+    momentum flux.  Returns omega_eff = 1/tau_eff.
+    """
+    dt = f.dtype
+    d = E.shape[1]
+    nd = f.ndim - 1
+    sh = (len(E),) + (1,) * nd
+    fneq = f - feq
+    pi2 = None
+    for a in range(d):
+        for b in range(a, d):
+            ee = (E[:, a] * E[:, b]).astype(np.float64)
+            pab = jnp.sum(jnp.asarray(ee, dt).reshape(sh) * fneq, axis=0)
+            term = pab * pab * (1.0 if a == b else 2.0)
+            pi2 = term if pi2 is None else pi2 + term
+    pi_norm = jnp.sqrt(pi2)
+    tau0 = 1.0 / omega0
+    tau_eff = 0.5 * (tau0 + jnp.sqrt(tau0 * tau0
+                                     + 18.0 * math.sqrt(2.0) * smag * smag
+                                     * pi_norm / rho))
+    return 1.0 / tau_eff
 
 
 def moments(M: np.ndarray, f: jnp.ndarray) -> jnp.ndarray:

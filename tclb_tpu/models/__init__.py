@@ -34,6 +34,17 @@ _REGISTRY: dict[str, str] = {
     "sw": "tclb_tpu.models.sw",
     "wave": "tclb_tpu.models.wave",
     "wave2d": "tclb_tpu.models.wave2d",
+    "d2q9_heat_adj": "tclb_tpu.models.d2q9_heat_adj",
+    "d2q9_kuper_adj": "tclb_tpu.models.d2q9_kuper_adj",
+    "d2q9_plate": "tclb_tpu.models.d2q9_plate",
+    "d2q9_optimalMixing": "tclb_tpu.models.d2q9_optimal_mixing",
+    "d2q9_solid": "tclb_tpu.models.d2q9_solid",
+    "d3q19_adj": "tclb_tpu.models.d3q19_adj",
+    "d3q19_heat": "tclb_tpu.models.d3q19_heat",
+    "d3q19_heat_adj": "tclb_tpu.models.d3q19_heat_adj",
+    "d3q19_heat_adj_art": "tclb_tpu.models.d3q19_heat_adj:build_art",
+    "d3q19_heat_adj_prop": "tclb_tpu.models.d3q19_heat_adj:build_prop",
+    "d3q19_kuper": "tclb_tpu.models.d3q19_kuper",
 }
 
 _CACHE: dict[str, Model] = {}

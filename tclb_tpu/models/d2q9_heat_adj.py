@@ -1,0 +1,127 @@
+"""d2q9_heat_adj — conjugate heat-transfer topology optimization.
+
+Behavioral parity target: reference model ``d2q9_heat_adj``
+(reference src/d2q9_heat_adj/Dynamics.R, Dynamics.c.Rt, ADJOINT=1 — the
+example/heat_adj.xml benchmark): flow + temperature with a design field
+``w``: Brinkman velocity penalization (fluid where w=1) and
+w-interpolated thermal diffusivity between ``FluidAlfa`` and ``SolidAlfa``;
+objectives HeatFlux / HeatSource / Material for heat-exchanger design.
+"""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+from tclb_tpu.core.lattice import NodeCtx
+from tclb_tpu.core.registry import ModelDef
+from tclb_tpu.models.d2q9 import E, OPP, _zou_he_x
+from tclb_tpu.models.d2q9_heat import _t_eq
+from tclb_tpu.ops import lbm
+
+W = lbm.weights(E)
+
+
+def _def() -> ModelDef:
+    d = ModelDef("d2q9_heat_adj", ndim=2,
+                 description="conjugate heat topology optimization")
+    d.add_densities("f", E)
+    d.add_densities("T", E, group="T")
+    d.add_density("w", group="w", parameter=True)
+    d.add_quantity("Rho", unit="kg/m3")
+    d.add_quantity("T", unit="K")
+    d.add_quantity("U", unit="m/s", vector=True)
+    d.add_quantity("W")
+    d.add_quantity("TB", adjoint=True)
+    d.add_quantity("WB", adjoint=True)
+    d.add_setting("omega", default=1.0)
+    d.add_setting("nu", default=1 / 6,
+                  derived={"omega": lambda nu: 1.0 / (3 * nu + 0.5)})
+    d.add_setting("InletVelocity")
+    d.add_setting("InletTemperature", default=1.0)
+    d.add_setting("InitTemperature", default=1.0)
+    d.add_setting("InletDensity", default=1.0)
+    d.add_setting("FluidAlfa", default=0.1)
+    d.add_setting("SolidAlfa", default=0.01)
+    d.add_setting("HeatSource", default=0.0,
+                  comment="volumetric heating of solid (1-w)")
+    d.add_setting("Porocity", default=0.0, zonal=True)
+    d.add_global("HeatFlux")
+    d.add_global("HeatSourceTotal")
+    d.add_global("Material")
+    d.add_global("Drag")
+    return d
+
+
+def run(ctx: NodeCtx) -> jnp.ndarray:
+    f = ctx.group("f")
+    fT = ctx.group("T")
+    w = ctx.density("w")
+    dt = f.dtype
+    vel = ctx.setting("InletVelocity")
+    den = ctx.setting("InletDensity")
+    t_in = ctx.setting("InletTemperature")
+
+    f = ctx.boundary_case(f, {
+        ("Wall", "Solid"): lambda f: f[jnp.asarray(OPP)],
+        "WVelocity": lambda f: _zou_he_x(f, vel, "velocity", "W"),
+        "EPressure": lambda f: _zou_he_x(f, den, "pressure", "E"),
+    })
+    fT = ctx.boundary_case(fT, {
+        ("Wall", "Solid"): lambda t: t[jnp.asarray(OPP)],
+        "WVelocity": lambda t: _t_eq(
+            jnp.broadcast_to(t_in, t.shape[1:]).astype(dt),
+            jnp.zeros(t.shape[1:], dt), jnp.zeros(t.shape[1:], dt)),
+    })
+
+    rho = jnp.sum(f, axis=0)
+    ux = jnp.tensordot(jnp.asarray(E[:, 0], dt), f, axes=1) / rho
+    uy = jnp.tensordot(jnp.asarray(E[:, 1], dt), f, axes=1) / rho
+
+    om = ctx.setting("omega")
+    feq = lbm.equilibrium(E, W, rho, (ux, uy))
+    # Brinkman penalization: velocity scaled by w (solid where w -> 0)
+    ctx.add_global("Drag", (1.0 - w) * jnp.abs(ux),
+                   where=ctx.nt_in_group("COLLISION"))
+    ux2, uy2 = ux * w, uy * w
+    fc = f + om * (feq - f) \
+        + (lbm.equilibrium(E, W, rho, (ux2, uy2)) - feq)
+
+    temp = jnp.sum(fT, axis=0)
+    alfa = ctx.setting("FluidAlfa") * w + ctx.setting("SolidAlfa") * (1.0 - w)
+    om_t = 1.0 / (3.0 * alfa + 0.5)
+    src = ctx.setting("HeatSource") * (1.0 - w)
+    tc = fT + om_t[None] * (_t_eq(temp, ux2, uy2) - fT) \
+        + _t_eq(src, jnp.zeros_like(ux), jnp.zeros_like(uy))
+    coll = ctx.nt_in_group("COLLISION")[None]
+    f = jnp.where(coll, fc, f)
+    fT = jnp.where(coll, tc, fT)
+
+    ctx.add_global("HeatFlux", temp * ux2, where=ctx.nt_is("Outlet"))
+    ctx.add_global("HeatSourceTotal", src,
+                   where=ctx.nt_in_group("COLLISION"))
+    ctx.add_global("Material", 1.0 - w,
+                   where=ctx.nt_in_group("DESIGNSPACE"))
+    return ctx.store({"f": f, "T": fT})
+
+
+def init(ctx: NodeCtx) -> jnp.ndarray:
+    shape = ctx.flags.shape
+    dt = ctx._fields.dtype
+    rho = jnp.ones(shape, dt)
+    ux = jnp.broadcast_to(ctx.setting("InletVelocity"), shape).astype(dt)
+    f = lbm.equilibrium(E, W, rho, (ux, jnp.zeros(shape, dt)))
+    t0 = jnp.broadcast_to(ctx.setting("InitTemperature"), shape).astype(dt)
+    fT = _t_eq(t0, jnp.zeros(shape, dt), jnp.zeros(shape, dt))
+    w = 1.0 - jnp.broadcast_to(ctx.setting("Porocity"), shape).astype(dt)
+    w = jnp.where(ctx.nt_is("Solid"), jnp.zeros_like(w), w)
+    return ctx.store({"f": f, "T": fT, "w": w[None]})
+
+
+def build():
+    tq = lambda c: jnp.sum(c.group("T"), axis=0)    # noqa: E731
+    wq = lambda c: c.density("w")                   # noqa: E731
+    from tclb_tpu.models.d2q9_heat import get_rho, get_u
+    return _def().finalize().bind(
+        run=run, init=init,
+        quantities={"Rho": get_rho, "T": tq, "U": get_u, "W": wq,
+                    "TB": tq, "WB": wq})

@@ -1,0 +1,48 @@
+"""d2q9_kuper_adj — Kupershtokh multiphase with adjoint design.
+
+Behavioral parity target: reference model ``d2q9_kuper_adj``
+(reference src/d2q9_kuper_adj/Dynamics.R, ADJOINT=1, with its eq.R
+derivation data): d2q9_kuper plus a per-node design density ``wd`` scaling
+the local interaction strength — the differentiable handle for
+wetting/phase-distribution optimization.  The whole two-stage step is
+differentiable here, so the Tapenade tape of the reference is unnecessary.
+"""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+from tclb_tpu.core.lattice import NodeCtx
+from tclb_tpu.models import d2q9_kuper
+
+
+def _def():
+    d = d2q9_kuper._def()
+    d.name = "d2q9_kuper_adj"
+    d.description = "Kupershtokh multiphase with design field"
+    d.add_density("wd", group="wd", parameter=True)
+    d.add_quantity("WD")
+    d.add_quantity("WDB", adjoint=True)
+    return d
+
+
+def calc_phi(ctx: NodeCtx):
+    out = d2q9_kuper.calc_phi(ctx)
+    # design field scales the local pseudopotential (interaction strength)
+    return {"phi": out["phi"] * ctx.density("wd")}
+
+
+def init(ctx: NodeCtx) -> jnp.ndarray:
+    out = d2q9_kuper.init(ctx)
+    wd = jnp.ones((1,) + ctx.flags.shape, out.dtype)
+    return out.at[ctx.model.storage_index["wd"]].set(wd[0])
+
+
+def build():
+    wq = lambda c: c.density("wd")        # noqa: E731
+    return _def().finalize().bind(
+        run=d2q9_kuper.run, init=init,
+        stages={"CalcPhi": calc_phi},
+        quantities={"Rho": lambda c: jnp.sum(c.group("f"), axis=0),
+                    "U": d2q9_kuper.get_u, "P": d2q9_kuper.get_p,
+                    "F": d2q9_kuper.get_f, "WD": wq, "WDB": wq})

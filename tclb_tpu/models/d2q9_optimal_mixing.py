@@ -1,0 +1,122 @@
+"""d2q9_optimalMixing — mixing optimization (flow + d2q5 scalar, moving-wall
+control).
+
+Behavioral parity target: reference model ``d2q9_optimalMixing``
+(reference src/d2q9_optimalMixing/Dynamics.R, ADJOINT=1): d2q9 flow with a
+d2q5 advected scalar (temperature), a zonal ``MovingWallVelocity`` control
+(the optimized stirring schedule), and the mixing objectives TotalTempSqr /
+CountCells / NMovingWallForce.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+import jax.numpy as jnp
+
+from tclb_tpu.core.lattice import NodeCtx
+from tclb_tpu.core.registry import ModelDef
+from tclb_tpu.models.d2q9 import E, OPP
+from tclb_tpu.ops import lbm
+
+W = lbm.weights(E)
+# d2q5 for the scalar
+EG = np.array([(0, 0), (1, 0), (-1, 0), (0, 1), (0, -1)], dtype=np.int32)
+WG = lbm.weights(EG)
+OPPG = lbm.opposite(EG)
+
+
+def _def() -> ModelDef:
+    d = ModelDef("d2q9_optimalMixing", ndim=2,
+                 description="mixing optimization with moving-wall control")
+    d.add_densities("f", E)
+    d.add_densities("g", EG, group="g")
+    d.add_quantity("Rho", unit="kg/m3")
+    d.add_quantity("U", unit="m/s", vector=True)
+    d.add_quantity("T", unit="K")
+    d.add_setting("omega", default=1.0)
+    d.add_setting("nu", default=1 / 6,
+                  derived={"omega": lambda nu: 1.0 / (3 * nu + 0.5)})
+    d.add_setting("omegaT", default=1.0)
+    d.add_setting("K", default=1 / 6, comment="thermal diffusivity",
+                  derived={"omegaT": lambda k: 1.0 / (3 * k + 0.5)})
+    d.add_setting("MovingWallVelocity", default=0.0, zonal=True)
+    d.add_setting("Velocity", default=0.0, zonal=True)
+    d.add_setting("Pressure", default=0.0, zonal=True)
+    d.add_setting("Temperature", default=0.0, zonal=True)
+    d.add_global("TotalTempSqr")
+    d.add_global("CountCells")
+    d.add_global("NMovingWallForce")
+    d.add_node_type("MovingWall", "BOUNDARY")
+    return d
+
+
+def _g_eq(T, ux, uy):
+    dt = T.dtype
+    out = []
+    for i in range(5):
+        eu = float(EG[i, 0]) * ux + float(EG[i, 1]) * uy
+        out.append(jnp.asarray(float(WG[i]), dt) * T * (1.0 + 3.0 * eu))
+    return jnp.stack(out)
+
+
+def run(ctx: NodeCtx) -> jnp.ndarray:
+    f = ctx.group("f")
+    g = ctx.group("g")
+    dt = f.dtype
+    mwv = ctx.setting("MovingWallVelocity")
+
+    def moving_wall(f):
+        fb = f[jnp.asarray(OPP)]
+        corr = jnp.stack([
+            6.0 * float(W[i]) * float(E[i, 0]) * mwv
+            * jnp.ones(f.shape[1:], dt) if E[i, 0] else
+            jnp.zeros(f.shape[1:], dt) for i in range(9)])
+        return fb + corr
+
+    f = ctx.boundary_case(f, {
+        ("Wall", "Solid"): lambda f: f[jnp.asarray(OPP)],
+        "MovingWall": moving_wall,
+    })
+    g = ctx.boundary_case(g, {
+        ("Wall", "Solid", "MovingWall"): lambda g: g[jnp.asarray(OPPG)],
+    })
+
+    rho = jnp.sum(f, axis=0)
+    ux = jnp.tensordot(jnp.asarray(E[:, 0], dt), f, axes=1) / rho
+    uy = jnp.tensordot(jnp.asarray(E[:, 1], dt), f, axes=1) / rho
+    fc = f + ctx.setting("omega") * (lbm.equilibrium(E, W, rho, (ux, uy)) - f)
+    temp = jnp.sum(g, axis=0)
+    gc = g + ctx.setting("omegaT") * (_g_eq(temp, ux, uy) - g)
+    coll = ctx.nt_in_group("COLLISION")[None]
+    f = jnp.where(coll, fc, f)
+    g = jnp.where(coll, gc, g)
+
+    # mixing measure: mean-free squared temperature
+    # (reference TotalTempSqr/CountCells)
+    where = ctx.nt_in_group("COLLISION")
+    ctx.add_global("TotalTempSqr", temp * temp, where=where)
+    ctx.add_global("CountCells", jnp.ones_like(temp), where=where)
+    ex = jnp.tensordot(jnp.asarray(E[:, 0], dt), f, axes=1)
+    ctx.add_global("NMovingWallForce", 2.0 * ex * mwv,
+                   where=ctx.nt_is("MovingWall"))
+    return ctx.store({"f": f, "g": g})
+
+
+def init(ctx: NodeCtx) -> jnp.ndarray:
+    shape = ctx.flags.shape
+    dt = ctx._fields.dtype
+    rho = 1.0 + 3.0 * jnp.broadcast_to(ctx.setting("Pressure"),
+                                       shape).astype(dt)
+    ux = jnp.broadcast_to(ctx.setting("Velocity"), shape).astype(dt)
+    f = lbm.equilibrium(E, W, rho, (ux, jnp.zeros(shape, dt)))
+    t0 = jnp.broadcast_to(ctx.setting("Temperature"), shape).astype(dt)
+    g = _g_eq(t0, jnp.zeros(shape, dt), jnp.zeros(shape, dt))
+    return ctx.store({"f": f, "g": g})
+
+
+def build():
+    from tclb_tpu.models.d2q9_heat import get_rho, get_u
+    return _def().finalize().bind(
+        run=run, init=init,
+        quantities={"Rho": get_rho, "U": get_u,
+                    "T": lambda c: jnp.sum(c.group("g"), axis=0)})

@@ -1,0 +1,75 @@
+"""d3q19_adj — 3D topology optimization (porous design field).
+
+Behavioral parity target: reference model ``d3q19_adj``
+(reference src/d3q19_adj/Dynamics.R, ADJOINT=1): the 3D analogue of
+d2q9_adj — design density ``w`` with Brinkman penalization inside the MRT
+collision, Drag/Lift/Material objectives.
+"""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+from tclb_tpu.core.lattice import NodeCtx
+from tclb_tpu.models import family
+from tclb_tpu.models.d3q19 import E, OPP, W, M, _keep_vector
+from tclb_tpu.ops import lbm
+
+
+def _def():
+    d = family.base_def("d3q19_adj", E, "3D porous topology optimization",
+                        faces="WE", symmetries="NS")
+    d.add_density("w", group="w", parameter=True)
+    d.add_setting("S_high", default=1.0)
+    d.add_setting("Porocity", default=0.0, zonal=True)
+    d.add_setting("PorocityGamma", default=0.0)
+    d.add_quantity("W")
+    d.add_quantity("WB", adjoint=True)
+    d.add_global("Drag")
+    d.add_global("Lift")
+    d.add_global("Material")
+    d.add_global("MaterialPenalty")
+    return d
+
+
+def run(ctx: NodeCtx) -> jnp.ndarray:
+    f = ctx.group("f")
+    w = ctx.density("w")
+    dt = f.dtype
+    f = family.apply_boundaries(ctx, f, E, W, OPP)
+    family.add_flux_objectives(ctx, f, E)
+    rho = jnp.sum(f, axis=0)
+    u = tuple(jnp.tensordot(jnp.asarray(E[:, a], dt), f, axes=1) / rho
+              for a in range(3))
+    feq = lbm.equilibrium(E, W, rho, u)
+    keep = _keep_vector(ctx.setting("omega"), ctx.setting("S_high"), dt)
+    m_neq = lbm.moments(M, f - feq) * keep.reshape((19,) + (1,) * (f.ndim - 1))
+    g = family.gravity_of(ctx)
+    nw = w / (1.0 - ctx.setting("PorocityGamma") * (1.0 - w))
+    u2 = tuple((u[a] + g[a]) for a in range(3))
+    coll = ctx.nt_in_group("COLLISION")
+    ctx.add_global("Drag", (1.0 - nw) * u2[0], where=coll)
+    ctx.add_global("Lift", (1.0 - nw) * u2[1], where=coll)
+    u2 = tuple(c * nw for c in u2)
+    m_post = m_neq + lbm.moments(M, lbm.equilibrium(E, W, rho, u2))
+    fc = lbm.from_moments(M, m_post)
+    f = jnp.where(ctx.nt_in_group("COLLISION")[None], fc, f)
+    in_design = ctx.nt_in_group("DESIGNSPACE")
+    ctx.add_global("MaterialPenalty", w * (1.0 - w), where=in_design)
+    ctx.add_global("Material", 1.0 - w, where=in_design)
+    return ctx.store({"f": f})
+
+
+def init(ctx: NodeCtx) -> jnp.ndarray:
+    shape = ctx.flags.shape
+    dt = ctx._fields.dtype
+    w = 1.0 - jnp.broadcast_to(ctx.setting("Porocity"), shape).astype(dt)
+    w = jnp.where(ctx.nt_is("Solid"), jnp.zeros_like(w), w)
+    return family.standard_init(ctx, E, W, extra={"w": w[None]})
+
+
+def build():
+    q = family.make_getters(E, force_of=family.gravity_of)
+    wq = lambda c: c.density("w")          # noqa: E731
+    q.update({"W": wq, "WB": wq})
+    return _def().finalize().bind(run=run, init=init, quantities=q)

@@ -1,0 +1,95 @@
+"""d3q19_heat — 3D flow + temperature (d3q19 + d3q7 double distribution).
+
+Behavioral parity target: reference model ``d3q19_heat``
+(reference src/d3q19_heat/Dynamics.R, Dynamics.c.Rt): d3q19 flow coupled to
+an advected temperature lattice with diffusivity ``FluidAlfa`` and Heater
+nodes.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+import jax.numpy as jnp
+
+from tclb_tpu.core.lattice import NodeCtx
+from tclb_tpu.core.registry import ModelDef
+from tclb_tpu.models import family
+from tclb_tpu.models.d3q19 import E, OPP, W, collide
+from tclb_tpu.ops import lbm
+
+# d3q7 for the scalar: rest + 6 axis vectors
+ET = np.array([(0, 0, 0), (1, 0, 0), (-1, 0, 0), (0, 1, 0), (0, -1, 0),
+               (0, 0, 1), (0, 0, -1)], dtype=np.int32)
+WT = lbm.weights(ET)
+OPPT = lbm.opposite(ET)
+
+
+def _def() -> ModelDef:
+    d = family.base_def("d3q19_heat", E, "3D flow + temperature",
+                        faces="WE", symmetries="NS")
+    d.add_densities("T", ET, group="T")
+    d.add_setting("S_high", default=1.0)
+    d.add_setting("InletTemperature", default=1.0)
+    d.add_setting("InitTemperature", default=1.0)
+    d.add_setting("FluidAlfa", default=1.0)
+    d.add_setting("HeaterTemperature", default=100.0)
+    d.add_quantity("T", unit="K")
+    d.add_global("OutFlux")
+    d.add_node_type("Heater", "ADDITIONALS")
+    return d
+
+
+def _t_eq(T, u):
+    dt = T.dtype
+    out = []
+    for i in range(7):
+        eu = sum(float(ET[i, a]) * u[a] for a in range(3) if ET[i, a])
+        if isinstance(eu, int):
+            out.append(jnp.asarray(float(WT[i]), dt) * T)
+        else:
+            out.append(jnp.asarray(float(WT[i]), dt) * T * (1.0 + 4.0 * eu))
+    return jnp.stack(out)
+
+
+def run(ctx: NodeCtx) -> jnp.ndarray:
+    f = ctx.group("f")
+    fT = ctx.group("T")
+    dt = f.dtype
+    f = family.apply_boundaries(ctx, f, E, W, OPP)
+    t_in = ctx.setting("InletTemperature")
+    shape = f.shape[1:]
+    fT = ctx.boundary_case(fT, {
+        ("Wall", "Solid"): lambda t: t[jnp.asarray(OPPT)],
+        ("WVelocity", "EPressure"): lambda t: _t_eq(
+            jnp.broadcast_to(t_in, shape).astype(dt),
+            tuple(jnp.zeros(shape, dt) for _ in range(3))),
+    })
+    rho = jnp.sum(f, axis=0)
+    u = tuple(jnp.tensordot(jnp.asarray(E[:, a], dt), f, axes=1) / rho
+              for a in range(3))
+    fc = collide(ctx, f)
+    temp = jnp.sum(fT, axis=0)
+    target = jnp.where(ctx.nt_is("Heater"),
+                       ctx.setting("HeaterTemperature"), temp)
+    # d3q7 diffusivity: alfa = (1/w_a)(tau - 1/2) with w_a = 1/4
+    om_t = 1.0 / (4.0 * ctx.setting("FluidAlfa") + 0.5)
+    tc = fT + om_t * (_t_eq(target, u) - fT)
+    coll = ctx.nt_in_group("COLLISION")[None]
+    f = jnp.where(coll, fc, f)
+    fT = jnp.where(coll, tc, fT)
+    ctx.add_global("OutFlux", temp * u[0], where=ctx.nt_is("Outlet"))
+    return ctx.store({"f": f, "T": fT})
+
+
+def init(ctx: NodeCtx) -> jnp.ndarray:
+    shape = ctx.flags.shape
+    dt = ctx._fields.dtype
+    t0 = jnp.broadcast_to(ctx.setting("InitTemperature"), shape).astype(dt)
+    fT = _t_eq(t0, tuple(jnp.zeros(shape, dt) for _ in range(3)))
+    return family.standard_init(ctx, E, W, extra={"T": fT})
+
+
+def build():
+    q = family.make_getters(E, force_of=family.gravity_of)
+    q["T"] = lambda c: jnp.sum(c.group("T"), axis=0)
+    return _def().finalize().bind(run=run, init=init, quantities=q)

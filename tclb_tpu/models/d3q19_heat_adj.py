@@ -1,0 +1,106 @@
+"""d3q19_heat_adj (+ _art / _prop variants) — 3D conjugate-heat topology
+optimization.
+
+Behavioral parity targets: reference models ``d3q19_heat_adj``,
+``d3q19_heat_adj_art`` and ``d3q19_heat_adj_prop``
+(reference src/d3q19_heat_adj*/Dynamics.R, ADJOINT=1): d3q19 flow +
+advected temperature with a design field ``w`` — Brinkman velocity
+penalization and w-interpolated diffusivity.  The reference's _art/_prop
+variants differ in how their Tapenade tapes are generated/propagated —
+an implementation detail of source-transform AD with no analogue here
+(jax.grad differentiates the same physics) — so all three names share one
+TPU-native physics definition.
+"""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+from tclb_tpu.core.lattice import NodeCtx
+from tclb_tpu.models import family
+from tclb_tpu.models.d3q19_heat import ET, OPPT, WT, _t_eq
+from tclb_tpu.models.d3q19 import E, OPP, W
+from tclb_tpu.ops import lbm
+
+
+def _make(name: str):
+    def _def():
+        d = family.base_def(name, E, "3D conjugate-heat topology opt",
+                            faces="WE", symmetries="NS")
+        d.add_densities("T", ET, group="T")
+        d.add_density("w", group="w", parameter=True)
+        d.add_setting("InletTemperature", default=1.0)
+        d.add_setting("InitTemperature", default=1.0)
+        d.add_setting("FluidAlfa", default=0.1)
+        d.add_setting("SolidAlfa", default=0.01)
+        d.add_setting("Porocity", default=0.0, zonal=True)
+        d.add_quantity("T", unit="K")
+        d.add_quantity("W")
+        d.add_quantity("TB", adjoint=True)
+        d.add_quantity("WB", adjoint=True)
+        d.add_global("HeatFlux")
+        d.add_global("Material")
+        d.add_global("Drag")
+        return d
+
+    def run(ctx: NodeCtx) -> jnp.ndarray:
+        f = ctx.group("f")
+        fT = ctx.group("T")
+        w = ctx.density("w")
+        dt = f.dtype
+        f = family.apply_boundaries(ctx, f, E, W, OPP)
+        shape = f.shape[1:]
+        t_in = ctx.setting("InletTemperature")
+        fT = ctx.boundary_case(fT, {
+            ("Wall", "Solid"): lambda t: t[jnp.asarray(OPPT)],
+            "WVelocity": lambda t: _t_eq(
+                jnp.broadcast_to(t_in, shape).astype(dt),
+                tuple(jnp.zeros(shape, dt) for _ in range(3))),
+        })
+        rho = jnp.sum(f, axis=0)
+        u = tuple(jnp.tensordot(jnp.asarray(E[:, a], dt), f, axes=1) / rho
+                  for a in range(3))
+        om = ctx.setting("omega")
+        feq = lbm.equilibrium(E, W, rho, u)
+        coll_mask = ctx.nt_in_group("COLLISION")
+        ctx.add_global("Drag", (1.0 - w) * jnp.abs(u[0]), where=coll_mask)
+        u2 = tuple(c * w for c in u)
+        fc = f + om * (feq - f) + (lbm.equilibrium(E, W, rho, u2) - feq)
+        temp = jnp.sum(fT, axis=0)
+        alfa = ctx.setting("FluidAlfa") * w \
+            + ctx.setting("SolidAlfa") * (1.0 - w)
+        om_t = 1.0 / (4.0 * alfa + 0.5)
+        tc = fT + om_t[None] * (_t_eq(temp, u2) - fT)
+        coll = coll_mask[None]
+        f = jnp.where(coll, fc, f)
+        fT = jnp.where(coll, tc, fT)
+        ctx.add_global("HeatFlux", temp * u2[0], where=ctx.nt_is("Outlet"))
+        ctx.add_global("Material", 1.0 - w,
+                       where=ctx.nt_in_group("DESIGNSPACE"))
+        return ctx.store({"f": f, "T": fT})
+
+    def init(ctx: NodeCtx) -> jnp.ndarray:
+        shape = ctx.flags.shape
+        dt = ctx._fields.dtype
+        t0 = jnp.broadcast_to(ctx.setting("InitTemperature"),
+                              shape).astype(dt)
+        fT = _t_eq(t0, tuple(jnp.zeros(shape, dt) for _ in range(3)))
+        w = 1.0 - jnp.broadcast_to(ctx.setting("Porocity"),
+                                   shape).astype(dt)
+        w = jnp.where(ctx.nt_is("Solid"), jnp.zeros_like(w), w)
+        return family.standard_init(ctx, E, W,
+                                    extra={"T": fT, "w": w[None]})
+
+    def build():
+        q = family.make_getters(E, force_of=family.gravity_of)
+        tq = lambda c: jnp.sum(c.group("T"), axis=0)   # noqa: E731
+        wq = lambda c: c.density("w")                  # noqa: E731
+        q.update({"T": tq, "W": wq, "TB": tq, "WB": wq})
+        return _def().finalize().bind(run=run, init=init, quantities=q)
+
+    return build
+
+
+build = _make("d3q19_heat_adj")
+build_art = _make("d3q19_heat_adj_art")
+build_prop = _make("d3q19_heat_adj_prop")

@@ -1,0 +1,112 @@
+"""d3q19_kuper — 3D Kupershtokh pseudopotential multiphase.
+
+Behavioral parity target: reference model ``d3q19_kuper``
+(reference src/d3q19_kuper/Dynamics.R, Dynamics.c.Rt): the 3D version of
+d2q9_kuper — same vdW pseudopotential ``phi`` stage, exact-difference force
+over the 18 neighbor directions with shell weights, BGK+force collision.
+"""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+from tclb_tpu.core.lattice import NodeCtx
+from tclb_tpu.core.registry import ModelDef
+from tclb_tpu.models import family
+from tclb_tpu.models.d3q19 import E, OPP, W
+from tclb_tpu.models.d2q9_kuper import _eos_pressure
+from tclb_tpu.ops import lbm
+
+# gradient shell weights: 18 * w_i gives (1, 1/2) on (axis, diagonal)
+GS = 18.0 * W
+
+
+def _def() -> ModelDef:
+    d = ModelDef("d3q19_kuper", ndim=3,
+                 description="3D Kupershtokh pseudopotential multiphase")
+    d.add_densities("f", E)
+    d.add_field("phi", dx=(-1, 1), dy=(-1, 1), dz=(-1, 1))
+    d.add_stage("BaseIteration", "Run")
+    d.add_stage("CalcPhi", "CalcPhi")
+    d.add_stage("BaseInit", "Init", load_densities=False)
+    d.add_action("Iteration", ("BaseIteration", "CalcPhi"))
+    d.add_action("Init", ("BaseInit", "CalcPhi"))
+    d.add_quantity("Rho", unit="kg/m3")
+    d.add_quantity("U", unit="m/s", vector=True)
+    d.add_quantity("P", unit="Pa")
+    d.add_setting("omega", default=1.0)
+    d.add_setting("nu", default=1 / 6,
+                  derived={"omega": lambda nu: 1.0 / (3 * nu + 0.5)})
+    d.add_setting("Temperature", default=0.56)
+    d.add_setting("FAcc", default=1.0)
+    d.add_setting("Magic", default=0.01)
+    d.add_setting("MagicA", default=-0.152)
+    d.add_setting("MagicF", default=-2.0 / 3.0)
+    for ax in ("X", "Y", "Z"):
+        d.add_setting(f"Gravitation{ax}")
+    d.add_setting("Density", default=3.26, zonal=True)
+    d.add_setting("Wetting", default=1.0)
+    return d
+
+
+def calc_phi(ctx: NodeCtx):
+    f = ctx.group("f")
+    rho = jnp.sum(f, axis=0)
+    rho = jnp.where(ctx.nt_in_group("BOUNDARY"), ctx.setting("Density"), rho)
+    p = ctx.setting("Magic") * _eos_pressure(rho, ctx.setting("Temperature"))
+    phi = ctx.setting("FAcc") * jnp.sqrt(jnp.maximum(rho / 3.0 - p, 0.0))
+    return {"phi": phi}
+
+
+def run(ctx: NodeCtx) -> jnp.ndarray:
+    f = ctx.group("f")
+    dt = f.dtype
+    f = family.apply_boundaries(ctx, f, E, W, OPP)
+    a = ctx.setting("MagicA")
+    phi0 = ctx.load("phi")
+    fx = jnp.zeros_like(phi0)
+    fy = jnp.zeros_like(phi0)
+    fz = jnp.zeros_like(phi0)
+    for i in range(1, 19):
+        phii = ctx.load("phi", int(E[i, 0]), int(E[i, 1]), int(E[i, 2]))
+        r = a * phii * phii + (1.0 - 2.0 * a) * phii * phi0
+        g = float(GS[i])
+        fx = fx + g * r * float(E[i, 0])
+        fy = fy + g * r * float(E[i, 1])
+        fz = fz + g * r * float(E[i, 2])
+    s = ctx.setting("MagicF")
+    rho = jnp.sum(f, axis=0)
+    u = tuple(jnp.tensordot(jnp.asarray(E[:, ax], dt), f, axes=1) / rho
+              for ax in range(3))
+    grav = family.gravity_of(ctx)
+    frc = (s * fx / rho + grav[0], s * fy / rho + grav[1],
+           s * fz / rho + grav[2])
+    feq = lbm.equilibrium(E, W, rho, u)
+    fc = f + ctx.setting("omega") * (feq - f)
+    u2 = tuple(u[ax] + frc[ax] for ax in range(3))
+    fc = fc + (lbm.equilibrium(E, W, rho, u2) - feq)
+    f = jnp.where(ctx.nt_in_group("COLLISION")[None], fc, f)
+    return ctx.store({"f": f})
+
+
+def init(ctx: NodeCtx) -> jnp.ndarray:
+    shape = ctx.flags.shape
+    dt = ctx._fields.dtype
+    rho = jnp.broadcast_to(ctx.setting("Density"), shape).astype(dt)
+    f = lbm.equilibrium(E, W, rho,
+                        tuple(jnp.zeros(shape, dt) for _ in range(3)))
+    return ctx.store({"f": f})
+
+
+def get_p(ctx):
+    rho = jnp.sum(ctx.group("f"), axis=0)
+    return ctx.setting("Magic") * _eos_pressure(rho,
+                                                ctx.setting("Temperature"))
+
+
+def build():
+    q = family.make_getters(E, force_of=family.gravity_of)
+    q["P"] = get_p
+    return _def().finalize().bind(run=run, init=init,
+                                  stages={"CalcPhi": calc_phi},
+                                  quantities=q)

@@ -86,8 +86,9 @@ def apply_boundaries(ctx: NodeCtx, f: jnp.ndarray, E: np.ndarray,
     Wall/Solid bounce-back, <F>Velocity / <F>Pressure faces via
     non-equilibrium bounce-back, <F>Symmetry mirrors (the reference's
     per-node boundary switch, e.g. src/d2q9/Dynamics.c.Rt:121-150)."""
-    vel = ctx.setting("Velocity")
-    den = ctx.setting("Density")
+    si = ctx.model.setting_index
+    vel = ctx.setting("Velocity") if "Velocity" in si else 0.0
+    den = ctx.setting("Density") if "Density" in si else 1.0
     cases: dict = {("Wall", "Solid"): lambda f: f[jnp.asarray(OPP)]}
     known = ctx.model.node_types
     for face, (axis, side) in FACES.items():

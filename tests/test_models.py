@@ -3,6 +3,7 @@ bounce-back walls + periodic wrap, and stays finite; hydrodynamic families
 reproduce the analytic Poiseuille profile (the reference's regression-test
 role, tools/tests.sh + the d2q9_npe_guo python physics checks)."""
 
+import jax
 import numpy as np
 import jax.numpy as jnp
 import pytest
@@ -299,6 +300,84 @@ def test_hb_destruction():
     assert T[4, 8] < 1.0                # eroded at Destroy nodes
     ss = np.asarray(lat.get_quantity("SS"))
     assert np.isfinite(ss).all()
+
+
+@pytest.mark.parametrize("name", ["d2q9_heat_adj", "d2q9_plate",
+                                  "d2q9_optimalMixing", "d2q9_solid",
+                                  "d3q19_heat", "d3q19_heat_adj",
+                                  "d3q19_adj"])
+def test_variant_models_run_finite(name):
+    m = get_model(name)
+    shape = (8, 12) if m.ndim == 2 else (6, 6, 10)
+    settings = {"nu": 0.1}
+    if "InletVelocity" in m.setting_index:
+        settings["InletVelocity"] = 0.02
+    if "Velocity" in m.setting_index:
+        settings["Velocity"] = 0.02
+    lat = Lattice(m, shape, dtype=jnp.float64, settings=settings)
+    lat.set_flags(_flags_channel(m, shape))
+    lat.init()
+    lat.iterate(30)
+    for q in m.quantities:
+        if q.adjoint:
+            continue
+        assert np.isfinite(np.asarray(lat.get_quantity(q.name))).all(), \
+            (name, q.name)
+
+
+def test_d3q19_kuper_runs():
+    m = get_model("d3q19_kuper")
+    shape = (8, 8, 8)
+    lat = Lattice(m, shape, dtype=jnp.float64,
+                  settings={"nu": 0.18, "Temperature": 0.56,
+                            "Density": 3.26, "Magic": 0.01})
+    lat.set_flags(np.full(shape, m.flag_for("BGK"), dtype=np.uint16))
+    lat.init()
+    mass0 = float(np.asarray(lat.get_quantity("Rho")).sum())
+    lat.iterate(30)
+    rho = np.asarray(lat.get_quantity("Rho"))
+    assert np.isfinite(rho).all()
+    assert float(rho.sum()) == pytest.approx(mass0, rel=1e-10)
+
+
+def test_heat_adj_gradient():
+    """The heat_adj.xml benchmark case family: gradient of HeatFlux wrt the
+    conjugate-design field checks against finite differences."""
+    from tclb_tpu.adjoint import (InternalTopology, fd_test,
+                                  make_objective_run,
+                                  make_unsteady_gradient)
+    m = get_model("d2q9_heat_adj")
+    shape = (8, 12)
+    lat = Lattice(m, shape, dtype=jnp.float64,
+                  settings={"nu": 0.1, "InletVelocity": 0.05,
+                            "InletTemperature": 2.0,
+                            "HeatFluxInObj": 1.0, "Porocity": 0.5})
+    flags = np.full(shape, m.flag_for("BGK"), dtype=np.uint16)
+    flags[0], flags[-1] = m.flag_for("Wall"), m.flag_for("Wall")
+    flags[1:-1, 0] = m.flag_for("WVelocity", "BGK")
+    flags[1:-1, -1] = m.flag_for("EPressure", "BGK")
+    flags[2:6, 4:8] |= m.flag_for("DesignSpace")
+    flags[1:-1, -2] |= m.flag_for("Outlet")
+    lat.set_flags(flags)
+    lat.init()
+    design = InternalTopology(m)
+    gf = make_unsteady_gradient(m, design, 6, levels=2)
+    theta = design.get(lat.state, lat.params)
+    obj, g, _ = gf(theta, lat.state, lat.params)
+    assert np.isfinite(float(obj)) and np.abs(np.asarray(g)).max() > 0
+    run = make_objective_run(m, 6, levels=2)
+
+    @jax.jit
+    def loss(th):
+        s2, p2 = design.put(th, lat.state, lat.params)
+        return run(s2, p2)[0]
+
+    import jax as _jax
+    for c in fd_test(loss, _jax.numpy.asarray(g), theta, n_checks=3,
+                     eps=1e-6, seed=7):
+        if c["adjoint"] == 0 and abs(c["fd"]) < 1e-10:
+            continue
+        assert c["rel_err"] < 1e-5, c
 
 
 def test_all_registered_models_build():

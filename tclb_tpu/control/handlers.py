@@ -12,6 +12,7 @@ Handlers run host-side; everything device-bound goes through the Lattice.
 from __future__ import annotations
 
 import math
+import re
 import xml.etree.ElementTree as ET
 from typing import Optional
 
@@ -261,11 +262,27 @@ class conControl(Handler):
 
     def _eval(self, context: dict[str, np.ndarray], expr: str) -> np.ndarray:
         """``var*scale+var2*scale2+const`` -> per-iteration array
-        (reference conControl::get, src/Handlers.cpp.Rt:2253-2310)."""
+        (reference conControl::get, src/Handlers.cpp.Rt:2253-2310).
+
+        Terms are split on top-level ``+``/``-``; a sign directly after
+        ``e``/``E`` is a numeric exponent (``1e+5``), not a term boundary,
+        and a leading sign negates the first term."""
         s = self.solver
         out = np.zeros(self.horizon)
-        for term in expr.split("+"):
-            factors = term.split("*")
+        # a +/- is an exponent sign only in digit-e contexts ("1e+5", "2.E-3");
+        # after an identifier ending in e/E ("rate+flow") it still splits
+        parts = re.split(r"(?<![\d.][eE])([+-])", expr)
+        sign = 1.0
+        for part in parts:
+            part = part.strip()
+            if part == "+":
+                continue
+            if part == "-":
+                sign = -sign
+                continue
+            if not part:
+                continue
+            factors = part.split("*")
             if factors[0].strip() in context:
                 val = context[factors[0].strip()].copy()
                 for f in factors[1:]:
@@ -275,7 +292,8 @@ class conControl(Handler):
                 for f in factors:
                     v *= s.units.alt(f)
                 val = v
-            out = out + val
+            out = out + sign * val
+            sign = 1.0
         return out
 
     def _load_csv(self, node: ET.Element, context: dict) -> None:
@@ -303,9 +321,16 @@ class conControl(Handler):
             self.horizon = saved
         else:
             t = data["_index"] * (self.horizon / n)
+        # np.interp silently misbehaves on a non-increasing sample grid —
+        # sort rows by time and reject duplicates instead
+        order = np.argsort(t, kind="stable")
+        t = np.asarray(t, dtype=np.float64)[order]
+        if (np.diff(t) <= 0).any():
+            raise ValueError(f"<CSV {fn}>: Time column has duplicate or "
+                             "non-increasing entries after sorting")
         grid = np.arange(self.horizon, dtype=np.float64)
         for name, col in data.items():
-            context[name] = np.interp(grid, t, col)
+            context[name] = np.interp(grid, t, np.asarray(col)[order])
         # the reference also accepts <Params> nested inside <CSV>
         # (conControl::Internal tail, src/Handlers.cpp.Rt:2430-2450)
         for child in node:

@@ -92,8 +92,9 @@ class Solver:
         lat = self.lattice
         row: dict[str, float] = {
             "Iteration": float(self.iter),
-            "Time_si": self.units.scale_time() * self.iter
-            if hasattr(self.units, "scale_time") else float(self.iter),
+            # 1 s == units.scale[1] lattice iterations (UnitEnv gauge),
+            # so SI time of iteration n is n / scale[1]
+            "Time_si": float(self.iter) / float(self.units.scale[1]),
             "Walltime": time.time() - self.start_walltime,
             "OptIteration": float(self.opt_iter),
         }

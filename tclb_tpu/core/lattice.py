@@ -221,7 +221,10 @@ class NodeCtx:
     def setting_dt(self, name: str) -> jnp.ndarray:
         """Time derivative of a zonal setting: central difference over its
         time series (reference ``<setting>_DT`` planes, the ``set_internal``
-        derivative at src/ZoneSettings.h:102-119); zero where no series."""
+        derivative at src/ZoneSettings.h:102-119); zero where no series.
+        One-sided differences at the series endpoints — the series is a
+        finite control horizon, not periodic, so a wrapped central
+        difference would mix the two ends into a spurious spike."""
         m = self.model
         i = m.setting_index[name]
         zone_vals = jnp.zeros((m.zone_max,), dtype=self._fields.dtype)
@@ -230,8 +233,11 @@ class NodeCtx:
             ts = self.params.time_series
             T = ts.shape[1]
             t = jnp.mod(jnp.asarray(self.iteration, jnp.int32), T)
+            lo = jnp.maximum(t - 1, 0)
+            hi = jnp.minimum(t + 1, T - 1)
+            span = jnp.maximum(hi - lo, 1).astype(ts.dtype)
             for z, r in rows:
-                d = (ts[r, jnp.mod(t + 1, T)] - ts[r, jnp.mod(t - 1, T)]) / 2.0
+                d = (ts[r, hi] - ts[r, lo]) / span
                 zone_vals = zone_vals.at[z].set(d)
         return zone_vals[self._zones()]
 
@@ -317,6 +323,16 @@ def make_stage_step(model: Model, stage_name: str,
         streaming = Streaming(model)
 
     def step(state: LatticeState, params: SimParams) -> LatticeState:
+        # full-f32 matmuls: on TPU, einsum/tensordot otherwise default to
+        # bf16 MXU passes, and bf16's 8 mantissa bits destroy the moment
+        # transforms (the d2q9 Karman case visibly diverges by iteration
+        # ~100).  LBM is bandwidth-bound — exact matmuls cost nothing
+        # measurable.  Scoped here, not via global config, so importing the
+        # framework never changes precision for unrelated user code.
+        with jax.default_matmul_precision("highest"):
+            return _step_inner(state, params)
+
+    def _step_inner(state: LatticeState, params: SimParams) -> LatticeState:
         raw = state.fields
         pulled = streaming.pull(raw) if stage.load_densities else raw
         ctx = NodeCtx(model, pulled, raw, state.flags, params,
@@ -412,7 +428,8 @@ def make_sampled_iterate(model: Model, points: np.ndarray,
                       iteration=state.iteration)
         cols = []
         for _, fn in qfns:
-            plane = fn(ctx)
+            with jax.default_matmul_precision("highest"):
+                plane = fn(ctx)
             if plane.ndim == len(state.flags.shape):
                 cols.append(plane[idx][:, None])
             else:  # vector: (ncomp, *shape) -> (npoints, ncomp)
@@ -569,7 +586,8 @@ class Lattice:
         ctx = NodeCtx(self.model, self.state.fields, self.state.fields,
                       self.state.flags, self.params,
                       iteration=self.state.iteration)
-        return fn(ctx)
+        with jax.default_matmul_precision("highest"):
+            return fn(ctx)
 
     def get_density(self, name: str) -> jnp.ndarray:
         return self.state.fields[self.model.storage_index[name]]

@@ -96,9 +96,13 @@ def apply_boundaries(ctx: NodeCtx, f: jnp.ndarray, E: np.ndarray,
             continue
         vname, pname = f"{face}Velocity", f"{face}Pressure"
         if vname in known:
+            # vel is the signed +axis component on every face (reference
+            # ZouHe: V3[direction] = Velocity, src/lib/boundary.R) —
+            # nebb_boundary takes it as-is; negating by side would reverse
+            # the flow on E/N/T faces vs the reference and our own d2q9
             cases[vname] = (lambda f, a=axis, s=side:
                             lbm.nebb_boundary(E, W, OPP, f, a, s,
-                                              "velocity", vel * s))
+                                              "velocity", vel))
         if pname in known:
             cases[pname] = (lambda f, a=axis, s=side:
                             lbm.nebb_boundary(E, W, OPP, f, a, s,

@@ -171,15 +171,18 @@ def nebb_boundary(E: np.ndarray, W: np.ndarray, OPP: np.ndarray,
 
     ``axis``: face normal axis (0=x, 1=y, 2=z); ``side``: +1 if the fluid
     lies in +axis direction (a "low" face), -1 for a "high" face;
-    ``kind``: 'velocity' (``value`` = normal velocity, positive into the
-    domain) or 'pressure' (``value`` = density).  Unknown populations
-    (e.axis == side) get ``f_opp + 2 w rho (e.u)/cs2`` evaluated for the
-    normal-only velocity — exact mass/momentum closure on straight walls.
+    ``kind``: 'velocity' (``value`` = signed +axis velocity component) or
+    'pressure' (``value`` = density).  Unknown populations (e.axis == side)
+    get ``f_opp + 2 w rho (e.u)/cs2`` for the normal velocity, minus the
+    tangential-momentum correction ``(e.t)(Q_t/2 - cs2 rho u_t)`` with
+    ``Q_t`` the tangential momentum carried by the wall-parallel knowns
+    (Zou & He's d2q9 ``0.5 (f[2]-f[4])`` terms, generalized to 3D a la
+    Hecht & Harting) — the closure the reference ZouHe applies
+    (src/lib/boundary.R); the imposed tangential velocity is zero.
     """
     dt = f.dtype
     en = E[:, axis].astype(np.int64)
     tang = jnp.asarray((en == 0), dt)
-    into = jnp.asarray((en == side), dt)      # unknowns, leaving the wall
     outof = jnp.asarray((en == -side), dt)    # known, entering the wall
     nd = f.ndim - 1
     sh = (len(E),) + (1,) * nd
@@ -195,6 +198,16 @@ def nebb_boundary(E: np.ndarray, W: np.ndarray, OPP: np.ndarray,
     # non-equilibrium bounce-back: f_i = f_opp(i) + 6 w_i rho e_i.u
     eu = jnp.asarray(en, dt).reshape(sh) * un
     corr = 6.0 * jnp.asarray(W, dt).reshape(sh) * rho * eu
+    # tangential closure: redistribute the excess tangential momentum of the
+    # wall-parallel populations onto the unknowns (target u_t = 0)
+    for t_ax in range(E.shape[1]):
+        if t_ax == axis:
+            continue
+        et = E[:, t_ax].astype(np.int64)
+        if not et.any():
+            continue
+        q_t = jnp.sum((tang * jnp.asarray(et, dt)).reshape(sh) * f, axis=0)
+        corr = corr - jnp.asarray(et, dt).reshape(sh) * (0.5 * q_t)
     f_bb = f[jnp.asarray(OPP)]
     return jnp.where(jnp.asarray(en == side).reshape(sh), f_bb + corr, f)
 

@@ -367,11 +367,16 @@ class Geometry:
             if len(child):
                 self.draw(child)
             else:
-                # no shape children: fill the element's own region
-                holder = ET.Element("g", dict(child.attrib))
-                box = ET.SubElement(holder, "Box")
-                self._paint(np.ones((self.region.nz, self.region.ny,
-                                     self.region.nx), bool), self.region)
+                # no shape children: paint a Box over the element's OWN
+                # region attributes (e.g. <Wall dx="0" fx="5"/> is the
+                # first six columns, not the whole domain — reference
+                # Geometry::load treats the element itself as the region,
+                # src/Geometry.cpp.Rt:905-950)
+                holder = ET.Element("g")
+                ET.SubElement(holder, "Box", {
+                    k: v for k, v in child.attrib.items()
+                    if k not in ("mask", "mode", "name")})
+                self.draw(holder)
 
     def result(self) -> np.ndarray:
         """Painted flags, shaped for the model's dimensionality."""

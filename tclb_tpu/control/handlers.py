@@ -270,8 +270,12 @@ class conControl(Handler):
         s = self.solver
         out = np.zeros(self.horizon)
         # a +/- is an exponent sign only in digit-e contexts ("1e+5", "2.E-3");
-        # after an identifier ending in e/E ("rate+flow") it still splits
-        parts = re.split(r"(?<![\d.][eE])([+-])", expr)
+        # after an identifier ending in e/E ("rate+flow") it still splits.
+        # A sign directly after '*' is a negative factor ("flow*-2"), not a
+        # term boundary (tighten spaces around '*' first so "flow * -2"
+        # parses the same way).
+        expr = re.sub(r"\s*\*\s*", "*", expr)
+        parts = re.split(r"(?<![\d.][eE])(?<!\*)([+-])", expr)
         sign = 1.0
         for part in parts:
             part = part.strip()

@@ -352,10 +352,16 @@ def make_stage_step(model: Model, stage_name: str,
             new_fields = buf
         # Solid/Wall nodes keep the engine's semantics from the model's Run();
         # nothing special here — BCs are the model's job via ctx.boundary_case.
+        # Globals accumulate across the stages of one action (the reference
+        # clears the GPU globals buffer at iteration start and every stage's
+        # kernels atomically add into it, src/Lattice.cu.Rt:383-461);
+        # make_action_step zeroes the buffer before its first stage, so a
+        # trailing non-global stage (e.g. kuper's CalcPhi) no longer wipes
+        # the objectives the Run stage just computed.
         return LatticeState(
             fields=new_fields,
             flags=state.flags,
-            globals_=ctx.reduce_globals(),
+            globals_=state.globals_ + ctx.reduce_globals(),
             iteration=state.iteration,
         )
 
@@ -375,6 +381,7 @@ def make_action_step(model: Model, action: str = "Iteration",
                    for s in model.actions[action])
 
     def step(state: LatticeState, params: SimParams) -> LatticeState:
+        state = state.replace(globals_=jnp.zeros_like(state.globals_))
         for s in steps:
             state = s(state, params)
         if advances:

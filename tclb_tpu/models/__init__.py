@@ -31,6 +31,10 @@ _REGISTRY: dict[str, str] = {
     "d2q9_hb": "tclb_tpu.models.d2q9_hb",
     "d2q9_diff": "tclb_tpu.models.d2q9_diff",
     "d2q9_kuper": "tclb_tpu.models.d2q9_kuper",
+    "d2q9_pf": "tclb_tpu.models.d2q9_pf",
+    "d2q9_pf_curvature": "tclb_tpu.models.d2q9_pf_curvature",
+    "d2q9_pf_pressureEvolution":
+        "tclb_tpu.models.d2q9_pf_pressure_evolution",
     "sw": "tclb_tpu.models.sw",
     "wave": "tclb_tpu.models.wave",
     "wave2d": "tclb_tpu.models.wave2d",

@@ -1,0 +1,182 @@
+"""d2q9_pf — conservative phase-field interface tracking on two lattices.
+
+Behavioral parity target: reference model ``d2q9_pf``
+(reference src/d2q9_pf/Dynamics.R, Dynamics.c.Rt — "Conservative phase-field
+lattice Boltzmann model for interface tracking equation", M. Dzikowski 2016).
+Two d2q9 populations: ``f`` carries hydrodynamics (all non-conserved moments
+relaxed at one rate — the reference's orthonormalized-basis MRT with equal
+rates, Dynamics.c.Rt:189-248 — with exact-difference gravity forcing), ``h``
+carries the phase field with an anti-diffusive interface-sharpening term
+``Bh w_i e.n``, ``Bh = 3 M (1 - 4 pf^2) W`` (Dynamics.c.Rt:239-246).  The
+interface normal comes from the first central moments of ``h``
+(Dynamics.c.Rt:71-96).
+"""
+
+from __future__ import annotations
+
+import numpy as np
+import jax.numpy as jnp
+
+from tclb_tpu.core.lattice import NodeCtx
+from tclb_tpu.core.registry import ModelDef
+from tclb_tpu.models.d2q9 import E, _zou_he_x
+from tclb_tpu.ops import lbm
+
+W = lbm.weights(E)
+OPP = lbm.opposite(E)
+OPP18 = np.concatenate([OPP, OPP + 9])
+
+
+def _def() -> ModelDef:
+    d = ModelDef("d2q9_pf", ndim=2,
+                 description="conservative phase-field interface tracking")
+    d.add_densities("f", E)
+    d.add_densities("h", E)
+    d.add_quantity("Rho", unit="kg/m3")
+    d.add_quantity("U", unit="m/s", vector=True)
+    d.add_quantity("Normal", unit="1/m", vector=True)
+    d.add_quantity("PhaseField", unit="1")
+    d.add_setting("omega", comment="one over relaxation time")
+    d.add_setting("nu", default=1 / 6,
+                  derived={"omega": lambda nu: 1.0 / (3 * nu + 0.5)})
+    d.add_setting("Velocity", default=0.0, zonal=True)
+    d.add_setting("Pressure", default=0.0, zonal=True)
+    d.add_setting("W", default=1.0, comment="anti-diffusivity coeff")
+    d.add_setting("M", default=1.0, comment="mobility")
+    d.add_setting("PhaseField", default=1.0, zonal=True,
+                  comment="phase-field marker scalar")
+    d.add_setting("GravitationX")
+    d.add_setting("GravitationY")
+    d.add_global("PressureLoss", unit="1mPa")
+    d.add_global("OutletFlux", unit="1m2/s")
+    d.add_global("InletFlux", unit="1m2/s")
+    return d
+
+
+def _heq(pf, n, u, bh):
+    """h equilibrium: advected phase field + sharpening flux along the
+    interface normal (reference Heq, src/d2q9_pf/Dynamics.c.Rt:44-46)."""
+    base = lbm.equilibrium(E, W, pf, u)
+    dt = pf.dtype
+    en = jnp.stack([jnp.asarray(float(E[i, 0]), dt) * n[0]
+                    + jnp.asarray(float(E[i, 1]), dt) * n[1]
+                    for i in range(9)])
+    wi = jnp.asarray(W, dt).reshape((9,) + (1,) * pf.ndim)
+    return base + bh * wi * en
+
+
+def _normal(h, u):
+    """Interface normal from the first central moments of h (reference
+    getNormal, src/d2q9_pf/Dynamics.c.Rt:71-96): k = sum_i h_i (e_i - u),
+    n = -k/|k| (zero where |k| vanishes)."""
+    dt = h.dtype
+    pf = jnp.sum(h, axis=0)
+    k10 = jnp.tensordot(jnp.asarray(E[:, 0], dt), h, axes=1) - pf * u[0]
+    k01 = jnp.tensordot(jnp.asarray(E[:, 1], dt), h, axes=1) - pf * u[1]
+    ln = jnp.sqrt(k10 * k10 + k01 * k01)
+    safe = jnp.where(ln > 0, ln, 1.0)
+    return (jnp.where(ln > 0, -k10 / safe, 0.0),
+            jnp.where(ln > 0, -k01 / safe, 0.0))
+
+
+def _boundaries(ctx: NodeCtx, fh: jnp.ndarray) -> jnp.ndarray:
+    """Boundary dispatch over the stacked (f, h) populations: walls bounce
+    both groups (reference FullBounceBack swaps every streamed pair,
+    src/lib/boundary.R:31-33); Zou/He in/outlets act on f only
+    (src/d2q9_pf/Dynamics.c.Rt:169-187)."""
+    vel = ctx.setting("Velocity")
+    den = 1.0 + 3.0 * ctx.setting("Pressure")
+
+    def zou(kind, side):
+        def apply(fh):
+            f = _zou_he_x(fh[:9], vel if kind == "velocity" else den,
+                          kind, side)
+            return jnp.concatenate([f, fh[9:]])
+        return apply
+
+    return ctx.boundary_case(fh, {
+        ("Wall", "Solid"): lambda s: s[jnp.asarray(OPP18)],
+        "EVelocity": zou("velocity", "E"),
+        "WPressure": zou("pressure", "W"),
+        "WVelocity": zou("velocity", "W"),
+        "EPressure": zou("pressure", "E"),
+    })
+
+
+def run(ctx: NodeCtx) -> jnp.ndarray:
+    fh = jnp.concatenate([ctx.group("f"), ctx.group("h")])
+    fh = _boundaries(ctx, fh)
+    f, h = fh[:9], fh[9:]
+    dt = f.dtype
+
+    # hydrodynamic collision: all non-conserved moments at rate omega with
+    # exact-difference gravity (reference CollisionMRT,
+    # src/d2q9_pf/Dynamics.c.Rt:189-225: equal S on every order makes the
+    # orthonormal basis immaterial)
+    rho = jnp.sum(f, axis=0)
+    ux = jnp.tensordot(jnp.asarray(E[:, 0], dt), f, axes=1) / rho
+    uy = jnp.tensordot(jnp.asarray(E[:, 1], dt), f, axes=1) / rho
+    gx = ctx.setting("GravitationX")
+    gy = ctx.setting("GravitationY")
+    omega = ctx.setting("omega")
+    feq = lbm.equilibrium(E, W, rho, (ux, uy))
+    feq2 = lbm.equilibrium(E, W, rho, (ux + gx, uy + gy))
+    fc = feq2 + (1.0 - omega) * (f - feq)
+
+    # phase-field collision sees the post-collision velocity (reference
+    # calls getU() after the f update, Dynamics.c.Rt:229-246)
+    u2 = (ux + gx, uy + gy)
+    pf = jnp.sum(h, axis=0)
+    n = _normal(h, u2)
+    omega_ph = 1.0 / (3.0 * ctx.setting("M") + 0.5)
+    bh = 3.0 * ctx.setting("M") * (1.0 - 4.0 * pf * pf) * ctx.setting("W")
+    hc = h - omega_ph * (h - _heq(pf, n, u2, bh))
+
+    coll = ctx.nt_in_group("COLLISION")[None]
+    f = jnp.where(coll, fc, f)
+    h = jnp.where(coll, hc, h)
+    return ctx.store({"f": f, "h": h})
+
+
+def init(ctx: NodeCtx) -> jnp.ndarray:
+    shape = ctx.flags.shape
+    dt = ctx._fields.dtype
+    rho = jnp.broadcast_to(1.0 + 3.0 * ctx.setting("Pressure"),
+                           shape).astype(dt)
+    ux = jnp.broadcast_to(ctx.setting("Velocity"), shape).astype(dt)
+    uy = jnp.zeros(shape, dt)
+    pf = jnp.broadcast_to(ctx.setting("PhaseField"), shape).astype(dt)
+    f = lbm.equilibrium(E, W, rho, (ux, uy))
+    h = lbm.equilibrium(E, W, pf, (ux, uy))
+    return ctx.store({"f": f, "h": h})
+
+
+def get_u(ctx: NodeCtx) -> jnp.ndarray:
+    f = ctx.group("f")
+    dt = f.dtype
+    rho = jnp.sum(f, axis=0)
+    ux = jnp.tensordot(jnp.asarray(E[:, 0], dt), f, axes=1) / rho
+    uy = jnp.tensordot(jnp.asarray(E[:, 1], dt), f, axes=1) / rho
+    return jnp.stack([ux, uy, jnp.zeros_like(ux)])
+
+
+def get_normal(ctx: NodeCtx) -> jnp.ndarray:
+    f = ctx.group("f")
+    h = ctx.group("h")
+    dt = f.dtype
+    rho = jnp.sum(f, axis=0)
+    u = (jnp.tensordot(jnp.asarray(E[:, 0], dt), f, axes=1) / rho,
+         jnp.tensordot(jnp.asarray(E[:, 1], dt), f, axes=1) / rho)
+    nx, ny = _normal(h, u)
+    return jnp.stack([nx, ny, jnp.zeros_like(nx)])
+
+
+def build():
+    return _def().finalize().bind(
+        run=run, init=init,
+        quantities={
+            "Rho": lambda c: jnp.sum(c.group("f"), axis=0),
+            "U": get_u,
+            "Normal": get_normal,
+            "PhaseField": lambda c: jnp.sum(c.group("h"), axis=0),
+        })

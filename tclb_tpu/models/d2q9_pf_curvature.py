@@ -1,0 +1,296 @@
+"""d2q9_pf_curvature — phase-field advection + CSF surface tension from
+stencil curvature.
+
+Behavioral parity target: reference model ``d2q9_pf_curvature``
+(reference src/d2q9_pf_curvature/Dynamics.R, Dynamics.c.Rt, M. Dzikowski
+2016; validated there by check.py fitting curvature of a circular drop).
+On top of d2q9_pf it adds: a ``phi`` Field written by a ``CalcPhi`` stage
+(walls store a -999 sentinel, Dynamics.c.Rt:329-369), a wall-repaired 9-point
+stencil (``InitPhisStencil``, :185-245: sentinel links take the opposite
+link's value, else the running mean of valid links), gradient/laplacian/
+curvature from that stencil (:247-287), a surface-tension force
+``SurfaceTensionRate * curv * n exp(-Decay phi^2)`` plus phase-interpolated
+gravity (:157-181), and phase-interpolated viscosity (:492-550).
+"""
+
+from __future__ import annotations
+
+import numpy as np
+import jax.numpy as jnp
+
+from tclb_tpu.core.lattice import NodeCtx
+from tclb_tpu.core.registry import ModelDef
+from tclb_tpu.models.d2q9 import E, _zou_he_x
+from tclb_tpu.models.family import mirror_perm
+from tclb_tpu.ops import lbm
+
+W = lbm.weights(E)
+OPP = lbm.opposite(E)
+OPP18 = np.concatenate([OPP, OPP + 9])
+MIRY = mirror_perm(E, 1)
+MIRY18 = np.concatenate([MIRY, MIRY + 9])
+SENTINEL = -999.0
+
+
+def _def() -> ModelDef:
+    d = ModelDef("d2q9_pf_curvature", ndim=2,
+                 description="phase field with CSF curvature surface tension")
+    d.add_densities("f", E)
+    d.add_densities("h", E)
+    d.add_field("phi", dx=(-1, 1), dy=(-1, 1))
+    d.add_stage("BaseIteration", "Run")
+    d.add_stage("CalcPhi", "CalcPhi")
+    d.add_stage("BaseInit", "Init", load_densities=False)
+    d.add_action("Iteration", ("BaseIteration", "CalcPhi"))
+    d.add_action("Init", ("BaseInit", "CalcPhi"))
+    d.add_quantity("Rho", unit="kg/m3")
+    d.add_quantity("U", unit="m/s", vector=True)
+    d.add_quantity("Normal", unit="1/m", vector=True)
+    d.add_quantity("PhaseField", unit="1")
+    d.add_quantity("Curvature", unit="1")
+    d.add_quantity("InterfaceForce", unit="1", vector=True)
+    d.add_setting("omega", comment="one over relaxation time (dense phase)")
+    d.add_setting("omega_l", comment="one over relaxation time, light phase")
+    d.add_setting("nu", default=1 / 6,
+                  derived={"omega": lambda nu: 1.0 / (3 * nu + 0.5)})
+    d.add_setting("Velocity", default=0.0, zonal=True)
+    d.add_setting("Pressure", default=0.0, zonal=True)
+    d.add_setting("W", default=1.0, comment="anti-diffusivity coeff")
+    d.add_setting("M", default=1.0, comment="mobility")
+    d.add_setting("PhaseField", default=1.0, zonal=True)
+    d.add_setting("GravitationX")
+    d.add_setting("GravitationY")
+    d.add_setting("GravitationX_l")
+    d.add_setting("GravitationY_l")
+    d.add_setting("SurfaceTensionDecay", default=100.0)
+    d.add_setting("SurfaceTensionRate", default=0.1)
+    d.add_setting("WettingAngle", default=0.0, zonal=True)
+    d.add_global("PressureLoss", unit="1mPa")
+    d.add_global("OutletFlux", unit="1m2/s")
+    d.add_global("InletFlux", unit="1m2/s")
+    d.add_node_type("NSymmetry", "BOUNDARY")
+    d.add_node_type("SSymmetry", "BOUNDARY")
+    return d
+
+
+def calc_phi(ctx: NodeCtx):
+    """CalcPhi stage: phi = sum(h); walls write the -999 sentinel consumed
+    by the stencil repair; symmetry faces sum the mirrored populations
+    (reference src/d2q9_pf_curvature/Dynamics.c.Rt:329-369)."""
+    h = ctx.group("h")
+    phi = jnp.sum(h, axis=0)
+    phi_sym = jnp.sum(h[jnp.asarray(MIRY)], axis=0)
+    phi = jnp.where(ctx.nt_is("NSymmetry") | ctx.nt_is("SSymmetry"),
+                    phi_sym, phi)
+    phi = jnp.where(ctx.nt_is("Wall"), jnp.asarray(SENTINEL, h.dtype), phi)
+    return {"phi": phi}
+
+
+def _repaired_stencil(ctx: NodeCtx):
+    """Wall-repaired phi stencil (reference InitPhisStencil,
+    src/d2q9_pf_curvature/Dynamics.c.Rt:218-243): a -999 link takes the
+    opposite link's value if valid, else the running mean of valid links
+    (accumulated in reference order)."""
+    phis = [ctx.load("phi", int(E[i, 0]), int(E[i, 1])) for i in range(9)]
+    valid = [p > SENTINEL + 1.0 for p in phis]
+    temp = jnp.zeros_like(phis[0])
+    for j in range(9):
+        temp = (j * temp + jnp.where(valid[j], phis[j], temp)) / (j + 1.0)
+    rphis = []
+    for j in range(9):
+        opp = int(OPP[j])
+        fallback = jnp.where(valid[opp], phis[opp], temp)
+        rphis.append(jnp.where(valid[j], phis[j], fallback))
+    return rphis
+
+
+def _grad_phi(rphis):
+    """Unweighted directional gradient sum_j rphis_j e_j (reference
+    getGradientPhi, src/d2q9_pf_curvature/Dynamics.c.Rt:91-117)."""
+    gx = sum(float(E[j, 0]) * rphis[j] for j in range(9) if E[j, 0])
+    gy = sum(float(E[j, 1]) * rphis[j] for j in range(9) if E[j, 1])
+    return gx, gy
+
+
+def _normal(rphis):
+    gx, gy = _grad_phi(rphis)
+    ln = jnp.sqrt(gx * gx + gy * gy)
+    safe = jnp.where(ln > 0, ln, 1.0)
+    return (jnp.where(ln > 0, gx / safe, 0.0),
+            jnp.where(ln > 0, gy / safe, 0.0))
+
+
+def _curvature(ctx: NodeCtx, rphis):
+    """curv = (lap(phi) - 2 phi (16 phi^2 - 4) W^2) / ((4 phi^2 - 1) W)
+    (reference getCurvature, src/d2q9_pf_curvature/Dynamics.c.Rt:247-287);
+    laplacian = 3 (mean_j phi_j - phi_0)."""
+    w = ctx.setting("W")
+    laplace = 3.0 * (sum(rphis) / 9.0 - rphis[0])
+    phi0 = ctx.load("phi")
+    ln = (4.0 * phi0 * phi0 - 1.0) * w
+    # The reference guards only ln == 0 (Dynamics.c.Rt:280-284), which is
+    # enough in f32 where 4 phi^2 - 1 underflows to exactly 0 in the +-1/2
+    # bulk; in f64 roundoff leaves ln ~ 1e-15 there and the 0/0 amplifies
+    # round-off noise beyond what the exp(-Decay phi^2) factor can absorb.
+    # Thresholding is the f64-faithful version of the same guard: at a real
+    # interface |ln| ~ W x O(1), orders of magnitude above it.
+    dead = jnp.abs(ln) < 1e-6
+    safe = jnp.where(dead, 1.0, ln)
+    curv = (laplace - 2.0 * phi0 * (16.0 * phi0 * phi0 - 4.0) * w * w) / safe
+    return jnp.where(dead, 0.0, curv)
+
+
+def _force(ctx: NodeCtx, pf):
+    """Surface tension + phase-interpolated gravity (reference getF,
+    src/d2q9_pf_curvature/Dynamics.c.Rt:157-181).  ``pf`` is sum(h)."""
+    rphis = _repaired_stencil(ctx)
+    nx, ny = _normal(rphis)
+    curv = _curvature(ctx, rphis)
+    decay = jnp.exp(-ctx.setting("SurfaceTensionDecay") * pf * pf)
+    rate = ctx.setting("SurfaceTensionRate")
+    fx = rate * curv * nx * decay
+    fy = rate * curv * ny * decay
+    gx = ctx.setting("GravitationX")
+    gy = ctx.setting("GravitationY")
+    gxl = ctx.setting("GravitationX_l")
+    gyl = ctx.setting("GravitationY_l")
+    fx = fx + gxl - (pf - 0.5) * (gx - gxl)
+    fy = fy + gyl - (pf - 0.5) * (gy - gyl)
+    return fx, fy, (nx, ny)
+
+
+def _boundaries(ctx: NodeCtx, fh: jnp.ndarray) -> jnp.ndarray:
+    vel = ctx.setting("Velocity")
+    den = 1.0 + 3.0 * ctx.setting("Pressure")
+    pf_set = ctx.setting("PhaseField")
+
+    def zou(kind, side, set_h):
+        def apply(fh):
+            f = _zou_he_x(fh[:9], vel if kind == "velocity" else den,
+                          kind, side)
+            h = fh[9:]
+            if set_h:
+                # pressure inlets/outlets also pin the phase field to its
+                # zonal setting at the Zou/He velocity (reference
+                # WPressure/EPressure, Dynamics.c.Rt:416-437)
+                dt = f.dtype
+                rho = jnp.sum(f, axis=0)
+                ux = jnp.tensordot(jnp.asarray(E[:, 0], dt), f, axes=1) / rho
+                uy = jnp.tensordot(jnp.asarray(E[:, 1], dt), f, axes=1) / rho
+                pf = jnp.broadcast_to(pf_set, rho.shape).astype(dt)
+                h = lbm.equilibrium(E, W, pf, (ux, uy))
+            return jnp.concatenate([f, h])
+        return apply
+
+    return ctx.boundary_case(fh, {
+        ("Wall", "Solid"): lambda s: s[jnp.asarray(OPP18)],
+        "EVelocity": zou("velocity", "E", False),
+        "WPressure": zou("pressure", "W", True),
+        "WVelocity": zou("velocity", "W", False),
+        "EPressure": zou("pressure", "E", True),
+        ("NSymmetry", "SSymmetry"): lambda s: s[jnp.asarray(MIRY18)],
+    })
+
+
+def _heq(pf, n, u, bh):
+    base = lbm.equilibrium(E, W, pf, u)
+    dt = base.dtype
+    en = jnp.stack([jnp.asarray(float(E[i, 0]), dt) * n[0]
+                    + jnp.asarray(float(E[i, 1]), dt) * n[1]
+                    for i in range(9)])
+    wi = jnp.asarray(W, dt).reshape((9,) + (1,) * pf.ndim)
+    return base + bh * wi * en
+
+
+def run(ctx: NodeCtx) -> jnp.ndarray:
+    fh = jnp.concatenate([ctx.group("f"), ctx.group("h")])
+    fh = _boundaries(ctx, fh)
+    f, h = fh[:9], fh[9:]
+    dt = f.dtype
+
+    pf = jnp.sum(h, axis=0)
+    fx, fy, n = _force(ctx, pf)
+
+    # phase-interpolated relaxation rate (reference CollisionMRT,
+    # Dynamics.c.Rt:505: gamma = 1 - (omega_l - (pf-1/2)(omega - omega_l)))
+    omega_eff = ctx.setting("omega_l") \
+        - (pf - 0.5) * (ctx.setting("omega") - ctx.setting("omega_l"))
+    rho = jnp.sum(f, axis=0)
+    jx = jnp.tensordot(jnp.asarray(E[:, 0], dt), f, axes=1)
+    jy = jnp.tensordot(jnp.asarray(E[:, 1], dt), f, axes=1)
+    feq = lbm.equilibrium(E, W, rho, (jx / rho, jy / rho))
+    # force enters the momentum directly (J += F, Dynamics.c.Rt:523-525)
+    feq2 = lbm.equilibrium(E, W, rho, ((jx + fx) / rho, (jy + fy) / rho))
+    fc = feq2 + (1.0 - omega_eff) * (f - feq)
+
+    # h relaxes toward Heq at the momentum-like velocity J + 1.5 F — the
+    # reference updates Jx += F.x then uses u = Jx + 0.5 F.x, un-normalized
+    # by rho (Dynamics.c.Rt:537-549); rho ~ 1 in this model's regime
+    uh = (jx + 1.5 * fx, jy + 1.5 * fy)
+    omega_ph = 1.0 / (3.0 * ctx.setting("M") + 0.5)
+    bh = 3.0 * ctx.setting("M") * (1.0 - 4.0 * pf * pf) * ctx.setting("W")
+    hc = (1.0 - omega_ph) * h + omega_ph * _heq(pf, n, uh, bh)
+
+    coll = ctx.nt_in_group("COLLISION")[None]
+    f = jnp.where(coll, fc, f)
+    h = jnp.where(coll, hc, h)
+    return ctx.store({"f": f, "h": h})
+
+
+def init(ctx: NodeCtx) -> jnp.ndarray:
+    shape = ctx.flags.shape
+    dt = ctx._fields.dtype
+    rho = jnp.broadcast_to(1.0 + 3.0 * ctx.setting("Pressure"),
+                           shape).astype(dt)
+    ux = jnp.broadcast_to(ctx.setting("Velocity"), shape).astype(dt)
+    uy = jnp.zeros(shape, dt)
+    pf = jnp.broadcast_to(ctx.setting("PhaseField"), shape).astype(dt)
+    f = lbm.equilibrium(E, W, rho, (ux, uy))
+    h = lbm.equilibrium(E, W, pf, (ux, uy))
+    return ctx.store({"f": f, "h": h})
+
+
+def get_u(ctx: NodeCtx) -> jnp.ndarray:
+    f = ctx.group("f")
+    dt = f.dtype
+    rho = jnp.where(ctx.nt_in_group("BOUNDARY"),
+                    1.0 + 3.0 * ctx.setting("Pressure"),
+                    jnp.sum(f, axis=0))
+    pf = jnp.sum(ctx.group("h"), axis=0)
+    fx, fy, _ = _force(ctx, pf)
+    ux = (jnp.tensordot(jnp.asarray(E[:, 0], dt), f, axes=1) + 0.5 * fx) / rho
+    uy = (jnp.tensordot(jnp.asarray(E[:, 1], dt), f, axes=1) + 0.5 * fy) / rho
+    return jnp.stack([ux, uy, jnp.zeros_like(ux)])
+
+
+def get_curvature(ctx: NodeCtx) -> jnp.ndarray:
+    return _curvature(ctx, _repaired_stencil(ctx))
+
+
+def get_normal(ctx: NodeCtx) -> jnp.ndarray:
+    nx, ny = _normal(_repaired_stencil(ctx))
+    return jnp.stack([nx, ny, jnp.zeros_like(nx)])
+
+
+def get_iforce(ctx: NodeCtx) -> jnp.ndarray:
+    rphis = _repaired_stencil(ctx)
+    nx, ny = _normal(rphis)
+    curv = _curvature(ctx, rphis)
+    pf = jnp.sum(ctx.group("h"), axis=0)
+    decay = jnp.exp(-ctx.setting("SurfaceTensionDecay") * pf * pf)
+    return jnp.stack([curv * nx * decay, curv * ny * decay,
+                      jnp.zeros_like(curv)])
+
+
+def build():
+    return _def().finalize().bind(
+        run=run, init=init,
+        stages={"CalcPhi": calc_phi},
+        quantities={
+            "Rho": lambda c: jnp.sum(c.group("f"), axis=0),
+            "U": get_u,
+            "Normal": get_normal,
+            "PhaseField": lambda c: jnp.sum(c.group("h"), axis=0),
+            "Curvature": get_curvature,
+            "InterfaceForce": get_iforce,
+        })

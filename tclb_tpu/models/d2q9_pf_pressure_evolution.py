@@ -1,0 +1,357 @@
+"""d2q9_pf_pressureEvolution — Fakhari/Geier/Lee mass-conserving two-phase
+LBM in pressure-evolution form.
+
+Behavioral parity target: reference model ``d2q9_pf_pressureEvolution``
+(reference src/d2q9_pf_pressureEvolution/Dynamics.R, Dynamics.c.Rt — "A
+mass-conserving LBM with dynamic grid refinement for immiscible two-phase
+flows", maintained by T. Mitchell).  The hydrodynamic population is the
+pressure-shifted ``g-bar`` distribution: its equilibrium is
+``Gamma_i rho/3 + w_i (p - rho/3)`` with the pressure recovered as
+``p = sum(f) + (rho_h-rho_l)(grad phi . u)/6`` (Dynamics.c.Rt:105-110);
+interface and body-force terms are the half-trapezoid corrections of the
+reference (:283-335).  Relaxation is classical-matrix MRT with settings
+S0..S6 and a phase-interpolated ``1/(tau+1/2)`` on the stress pair
+(:296-322).  The phase field streams on ``h`` with mobility relaxation and a
+``PhaseF`` Field provides the +-2 gradient stencil (:151-160).
+"""
+
+from __future__ import annotations
+
+import numpy as np
+import jax.numpy as jnp
+
+from tclb_tpu.core.lattice import NodeCtx
+from tclb_tpu.core.registry import ModelDef
+from tclb_tpu.models.d2q9 import E
+from tclb_tpu.ops import lbm
+
+W = lbm.weights(E)
+OPP = lbm.opposite(E)
+OPP18 = np.concatenate([OPP, OPP + 9])
+
+# classical (integer Lallemand-Luo) d2q9 moment rows: rho, e, eps, jx, qx,
+# jy, qy, pxx, pxy (reference CollisionMRT matrix, Dynamics.c.Rt:298-307)
+M_CLASSIC = np.array([
+    [1, 1, 1, 1, 1, 1, 1, 1, 1],
+    [-4, -1, -1, -1, -1, 2, 2, 2, 2],
+    [4, -2, -2, -2, -2, 1, 1, 1, 1],
+    [0, 1, 0, -1, 0, 1, -1, -1, 1],
+    [0, -2, 0, 2, 0, 1, -1, -1, 1],
+    [0, 0, 1, 0, -1, 1, 1, -1, -1],
+    [0, 0, -2, 0, 2, 1, 1, -1, -1],
+    [0, 1, -1, 1, -1, 0, 0, 0, 0],
+    [0, 0, 0, 0, 0, 1, -1, 1, -1],
+], dtype=np.float64)
+
+
+def _def() -> ModelDef:
+    d = ModelDef("d2q9_pf_pressureEvolution", ndim=2,
+                 description="pressure-evolution phase-field two-phase LBM")
+    d.add_densities("f", E)
+    d.add_densities("h", E)
+    d.add_field("PhaseF", dx=(-2, 2), dy=(-2, 2), group="phi")
+    d.add_stage("PhaseInit", "Init", load_densities=False)
+    d.add_stage("BaseInit", "Init_distributions", load_densities=False)
+    d.add_stage("calcPhase", "calcPhaseF")
+    d.add_stage("BaseIter", "Run")
+    d.add_action("Iteration", ("BaseIter", "calcPhase"))
+    d.add_action("Init", ("PhaseInit", "BaseInit", "calcPhase"))
+    d.add_quantity("Rho", unit="kg/m3")
+    d.add_quantity("PhaseField", unit="1")
+    d.add_quantity("U", unit="m/s", vector=True)
+    d.add_quantity("P", unit="Pa")
+    d.add_quantity("Mu", unit="1")
+    d.add_quantity("InterfaceForce", unit="N", vector=True)
+    d.add_setting("Density_h", default=1.0, comment="high density")
+    d.add_setting("Density_l", default=1.0, comment="low density")
+    d.add_setting("PhaseField_h", default=1.0)
+    d.add_setting("PhaseField_l", default=0.0)
+    d.add_setting("PhaseField", default=0.0, zonal=True)
+    d.add_setting("W", default=4.0, comment="interface width")
+    d.add_setting("M", default=0.05, comment="mobility")
+    d.add_setting("sigma", default=1e-3, comment="surface tension")
+    d.add_setting("omega_l", default=1.0)
+    d.add_setting("omega_h", default=1.0)
+    d.add_setting("nu_l", default=1 / 6,
+                  derived={"omega_l": lambda nu: 1.0 / (3 * nu)})
+    d.add_setting("nu_h", default=1 / 6,
+                  derived={"omega_h": lambda nu: 1.0 / (3 * nu)})
+    for i in range(7):
+        d.add_setting(f"S{i}", default=1.0, comment="relaxation param")
+    d.add_setting("VelocityX", default=0.0, zonal=True)
+    d.add_setting("VelocityY", default=0.0, zonal=True)
+    d.add_setting("Pressure", default=0.0, zonal=True)
+    d.add_setting("GravitationX")
+    d.add_setting("GravitationY")
+    d.add_setting("BuoyancyX")
+    d.add_setting("BuoyancyY")
+    d.add_setting("GmatchedX")
+    d.add_setting("GmatchedY")
+    d.add_global("PressureLoss", unit="1mPa")
+    d.add_global("OutletFlux", unit="1m2/s")
+    d.add_global("InletFlux", unit="1m2/s")
+    d.add_global("TotalDensity", unit="1kg/m3",
+                 comment="mass conservation check")
+    return d
+
+
+# --------------------------------------------------------------------- #
+# helpers over the PhaseF stencil
+# --------------------------------------------------------------------- #
+
+
+def _phase(ctx, dx=0, dy=0):
+    return ctx.load("PhaseF", dx, dy)
+
+
+def _rho_of(ctx, pf):
+    rl = ctx.setting("Density_l")
+    rh = ctx.setting("Density_h")
+    pl = ctx.setting("PhaseField_l")
+    ph = ctx.setting("PhaseField_h")
+    return rl + (rh - rl) * (pf - pl) / (ph - pl)
+
+
+def _grad_phi(ctx):
+    """Isotropic central gradient (reference calcGradPhi,
+    Dynamics.c.Rt:151-157)."""
+    gx = (_phase(ctx, 1, 0) - _phase(ctx, -1, 0)) / 3.0 \
+        + (_phase(ctx, 1, 1) - _phase(ctx, -1, -1)
+           + _phase(ctx, 1, -1) - _phase(ctx, -1, 1)) / 12.0
+    gy = (_phase(ctx, 0, 1) - _phase(ctx, 0, -1)) / 3.0 \
+        + (_phase(ctx, 1, 1) - _phase(ctx, -1, -1)
+           + _phase(ctx, -1, 1) - _phase(ctx, 1, -1)) / 12.0
+    return gx, gy
+
+
+def _mu(ctx):
+    """Chemical potential with the 9-point laplacian (reference getMu,
+    Dynamics.c.Rt:111-120)."""
+    pf = _phase(ctx)
+    pl = ctx.setting("PhaseField_l")
+    ph = ctx.setting("PhaseField_h")
+    pavg = 0.5 * (pl + ph)
+    w = ctx.setting("W")
+    sig = ctx.setting("sigma")
+    lp = (_phase(ctx, 1, 1) + _phase(ctx, -1, 1)
+          + _phase(ctx, 1, -1) + _phase(ctx, -1, -1)
+          + 4.0 * (_phase(ctx, 1, 0) + _phase(ctx, -1, 0)
+                   + _phase(ctx, 0, 1) + _phase(ctx, 0, -1))
+          - 20.0 * pf) / 6.0
+    return (4.0 * (12.0 * sig / w) * (pf - pl) * (pf - ph) * (pf - pavg)
+            - 1.5 * sig * w * lp)
+
+
+def _body_force(ctx, rho, pf):
+    """(rho-rho_h)*Buoyancy + rho*Gravitation + (1-pf)*rho_h*Gmatched
+    (reference Dynamics.c.Rt:95-96)."""
+    rh = ctx.setting("Density_h")
+    fbx = (rho - rh) * ctx.setting("BuoyancyX") \
+        + rho * ctx.setting("GravitationX") \
+        + (1.0 - pf) * rh * ctx.setting("GmatchedX")
+    fby = (rho - rh) * ctx.setting("BuoyancyY") \
+        + rho * ctx.setting("GravitationY") \
+        + (1.0 - pf) * rh * ctx.setting("GmatchedY")
+    return fbx, fby
+
+
+def _rc(ctx):
+    """Directional central differences Rc_i = (phi(e_i)-phi(-e_i))/2
+    (reference Dynamics.c.Rt:264-272)."""
+    out = [jnp.zeros_like(_phase(ctx))]
+    for i in range(1, 9):
+        dx, dy = int(E[i, 0]), int(E[i, 1])
+        out.append(0.5 * (_phase(ctx, dx, dy) - _phase(ctx, -dx, -dy)))
+    return out
+
+
+def _gamma(u):
+    """Gamma_i = feq_i/rho (second-order equilibrium at unit density)."""
+    one = jnp.ones_like(u[0])
+    return lbm.equilibrium(E, W, one, u)
+
+
+def _correction_terms(ctx, gamma, u, grad, fb, mu, rc):
+    """Interface + body-force correction stacks (reference
+    Dynamics.c.Rt:285-294): iface_i = ((Gamma_i - w_i)(rho_h-rho_l)/3 +
+    mu Gamma_i)(Rc_i - u.grad); body_i = Gamma_i ((e_i-u).Fb)."""
+    dt = gamma.dtype
+    drho = ctx.setting("Density_h") - ctx.setting("Density_l")
+    ugrad = u[0] * grad[0] + u[1] * grad[1]
+    iface, body = [], []
+    for i in range(9):
+        gi = gamma[i]
+        iface.append(((gi - float(W[i])) * drho / 3.0 + mu * gi)
+                     * (rc[i] - ugrad))
+        body.append(gi * ((float(E[i, 0]) - u[0]) * fb[0]
+                          + (float(E[i, 1]) - u[1]) * fb[1]))
+    return jnp.stack(iface).astype(dt), jnp.stack(body).astype(dt)
+
+
+def _normal(grad):
+    gn = jnp.sqrt(grad[0] * grad[0] + grad[1] * grad[1])
+    safe = jnp.where(gn > 0, gn, 1.0)
+    return (jnp.where(gn > 0, grad[0] / safe, 0.0),
+            jnp.where(gn > 0, grad[1] / safe, 0.0))
+
+
+def _heq(ctx, pf, gamma, n):
+    """h equilibrium: Gamma_i pf + theta w_i e.n with
+    theta = 3M(1-4(pf-pfavg)^2)/W (reference Dynamics.c.Rt:338-349)."""
+    dt = gamma.dtype
+    pavg = 0.5 * (ctx.setting("PhaseField_l") + ctx.setting("PhaseField_h"))
+    theta = (3.0 * ctx.setting("M")) \
+        * (1.0 - 4.0 * (pf - pavg) * (pf - pavg)) / ctx.setting("W")
+    en = jnp.stack([jnp.asarray(float(E[i, 0]), dt) * n[0]
+                    + jnp.asarray(float(E[i, 1]), dt) * n[1]
+                    for i in range(9)])
+    wi = jnp.asarray(W, dt).reshape((9,) + (1,) * pf.ndim)
+    return gamma * pf + theta * wi * en
+
+
+# --------------------------------------------------------------------- #
+# stages
+# --------------------------------------------------------------------- #
+
+
+def phase_init(ctx: NodeCtx):
+    """PhaseInit stage: seed PhaseF from the zonal setting so gradients are
+    available to Init_distributions (reference Init, Dynamics.c.Rt:163-166)."""
+    dt = ctx._fields.dtype
+    pf = jnp.broadcast_to(ctx.setting("PhaseField"),
+                          ctx.flags.shape).astype(dt)
+    return {"PhaseF": pf}
+
+
+def calc_phase(ctx: NodeCtx):
+    """calcPhase stage: PhaseF = sum of the streamed h populations
+    (reference calcPhaseF, Dynamics.c.Rt:158-160)."""
+    return {"PhaseF": jnp.sum(ctx.group("h"), axis=0)}
+
+
+def init_distributions(ctx: NodeCtx) -> jnp.ndarray:
+    """BaseInit stage (reference Init_distributions, Dynamics.c.Rt:167-212):
+    h at equilibrium, g-bar shifted to zero minus half corrections."""
+    dt = ctx._fields.dtype
+    pf = _phase(ctx)
+    grad = _grad_phi(ctx)
+    n = _normal(grad)
+    mu = _mu(ctx)
+    rho = _rho_of(ctx, pf)
+    ctx.add_global("TotalDensity", rho)
+    u = (jnp.broadcast_to(ctx.setting("VelocityX"), pf.shape).astype(dt),
+         jnp.broadcast_to(ctx.setting("VelocityY"), pf.shape).astype(dt))
+    fb = _body_force(ctx, rho, pf)
+    gamma = _gamma(u)
+    rc = _rc(ctx)
+    iface, body = _correction_terms(ctx, gamma, u, grad, fb, mu, rc)
+    h = _heq(ctx, pf, gamma, n)
+    f = -0.5 * iface - 0.5 * body
+    return ctx.store({"f": f, "h": h})
+
+
+def run(ctx: NodeCtx) -> jnp.ndarray:
+    fh = jnp.concatenate([ctx.group("f"), ctx.group("h")])
+    # only bounce-back walls: the reference's velocity/pressure BC bodies
+    # are empty (Dynamics.c.Rt:362-377)
+    fh = ctx.boundary_case(fh, {
+        ("Wall", "Solid"): lambda s: s[jnp.asarray(OPP18)],
+    })
+    f, h = fh[:9], fh[9:]
+    dt = f.dtype
+
+    pf = _phase(ctx)
+    rho = _rho_of(ctx, pf)
+    ctx.add_global("TotalDensity", rho, where=ctx.nt_is("MRT"))
+    mu = _mu(ctx)
+    fb = _body_force(ctx, rho, pf)
+    grad = _grad_phi(ctx)
+    jx = jnp.tensordot(jnp.asarray(E[:, 0], dt), f, axes=1)
+    jy = jnp.tensordot(jnp.asarray(E[:, 1], dt), f, axes=1)
+    u = ((3.0 / rho) * (jx + (0.5 / 3.0) * (mu * grad[0] + fb[0])),
+         (3.0 / rho) * (jy + (0.5 / 3.0) * (mu * grad[1] + fb[1])))
+    p = jnp.sum(f, axis=0) \
+        + (ctx.setting("Density_h") - ctx.setting("Density_l")) \
+        * (grad[0] * u[0] + grad[1] * u[1]) / 6.0
+
+    gamma = _gamma(u)
+    rc = _rc(ctx)
+    iface, body = _correction_terms(ctx, gamma, u, grad, fb, mu, rc)
+    wi = jnp.asarray(W, dt).reshape((9,) + (1,) * pf.ndim)
+    g_bar_eq = gamma * rho / 3.0 + wi * (p - rho / 3.0)
+    r = f - (g_bar_eq - 0.5 * iface - 0.5 * body)
+
+    # classical-matrix MRT relaxation with phase-interpolated stress rate
+    # (reference Dynamics.c.Rt:296-327)
+    pl = ctx.setting("PhaseField_l")
+    ph = ctx.setting("PhaseField_h")
+    tau = 1.0 / (ctx.setting("omega_l")
+                 + (ctx.setting("omega_h") - ctx.setting("omega_l"))
+                 * (pf - pl) / (ph - pl))
+    s_stress = 1.0 / (tau + 0.5)
+    m = lbm.moments(M_CLASSIC, r)
+    rates = [ctx.setting(f"S{i}") for i in range(7)]
+    m = jnp.stack([m[i] * rates[i] for i in range(7)]
+                  + [m[7] * s_stress, m[8] * s_stress])
+    r = lbm.from_moments(M_CLASSIC, m)
+    fc = f - r + iface + body
+
+    # phase-field collision (reference Dynamics.c.Rt:338-349)
+    n = _normal(grad)
+    omega_ph = 1.0 / (3.0 * ctx.setting("M") + 0.5)
+    hc = h - omega_ph * (h - _heq(ctx, pf, gamma, n))
+
+    coll = ctx.nt_is("MRT")[None]
+    f = jnp.where(coll, fc, f)
+    h = jnp.where(coll, hc, h)
+    return ctx.store({"f": f, "h": h})
+
+
+# --------------------------------------------------------------------- #
+# quantities
+# --------------------------------------------------------------------- #
+
+
+def get_u(ctx: NodeCtx) -> jnp.ndarray:
+    f = ctx.group("f")
+    dt = f.dtype
+    pf = _phase(ctx)
+    rho = _rho_of(ctx, pf)
+    mu = _mu(ctx)
+    fb = _body_force(ctx, rho, pf)
+    grad = _grad_phi(ctx)
+    jx = jnp.tensordot(jnp.asarray(E[:, 0], dt), f, axes=1)
+    jy = jnp.tensordot(jnp.asarray(E[:, 1], dt), f, axes=1)
+    ux = (3.0 / rho) * (jx + (0.5 / 3.0) * (mu * grad[0] + fb[0]))
+    uy = (3.0 / rho) * (jy + (0.5 / 3.0) * (mu * grad[1] + fb[1]))
+    return jnp.stack([ux, uy, jnp.zeros_like(ux)])
+
+
+def get_p(ctx: NodeCtx) -> jnp.ndarray:
+    u = get_u(ctx)
+    grad = _grad_phi(ctx)
+    return jnp.sum(ctx.group("f"), axis=0) \
+        + (ctx.setting("Density_h") - ctx.setting("Density_l")) \
+        * (grad[0] * u[0] + grad[1] * u[1]) / 6.0
+
+
+def get_iforce(ctx: NodeCtx) -> jnp.ndarray:
+    mu = _mu(ctx)
+    grad = _grad_phi(ctx)
+    return jnp.stack([mu * grad[0], mu * grad[1], jnp.zeros_like(mu)])
+
+
+def build():
+    return _def().finalize().bind(
+        run=run, init=init_distributions,
+        stages={"Init": phase_init,
+                "Init_distributions": init_distributions,
+                "calcPhaseF": calc_phase},
+        quantities={
+            "Rho": lambda c: _rho_of(c, _phase(c)),
+            "PhaseField": lambda c: _phase(c),
+            "U": get_u,
+            "P": get_p,
+            "Mu": lambda c: _mu(c),
+            "InterfaceForce": get_iforce,
+        })

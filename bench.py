@@ -39,28 +39,45 @@ def main():
     lat.set_flags(flags)
     lat.init()
 
-    # warmup with the SAME niter: niter is a static jit arg, so a different
-    # value would recompile inside the timed region
-    chunk = min(iters, 500)
-    lat.iterate(chunk)
-    jax.block_until_ready(lat.state.fields)
-    t0 = time.perf_counter()
-    done = 0
-    checksum = 0.0
-    while done < iters:
-        lat.iterate(chunk)
-        # materialize a device->host scalar INSIDE the timed region: a
-        # Python float cannot exist until the step chain actually executed,
-        # so asynchronous-dispatch backends can't fake this (round-1 bench
-        # reported 818x the HBM roofline because block_until_ready returned
-        # before execution on the axon transport)
-        checksum = float(jnp.sum(lat.state.fields))
-        done += chunk
-    dt = time.perf_counter() - t0
-    assert np.isfinite(checksum), \
-        f"simulation blew up inside the timed region (checksum={checksum})"
+    def timed(iterate_fn, state, params, niter):
+        """Time one `niter`-step chunk; returns (mlups, final_state).
+        Materializes a device->host scalar INSIDE the timed region: a Python
+        float cannot exist until the step chain actually executed, so
+        asynchronous-dispatch backends can't fake this (round-1 bench
+        reported 818x the HBM roofline because block_until_ready returned
+        before execution on the axon transport).  One big chunk with one end
+        checksum: the transport costs ~100 ms per checksum round-trip, so
+        per-chunk checksums would bill fixed dispatch latency to the kernel
+        (the number below still conservatively includes ONE such round
+        trip).  Warmup runs the same niter — niter is a static jit arg, a
+        different value would recompile inside the timed region."""
+        state = iterate_fn(state, params, niter)   # warmup / compile
+        float(jnp.sum(state.fields))
+        t0 = time.perf_counter()
+        state = iterate_fn(state, params, niter)
+        checksum = float(jnp.sum(state.fields))
+        dt = time.perf_counter() - t0
+        assert np.isfinite(checksum), \
+            f"simulation blew up inside the timed region ({checksum})"
+        return ny * nx * niter / dt / 1e6, state
 
-    mlups = ny * nx * done / dt / 1e6
+    mlups_xla, _ = timed(lambda s, p, n: lat._iterate(s, p, n),
+                         jax.tree.map(jnp.copy, lat.state), lat.params,
+                         iters)
+
+    # Pallas fused collide-stream path (ops/pallas_d2q9.py) — the tuned
+    # 1R+1W-per-density kernel, the analogue of the reference's RunKernel
+    # (src/LatticeContainer.inc.cpp.Rt:247-266).  ~5x more iterations: the
+    # kernel is ~20x faster than the XLA path, so it needs a longer run to
+    # amortize the same fixed dispatch overhead.
+    mlups_pallas = None
+    from tclb_tpu.ops import pallas_d2q9
+    if pallas_d2q9.supports(m, (ny, nx), jnp.float32):
+        it_p = pallas_d2q9.make_pallas_iterate(m, (ny, nx))
+        mlups_pallas, _ = timed(it_p, jax.tree.map(jnp.copy, lat.state),
+                                lat.params, iters * 5)
+
+    mlups = max(mlups_xla, mlups_pallas or 0.0)
     # HBM roofline: bytes per node update (reference traffic model,
     # src/main.cpp.Rt:126: 1 read + 1 write per density + flag read)
     bytes_per_update = 2 * m.n_storage * 4 + 2
@@ -82,6 +99,8 @@ def main():
         "value": round(mlups, 1),
         "unit": "MLUPS",
         "vs_baseline": round(ratio, 4),
+        "xla_mlups": round(mlups_xla, 1),
+        "pallas_mlups": round(mlups_pallas, 1) if mlups_pallas else None,
     }))
 
 

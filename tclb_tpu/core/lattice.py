@@ -357,11 +357,23 @@ def make_stage_step(model: Model, stage_name: str,
         # kernels atomically add into it, src/Lattice.cu.Rt:383-461);
         # make_action_step zeroes the buffer before its first stage, so a
         # trailing non-global stage (e.g. kuper's CalcPhi) no longer wipes
-        # the objectives the Run stage just computed.
+        # the objectives the Run stage just computed.  SUM globals add;
+        # MAX globals combine with max (the reference's atomicMax path,
+        # src/cross.h:104-132) — adding per-stage maxima would double-count.
+        stage_globals = ctx.reduce_globals()
+        max_rows = [i for i, g in enumerate(model.globals_) if g.op == "MAX"]
+        if max_rows:
+            is_max = jnp.zeros((model.n_globals,), dtype=bool
+                               ).at[jnp.array(max_rows)].set(True)
+            combined = jnp.where(is_max,
+                                 jnp.maximum(state.globals_, stage_globals),
+                                 state.globals_ + stage_globals)
+        else:
+            combined = state.globals_ + stage_globals
         return LatticeState(
             fields=new_fields,
             flags=state.flags,
-            globals_=state.globals_ + ctx.reduce_globals(),
+            globals_=combined,
             iteration=state.iteration,
         )
 

@@ -21,12 +21,10 @@ import jax.numpy as jnp
 from tclb_tpu.core.lattice import NodeCtx
 from tclb_tpu.core.registry import ModelDef
 from tclb_tpu.models.d2q9 import E, _zou_he_x
+from tclb_tpu.models.d2q9_pf import W, OPP, OPP18, _heq, init
 from tclb_tpu.models.family import mirror_perm
 from tclb_tpu.ops import lbm
 
-W = lbm.weights(E)
-OPP = lbm.opposite(E)
-OPP18 = np.concatenate([OPP, OPP + 9])
 MIRY = mirror_perm(E, 1)
 MIRY18 = np.concatenate([MIRY, MIRY + 9])
 SENTINEL = -999.0
@@ -75,14 +73,20 @@ def _def() -> ModelDef:
 
 def calc_phi(ctx: NodeCtx):
     """CalcPhi stage: phi = sum(h); walls write the -999 sentinel consumed
-    by the stencil repair; symmetry faces sum the mirrored populations
-    (reference src/d2q9_pf_curvature/Dynamics.c.Rt:329-369)."""
+    by the stencil repair.  On a symmetry face the populations moving INTO
+    the face are replaced by their y-mirrors before summing, so
+    phi = sum_{ey==0} h + 2 sum_{ey<0} h on SSymmetry (ey>0 on NSymmetry) —
+    reference src/d2q9_pf_curvature/Dynamics.c.Rt:329-360."""
     h = ctx.group("h")
+    dt = h.dtype
     phi = jnp.sum(h, axis=0)
-    phi_sym = jnp.sum(h[jnp.asarray(MIRY)], axis=0)
-    phi = jnp.where(ctx.nt_is("NSymmetry") | ctx.nt_is("SSymmetry"),
-                    phi_sym, phi)
-    phi = jnp.where(ctx.nt_is("Wall"), jnp.asarray(SENTINEL, h.dtype), phi)
+    ey = E[:, 1]
+    tang = jnp.sum(h[jnp.asarray(np.where(ey == 0)[0])], axis=0)
+    south = tang + 2.0 * jnp.sum(h[jnp.asarray(np.where(ey < 0)[0])], axis=0)
+    north = tang + 2.0 * jnp.sum(h[jnp.asarray(np.where(ey > 0)[0])], axis=0)
+    phi = jnp.where(ctx.nt_is("SSymmetry"), south, phi)
+    phi = jnp.where(ctx.nt_is("NSymmetry"), north, phi)
+    phi = jnp.where(ctx.nt_is("Wall"), jnp.asarray(SENTINEL, dt), phi)
     return {"phi": phi}
 
 
@@ -192,14 +196,8 @@ def _boundaries(ctx: NodeCtx, fh: jnp.ndarray) -> jnp.ndarray:
     })
 
 
-def _heq(pf, n, u, bh):
-    base = lbm.equilibrium(E, W, pf, u)
-    dt = base.dtype
-    en = jnp.stack([jnp.asarray(float(E[i, 0]), dt) * n[0]
-                    + jnp.asarray(float(E[i, 1]), dt) * n[1]
-                    for i in range(9)])
-    wi = jnp.asarray(W, dt).reshape((9,) + (1,) * pf.ndim)
-    return base + bh * wi * en
+# _heq and init are shared with d2q9_pf (imported above):
+# the sharpening-flux equilibrium and the uniform-phase init are identical.
 
 
 def run(ctx: NodeCtx) -> jnp.ndarray:
@@ -234,19 +232,6 @@ def run(ctx: NodeCtx) -> jnp.ndarray:
     coll = ctx.nt_in_group("COLLISION")[None]
     f = jnp.where(coll, fc, f)
     h = jnp.where(coll, hc, h)
-    return ctx.store({"f": f, "h": h})
-
-
-def init(ctx: NodeCtx) -> jnp.ndarray:
-    shape = ctx.flags.shape
-    dt = ctx._fields.dtype
-    rho = jnp.broadcast_to(1.0 + 3.0 * ctx.setting("Pressure"),
-                           shape).astype(dt)
-    ux = jnp.broadcast_to(ctx.setting("Velocity"), shape).astype(dt)
-    uy = jnp.zeros(shape, dt)
-    pf = jnp.broadcast_to(ctx.setting("PhaseField"), shape).astype(dt)
-    f = lbm.equilibrium(E, W, rho, (ux, uy))
-    h = lbm.equilibrium(E, W, pf, (ux, uy))
     return ctx.store({"f": f, "h": h})
 
 

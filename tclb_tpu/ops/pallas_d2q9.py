@@ -1,0 +1,315 @@
+"""Pallas fused collide-stream kernel for the d2q9 model family.
+
+This is the TPU equivalent of the reference's tuned CUDA hot loop
+(reference src/LatticeContainer.inc.cpp.Rt:247-266 ``RunKernel`` and
+src/cuda.cu.Rt:236-274 ``RunElement``): one kernel performs pull-streaming,
+boundary handling and MRT collision in a single pass, reading each density
+once from HBM and writing it once — the 1R+1W-per-density traffic model the
+reference prints as GB/s (src/main.cpp.Rt:126).
+
+Design (TPU-first, not a CUDA translation):
+
+* the lattice is tiled into row bands of ``BY`` rows; each grid step DMAs its
+  band plus one wrapped halo row above and below from HBM into VMEM scratch
+  (the reference instead splits storage into 27 margin blocks — here the halo
+  is re-read from the neighbouring band, a 2/BY traffic overhead);
+* pull-streaming is static slicing in y (the halo rows make ``y ± 1`` local)
+  and a lane-roll in x (``pltpu.roll`` — x is the lane dimension and stays
+  whole, exactly like the reference keeps x unsplit for coalescing,
+  src/Solver.cpp.Rt:274);
+* per-node ``switch (NodeType)`` dispatch is mask/select algebra on an int32
+  copy of the flag field (branchless, VPU-friendly);
+* the 9x9 MRT moment transforms are unrolled sparse multiply-adds on the VPU
+  (the matrices are ±small-integer constants; an MXU matmul would waste a
+  128x128 systolic pass on a 9-vector);
+* scalar Settings ride in SMEM; zonal Settings (Velocity/Density) are
+  pre-gathered into per-node planes outside the kernel (they are constant
+  across an ``Iterate`` call — the reference reads them per node from const
+  memory through the zone bits, src/LatticeContainer.h.Rt:89-108).
+
+This path is the reference's "NoGlobals" kernel specialization
+(src/cuda.cu.Rt Globals-mode template parameter): per-iteration Globals are
+not accumulated; ``state.globals_`` is zeroed.  Use the XLA path when
+objectives/monitors are needed per step.
+
+The physics here intentionally mirrors ``models/d2q9.py`` op for op;
+``tests/test_pallas.py`` pins the two paths together.
+"""
+
+from __future__ import annotations
+
+from functools import partial
+from typing import Callable, Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+from tclb_tpu.core.lattice import LatticeState, SimParams
+from tclb_tpu.core.registry import Model
+from tclb_tpu.ops.lbm import equilibrium
+
+_VMEM_SCRATCH_BUDGET = 4 * 1024 * 1024  # bytes for the band scratch
+
+
+def _band_rows(model: Model, ny: int, nx: int) -> Optional[int]:
+    """Largest band height BY that divides ny, is a multiple of 8 (f32
+    sublane tile) and keeps the (n_storage, BY+2, nx) scratch in budget."""
+    import os
+    override = os.environ.get("TCLB_PALLAS_BY")
+    if override:
+        by = int(override)
+        # the override must satisfy the same alignment/budget contract the
+        # kernel's DMA offsets are built on, or Mosaic miscompiles
+        if (by % 8 == 0 and ny % by == 0
+                and model.n_storage * (by + 2) * nx * 4
+                <= _VMEM_SCRATCH_BUDGET * 2):
+            return by
+    best = None
+    for by in range(8, ny + 1, 8):
+        if ny % by:
+            continue
+        if model.n_storage * (by + 2) * nx * 4 > _VMEM_SCRATCH_BUDGET:
+            break
+        best = by
+    return best
+
+
+def supports(model: Model, shape, dtype) -> bool:
+    """Whether the fused kernel can run this configuration."""
+    if model.name not in ("d2q9", "d2q9_new"):
+        return False
+    if len(shape) != 2 or dtype != jnp.float32:
+        return False
+    ny, nx = shape
+    if jax.default_backend() == "tpu" and nx % 128:
+        return False  # x is the lane dimension; keep it tile-aligned
+    return _band_rows(model, ny, nx) is not None
+
+
+def _sparse_matvec(mat: np.ndarray, planes: list) -> list:
+    """y = mat @ planes, unrolled over the (static, mostly-zero) matrix."""
+    out = []
+    for row in mat:
+        acc = None
+        for c, p in zip(row, planes):
+            c = float(c)
+            if c == 0.0:
+                continue
+            t = p if c == 1.0 else (-p if c == -1.0 else c * p)
+            acc = t if acc is None else acc + t
+        out.append(acc if acc is not None else jnp.zeros_like(planes[0]))
+    return out
+
+
+def make_pallas_iterate(model: Model, shape, dtype=jnp.float32,
+                        interpret: Optional[bool] = None) -> Callable:
+    """Build ``iterate(state, params, niter) -> state`` running the fused
+    Pallas collide-stream kernel.  Caller must check :func:`supports` first.
+    """
+    from tclb_tpu.models import d2q9 as mod
+
+    if not supports(model, shape, dtype):
+        raise ValueError(f"pallas path unsupported for {model.name} {shape}")
+    ny, nx = (int(s) for s in shape)
+    by = _band_rows(model, ny, nx)
+    if interpret is None:
+        interpret = jax.default_backend() != "tpu"
+
+    E, W, OPP, M = mod.E, mod.W, mod.OPP, mod.M
+    norm = (M * M).sum(axis=1)
+    Minv = (M / norm[:, None]).T
+    n_storage = model.n_storage
+    f_idx = list(model.groups["f"])
+    bc_idx = list(model.groups["BC"])
+    assert f_idx == list(range(9)), "kernel assumes f planes lead the stack"
+
+    si = model.setting_index
+    i_s3, i_s4, i_s56, i_s78 = si["S3"], si["S4"], si["S56"], si["S78"]
+    i_gx, i_gy = si["GravitationX"], si["GravitationY"]
+    nt = {n: (int(t.mask), int(t.value)) for n, t in model.node_types.items()}
+
+    def _is(flags, name):
+        mask, val = nt[name]
+        return (flags & jnp.int32(mask)) == jnp.int32(val)
+
+    def kernel(sett, f_hbm, flags_ref, vel_ref, den_ref, out_ref,
+               mid2, tops2, bots2, sems):
+        # Scratch is split into an aligned center band plus two 8-row halo
+        # buffers (Mosaic requires VMEM slice offsets AND sizes divisible by
+        # the (8, 128) tile, so a contiguous (by+2)-row window cannot be
+        # DMA'd into one buffer): the y-1 halo row is row 7 of the aligned
+        # 8-row block above the band, the y+1 halo is row 0 of the aligned
+        # block below (by and ny are multiples of 8).  Each buffer is
+        # double-slotted: band i+1's DMA is issued before band i's compute,
+        # overlapping HBM fetch with VPU work across grid steps (the
+        # reference gets the same overlap from its border/interior kernel
+        # split + async memcpy streams, src/Lattice.cu.Rt:424-456).
+        i = pl.program_id(0)
+        n = pl.num_programs(0)
+
+        def band_dmas(slot, band):
+            base = pl.multiple_of(band * jnp.int32(by), 8)
+            top8 = pl.multiple_of(
+                jax.lax.rem(base - jnp.int32(8) + jnp.int32(ny),
+                            jnp.int32(ny)), 8)
+            bot8 = pl.multiple_of(
+                jax.lax.rem(base + jnp.int32(by), jnp.int32(ny)), 8)
+            return (
+                pltpu.make_async_copy(f_hbm.at[:, pl.ds(base, by), :],
+                                      mid2.at[slot], sems.at[slot, 0]),
+                pltpu.make_async_copy(f_hbm.at[:, pl.ds(top8, 8), :],
+                                      tops2.at[slot], sems.at[slot, 1]),
+                pltpu.make_async_copy(f_hbm.at[:, pl.ds(bot8, 8), :],
+                                      bots2.at[slot], sems.at[slot, 2]),
+            )
+
+        slot = jax.lax.rem(i, jnp.int32(2))
+        nxt = jax.lax.rem(i + jnp.int32(1), jnp.int32(2))
+
+        @pl.when(i == 0)
+        def _():
+            for d in band_dmas(jnp.int32(0), i):
+                d.start()
+
+        @pl.when(i + 1 < n)
+        def _():
+            for d in band_dmas(nxt, i + jnp.int32(1)):
+                d.start()
+
+        for d in band_dmas(slot, i):
+            d.wait()
+
+        def mid(k):
+            return mid2[slot, k]
+
+        # pull-streaming: f_i(x) <- f_i(x - e_i); halo rows cover y +- 1,
+        # lane-roll covers the periodic x wrap (matches core.lattice.pull_stream)
+        pulled = []
+        for k in range(9):
+            dx, dy = int(E[k, 0]), int(E[k, 1])
+            if dy == 1:      # value pulled from y - 1
+                sl = jnp.concatenate(
+                    [tops2[slot, k, 7:8, :], mid2[slot, k, 0:by - 1, :]],
+                    axis=0)
+            elif dy == -1:   # value pulled from y + 1
+                sl = jnp.concatenate(
+                    [mid2[slot, k, 1:by, :], bots2[slot, k, 0:1, :]],
+                    axis=0)
+            else:
+                sl = mid(k)
+            pulled.append(pltpu.roll(sl, dx % nx, axis=1) if dx else sl)
+        f = jnp.stack(pulled)
+        flags = flags_ref[:]
+        vel = vel_ref[:]
+        den = den_ref[:]
+
+        # boundary dispatch — same case order as models.d2q9.run so that
+        # overlapping masks resolve identically
+        def apply(mask, new):
+            return jnp.where(mask[None], new, f)
+
+        f = apply(_is(flags, "Wall") | _is(flags, "Solid"),
+                  jnp.stack([f[int(OPP[k])] for k in range(9)]))
+        f = apply(_is(flags, "EVelocity"),
+                  mod._zou_he_x(f, vel, "velocity", "E"))
+        f = apply(_is(flags, "WPressure"),
+                  mod._zou_he_x(f, den, "pressure", "W"))
+        f = apply(_is(flags, "WVelocity"),
+                  mod._zou_he_x(f, vel, "velocity", "W"))
+        f = apply(_is(flags, "EPressure"),
+                  mod._zou_he_x(f, den, "pressure", "E"))
+        f = apply(_is(flags, "TopSymmetry"), mod._symmetry(f, top=True))
+        f = apply(_is(flags, "BottomSymmetry"), mod._symmetry(f, top=False))
+
+        # MRT collision (mirrors models.d2q9._collision_mrt, sans globals)
+        bc0 = mid(bc_idx[0])
+        bc1 = mid(bc_idx[1])
+        rho = sum(f[k] for k in range(9))
+        ux = sum(float(E[k, 0]) * f[k] for k in range(9) if E[k, 0]) / rho
+        uy = sum(float(E[k, 1]) * f[k] for k in range(9) if E[k, 1]) / rho
+        s3, s4 = sett[i_s3], sett[i_s4]
+        s56, s78 = sett[i_s56], sett[i_s78]
+        zero = jnp.zeros_like(rho)
+        omega_m = [zero, zero, zero, s3 + zero, s4 + zero,
+                   s56 + zero, s56 + zero, s78 + zero, s78 + zero]
+        feq = equilibrium(E, W, rho, (ux, uy))
+        fneq = [f[k] - feq[k] for k in range(9)]
+        m_neq = [m * o for m, o in zip(_sparse_matvec(M, fneq), omega_m)]
+        ux2 = ux + sett[i_gx] + bc0
+        uy2 = uy + sett[i_gy] + bc1
+        feq2 = equilibrium(E, W, rho, (ux2, uy2))
+        m_post = [a + b for a, b in
+                  zip(m_neq, _sparse_matvec(M, [feq2[k] for k in range(9)]))]
+        coll = _sparse_matvec(Minv, m_post)
+        mrt = _is(flags, "MRT")
+        for k in range(9):
+            out_ref[k] = jnp.where(mrt, coll[k], f[k])
+        out_ref[bc_idx[0]] = bc0
+        out_ref[bc_idx[1]] = bc1
+
+    grid = (ny // by,)
+    call = pl.pallas_call(
+        kernel,
+        grid=grid,
+        in_specs=[
+            pl.BlockSpec(memory_space=pltpu.SMEM),
+            pl.BlockSpec(memory_space=pl.ANY),
+            pl.BlockSpec((by, nx), lambda i: (i, 0),
+                         memory_space=pltpu.VMEM),
+            pl.BlockSpec((by, nx), lambda i: (i, 0),
+                         memory_space=pltpu.VMEM),
+            pl.BlockSpec((by, nx), lambda i: (i, 0),
+                         memory_space=pltpu.VMEM),
+        ],
+        out_specs=pl.BlockSpec((n_storage, by, nx), lambda i: (0, i, 0),
+                               memory_space=pltpu.VMEM),
+        out_shape=jax.ShapeDtypeStruct((n_storage, ny, nx), dtype),
+        scratch_shapes=[
+            pltpu.VMEM((2, n_storage, by, nx), dtype),
+            pltpu.VMEM((2, n_storage, 8, nx), dtype),
+            pltpu.VMEM((2, n_storage, 8, nx), dtype),
+            pltpu.SemaphoreType.DMA((2, 3)),
+        ],
+        interpret=interpret,
+    )
+
+    i_vel, i_den = si["Velocity"], si["Density"]
+    zshift = model.zone_shift
+
+    @partial(jax.jit, static_argnames=("niter",), donate_argnums=0)
+    def _iterate_jit(state: LatticeState, params: SimParams, niter: int
+                     ) -> LatticeState:
+        flags_i32 = state.flags.astype(jnp.int32)
+        zones = flags_i32 >> zshift
+        vel = params.zone_table[i_vel].astype(dtype)[zones]
+        den = params.zone_table[i_den].astype(dtype)[zones]
+        sett = params.settings.astype(dtype)
+
+        def body(fields, _):
+            return call(sett, fields, flags_i32, vel, den), None
+
+        fields, _ = jax.lax.scan(body, state.fields, None, length=niter)
+        return LatticeState(
+            fields=fields,
+            flags=state.flags,
+            globals_=jnp.zeros_like(state.globals_),
+            iteration=state.iteration + niter,
+        )
+
+    def iterate(state: LatticeState, params: SimParams, niter: int
+                ) -> LatticeState:
+        # the kernel freezes zonal Velocity/Density planes for the whole
+        # call; a <Control> time series changes them per iteration, which
+        # only the XLA path implements (NodeCtx.setting) — reject rather
+        # than silently diverge
+        if params.time_series is not None:
+            raise ValueError(
+                "pallas iterate does not support Control time series; "
+                "use the XLA path for time-dependent zonal settings")
+        return _iterate_jit(state, params, niter)
+
+    return iterate

@@ -1,0 +1,99 @@
+"""Parity of the Pallas fused collide-stream kernel vs the XLA step.
+
+The Pallas path (ops/pallas_d2q9.py) re-expresses the d2q9 hot loop as one
+VMEM-tiled kernel; these tests pin it to the XLA engine path the same way the
+reference pins its CUDA and CPU cross-bindings to shared goldens (SURVEY §4.1:
+GPU compile-tested, CPU run-tested, goldens backend-agnostic).  On CPU the
+kernel runs in interpreter mode; on TPU the identical trace is compiled.
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from tclb_tpu.core.lattice import Lattice
+from tclb_tpu.models import get_model
+from tclb_tpu.ops import pallas_d2q9
+
+
+def _make_lattice(ny=64, nx=128, **settings):
+    m = get_model("d2q9")
+    lat = Lattice(m, (ny, nx), dtype=jnp.float32,
+                  settings={"nu": 0.05, "Velocity": 0.03, **settings})
+    return m, lat
+
+
+def _karman_flags(m, ny, nx):
+    flags = np.full((ny, nx), m.flag_for("MRT"), dtype=np.uint16)
+    flags[:, 0] = m.flag_for("WVelocity", "MRT")
+    flags[:, -1] = m.flag_for("EPressure", "MRT")
+    flags[0, :] = m.flag_for("Wall")
+    flags[-1, :] = m.flag_for("Wall")
+    flags[ny // 3:2 * ny // 3, nx // 8:nx // 4] = m.flag_for("Wall")
+    return flags
+
+
+def test_supports():
+    m = get_model("d2q9")
+    assert pallas_d2q9.supports(m, (64, 128), jnp.float32)
+    assert not pallas_d2q9.supports(m, (64, 128), jnp.float64)
+    assert not pallas_d2q9.supports(m, (7, 128), jnp.float32)
+    assert not pallas_d2q9.supports(get_model("d2q9_SRT"), (64, 128),
+                                    jnp.float32)
+
+
+@pytest.mark.parametrize("case", ["karman", "periodic_force", "symmetry"])
+def test_pallas_matches_xla(case):
+    ny, nx = 64, 128
+    m, lat = _make_lattice(ny, nx)
+    if case == "karman":
+        flags = _karman_flags(m, ny, nx)
+    elif case == "periodic_force":
+        lat.set_setting("GravitationX", 1e-5)
+        flags = np.full((ny, nx), m.flag_for("MRT"), dtype=np.uint16)
+        flags[0, :] = m.flag_for("Wall")
+        flags[-1, :] = m.flag_for("Wall")
+    else:
+        flags = np.full((ny, nx), m.flag_for("MRT"), dtype=np.uint16)
+        flags[0, :] = m.flag_for("BottomSymmetry")
+        flags[-1, :] = m.flag_for("TopSymmetry")
+        flags[:, 0] = m.flag_for("WPressure", "MRT")
+        flags[:, -1] = m.flag_for("EVelocity", "MRT")
+    lat.set_flags(flags)
+    lat.init()
+
+    niter = 20
+    it_pallas = pallas_d2q9.make_pallas_iterate(m, (ny, nx))
+    s_pallas = it_pallas(
+        jax.tree.map(jnp.copy, lat.state), lat.params, niter)
+    lat.iterate(niter)
+
+    a = np.asarray(lat.state.fields)
+    b = np.asarray(s_pallas.fields)
+    assert np.isfinite(b).all()
+    # identical math, different summation order: f32 round-off only
+    np.testing.assert_allclose(b, a, rtol=2e-5, atol=2e-6)
+    assert int(s_pallas.iteration) == int(lat.state.iteration)
+
+
+def test_pallas_zonal_settings():
+    """Zonal Velocity read through the flag zone bits must match the XLA
+    path's per-node gather (reference ZoneSetting accessor,
+    src/LatticeContainer.h.Rt:89-108)."""
+    ny, nx = 32, 128
+    m, lat = _make_lattice(ny, nx)
+    flags = _karman_flags(m, ny, nx)
+    # inlet rows split into two settings zones with different velocities
+    flags[:ny // 2, 0] = m.flag_for("WVelocity", "MRT", zone=1)
+    lat.set_flags(flags)
+    lat.set_setting("Velocity", 0.01, zone=1)
+    lat.init()
+
+    it_pallas = pallas_d2q9.make_pallas_iterate(m, (ny, nx))
+    s_pallas = it_pallas(
+        jax.tree.map(jnp.copy, lat.state), lat.params, 10)
+    lat.iterate(10)
+    np.testing.assert_allclose(np.asarray(s_pallas.fields),
+                               np.asarray(lat.state.fields),
+                               rtol=2e-5, atol=2e-6)

@@ -26,11 +26,17 @@ _REGISTRY: dict[str, str] = {
     "d3q27_BGK": "tclb_tpu.models.d3q27_bgk",
     "d3q27_BGK_galcor": "tclb_tpu.models.d3q27_bgk:build_galcor",
     "d3q27_cumulant": "tclb_tpu.models.d3q27_cumulant",
+    "d3q27_viscoplastic": "tclb_tpu.models.d3q27_viscoplastic",
     "d2q9_new": "tclb_tpu.models.d2q9_new",
     "d2q9_heat": "tclb_tpu.models.d2q9_heat",
     "d2q9_hb": "tclb_tpu.models.d2q9_hb",
     "d2q9_diff": "tclb_tpu.models.d2q9_diff",
     "d2q9_kuper": "tclb_tpu.models.d2q9_kuper",
+    "d2q9_lee": "tclb_tpu.models.d2q9_lee",
+    "d2q9_npe_guo": "tclb_tpu.models.d2q9_npe_guo",
+    "d2q9_poison_boltzmann": "tclb_tpu.models.d2q9_poison_boltzmann",
+    "d2q9_pp_LBL": "tclb_tpu.models.d2q9_pp_lbl",
+    "d2q9_pp_MCMP": "tclb_tpu.models.d2q9_pp_mcmp",
     "d2q9_pf": "tclb_tpu.models.d2q9_pf",
     "d2q9_pf_curvature": "tclb_tpu.models.d2q9_pf_curvature",
     "d2q9_pf_pressureEvolution":

@@ -1,0 +1,345 @@
+"""d2q9_lee — Lee–Lin-style multiphase with potential-form forcing.
+
+Behavioral parity target: reference model ``d2q9_lee``
+(reference src/d2q9_lee/Dynamics.R, Dynamics.c.Rt).  Single d2q9 population
+plus two stencil-2 Fields: ``rho`` (recomputed per step with BC overrides,
+CalcRho :199-221) and the chemical potential ``nu``
+(``mu0 - Kappa lap(rho)`` with the double-well
+``mu0 = 2 Beta (r - rho_l)(r - rho_v)(2r - rho_v - rho_l)``, CalcNu
+:335-343).  The collision applies Lee's mixed-difference forcing: per
+direction, a biased ("B", second-order one-sided using the distance-2
+stencil) and a central ("C") projection
+``fX_i = cs2 nabla^X_i rho - rho nabla^X_i nu + e_i.G - u.G``
+(fillF :356-400), entering as ``feq``-weighted source terms — the central
+form inside the pre-collision velocity/equilibrium shift, the biased form
+after relaxation (CollisionBGK :430-480).
+
+The reference's ``fillF`` reads the ``fC`` array in its velocity update
+*before* assigning it (file-scope scratch, undefined on kernel entry); we
+implement the self-consistent interpretation — velocity from bare momentum
+for the projections' ``u.G`` work term, then the half-``fC`` shift — which
+coincides with the reference whenever G = 0 (the gradient parts do not
+depend on u at all).
+
+MovingWall / ForcedMovingWall lid boundaries and the Wet/Dry contact-angle
+density overrides are included; ``check.py``-style validation is the
+Laplace/flat-interface test in tests/test_lee.py.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+import jax.numpy as jnp
+
+from tclb_tpu.core.lattice import NodeCtx
+from tclb_tpu.core.registry import ModelDef
+from tclb_tpu.models.d2q9 import E, _zou_he_x
+from tclb_tpu.ops import lbm
+
+W = lbm.weights(E)
+OPP = lbm.opposite(E)
+CS2 = 1.0 / 3.0
+# MRT rates S4..S9 of the reference's #define block (Dynamics.c.Rt:8-13);
+# S8/S9 take omega at runtime
+MRT_S_FIXED = {3: 4.0 / 3.0, 4: 1.0, 5: 1.0, 6: 1.0}
+
+
+def _def() -> ModelDef:
+    d = ModelDef("d2q9_lee", ndim=2,
+                 description="Lee multiphase (potential-form forcing)")
+    d.add_densities("f", E)
+    d.add_field("rho", dx=(-2, 2), dy=(-2, 2))
+    d.add_field("nu", dx=(-2, 2), dy=(-2, 2))
+    d.add_stage("BaseIteration", "Run")
+    d.add_stage("CalcRho", "CalcRho")
+    d.add_stage("CalcNu", "CalcNu", load_densities=False)
+    d.add_stage("InitF2", "InitF2", load_densities=False)
+    d.add_action("Iteration", ("BaseIteration", "CalcRho", "CalcNu"))
+    d.add_action("Init", ("InitF2", "CalcRho", "CalcNu"))
+    d.add_quantity("Rho", unit="kg/m3")
+    d.add_quantity("U", unit="m/s", vector=True)
+    d.add_quantity("Nu", unit="kg/m3")
+    d.add_quantity("P", unit="Pa")
+    d.add_setting("omega", comment="one over relaxation time")
+    d.add_setting("nu", default=1 / 6,
+                  derived={"omega": lambda nu: 1.0 / (3 * nu + 0.5)})
+    d.add_setting("InletVelocity", default=0.0, zonal=True)
+    d.add_setting("InletPressure", default=0.0, zonal=True,
+                  derived={"InletDensity": lambda p: 1.0 + p / 3.0})
+    d.add_setting("InletDensity", default=1.0, zonal=True)
+    d.add_setting("OutletDensity", default=1.0, zonal=True)
+    d.add_setting("InitDensity", zonal=True)
+    d.add_setting("WallDensity", zonal=True)
+    d.add_setting("GravitationY")
+    d.add_setting("GravitationX")
+    d.add_setting("MovingWallVelocity", zonal=True)
+    d.add_setting("WetDensity", zonal=True)
+    d.add_setting("DryDensity", zonal=True)
+    d.add_setting("Wetting", zonal=True)
+    d.add_setting("LiquidDensity")
+    d.add_setting("VaporDensity")
+    d.add_setting("Beta")
+    d.add_setting("Kappa")
+    d.add_global("MomentumX")
+    d.add_global("MomentumY")
+    d.add_global("Mass")
+    d.add_node_type("MovingWall", "BOUNDARY")
+    d.add_node_type("ForcedMovingWall", "BOUNDARY")
+    d.add_node_type("Wet", "ADDITIONALS")
+    d.add_node_type("Dry", "ADDITIONALS")
+    return d
+
+
+def _mu0(ctx: NodeCtx, r):
+    """Double-well bulk chemical potential (reference getP/CalcNu)."""
+    rl = ctx.setting("LiquidDensity")
+    rv = ctx.setting("VaporDensity")
+    return 2.0 * ctx.setting("Beta") * (r - rl) * (r - rv) \
+        * (2.0 * r - rv - rl)
+
+
+def calc_rho(ctx: NodeCtx):
+    """rho = sum(f) with boundary overrides (reference CalcRho,
+    src/d2q9_lee/Dynamics.c.Rt:199-221)."""
+    rho = jnp.sum(ctx.group("f"), axis=0)
+    wallish = ctx.nt_is("Wall") | ctx.nt_is("MovingWall")
+    wall_rho = ctx.setting("WallDensity")
+    wall_rho = jnp.where(ctx.nt_is("Wet") & wallish,
+                         ctx.setting("WetDensity"), wall_rho)
+    wall_rho = jnp.where(ctx.nt_is("Dry") & wallish,
+                         ctx.setting("DryDensity"), wall_rho)
+    rho = jnp.where(wallish, wall_rho, rho)
+    rho = jnp.where(ctx.nt_is("EPressure"), ctx.setting("OutletDensity"),
+                    rho)
+    rho = jnp.where(ctx.nt_is("WPressure"), ctx.setting("InletDensity"),
+                    rho)
+    return {"rho": rho}
+
+
+def calc_nu(ctx: NodeCtx):
+    """nu = mu0(rho) - Kappa lap(rho); lap = sum_i (w_i/cs2)(rho(e) - 2
+    rho(0) + rho(-e)) (reference CalcNu, src/d2q9_lee/Dynamics.c.Rt:335-343)."""
+    r0 = ctx.load("rho")
+    lap = sum(float(W[i] / CS2)
+              * (ctx.load("rho", int(E[i, 0]), int(E[i, 1]))
+                 - 2.0 * r0
+                 + ctx.load("rho", -int(E[i, 0]), -int(E[i, 1])))
+              for i in range(1, 9))
+    return {"nu": _mu0(ctx, r0) - ctx.setting("Kappa") * lap}
+
+
+def _projections(ctx: NodeCtx, u, d):
+    """Per-direction biased/central force projections fB_i / fC_i
+    (reference fillF, src/d2q9_lee/Dynamics.c.Rt:356-400)."""
+    gx = ctx.setting("GravitationX")
+    gy = ctx.setting("GravitationY")
+    ug = u[0] * gx + u[1] * gy
+    fB, fC = [], []
+    for i in range(9):
+        dx, dy = int(E[i, 0]), int(E[i, 1])
+        if dx == 0 and dy == 0:
+            grad_b = grad_c = 0.0
+        else:
+            r1 = ctx.load("rho", dx, dy)
+            r2 = ctx.load("rho", 2 * dx, 2 * dy)
+            r0 = ctx.load("rho")
+            rm = ctx.load("rho", -dx, -dy)
+            n1 = ctx.load("nu", dx, dy)
+            n2 = ctx.load("nu", 2 * dx, 2 * dy)
+            n0 = ctx.load("nu")
+            nm = ctx.load("nu", -dx, -dy)
+            grad_b = 0.5 * (-r2 + 4.0 * r1 - 3.0 * r0) * CS2 \
+                - d * 0.5 * (-n2 + 4.0 * n1 - 3.0 * n0)
+            grad_c = 0.5 * (r1 - rm) * CS2 - d * 0.5 * (n1 - nm)
+        eg = float(E[i, 0]) * gx + float(E[i, 1]) * gy
+        fB.append(grad_b + eg - ug)
+        fC.append(grad_c + eg - ug)
+    # ForcedMovingWall: additional momentum-matching force (fillF :380-398)
+    fmw = ctx.nt_is("ForcedMovingWall")
+    gx2 = (ctx.setting("MovingWallVelocity") - u[0]) * d
+    gy2 = (0.0 - u[1]) * d
+    ug2 = u[0] * gx2 + u[1] * gy2
+    for i in range(9):
+        extra = float(E[i, 0]) * gx2 + float(E[i, 1]) * gy2 - ug2
+        fB[i] = jnp.where(fmw, fB[i] + extra, fB[i])
+        fC[i] = jnp.where(fmw, fC[i] + extra, fC[i])
+    return fB, fC
+
+
+def _vec_of(proj):
+    """make.vector: F = sum_i (w_i/cs2) proj_i e_i."""
+    fx = sum(float(W[i] / CS2 * E[i, 0]) * proj[i]
+             for i in range(9) if E[i, 0])
+    fy = sum(float(W[i] / CS2 * E[i, 1]) * proj[i]
+             for i in range(9) if E[i, 1])
+    return fx, fy
+
+
+def _fill(ctx: NodeCtx, f):
+    """d, u (with the half-central-force shift) and the projections."""
+    dt = f.dtype
+    d = jnp.sum(f, axis=0)
+    jx = jnp.tensordot(jnp.asarray(E[:, 0], dt), f, axes=1)
+    jy = jnp.tensordot(jnp.asarray(E[:, 1], dt), f, axes=1)
+    u_bare = (jx / d, jy / d)
+    fB, fC = _projections(ctx, u_bare, d)
+    fcx, fcy = _vec_of(fC)
+    u = ((jx + 0.5 * fcx) / d, (jy + 0.5 * fcy) / d)
+    return d, (jx, jy), u, fB, fC
+
+
+def _force_term(feq, d, u, proj, uF):
+    """force(): feq_i (proj_i - u.F) / (d cs2) (reference CollisionBGK)."""
+    return [feq[i] * (proj[i] - uF) / (d * CS2) for i in range(9)]
+
+
+def _collision_bgk(ctx: NodeCtx, f):
+    dt = f.dtype
+    d, (jx, jy), u, fB, fC = _fill(ctx, f)
+    fcx, fcy = _vec_of(fC)
+    fbx, fby = _vec_of(fB)
+    coll = ctx.nt_in_group("COLLISION")
+    ctx.add_global("Mass", d, where=coll)
+    ctx.add_global("MomentumX", jx + 0.5 * fcx, where=coll)
+    ctx.add_global("MomentumY", jy + 0.5 * fcy, where=coll)
+    feq = lbm.equilibrium(E, W, d, u)
+    omega = ctx.setting("omega")
+    uFc = u[0] * fcx + u[1] * fcy
+    uFb = u[0] * fbx + u[1] * fby
+    fc_term = _force_term(feq, d, u, fC, uFc)
+    fb_term = _force_term(feq, d, u, fB, uFb)
+    out = []
+    for i in range(9):
+        fneq = f[i] - (feq[i] - 0.5 * fc_term[i])
+        out.append((1.0 - omega) * fneq + feq[i] + 0.5 * fb_term[i])
+    return jnp.stack(out)
+
+
+def run(ctx: NodeCtx) -> jnp.ndarray:
+    f = ctx.group("f")
+    vel = ctx.setting("InletVelocity")
+
+    def moving_wall(f):
+        # lid at the BOTTOM of the fluid (reconstructs the upward-
+        # moving populations f2/f5/f6 — reference MovingWall :62-71)
+        rho = f[0] + f[1] + f[3] + 2.0 * (f[7] + f[4] + f[8])
+        ru = rho * ctx.setting("MovingWallVelocity")
+        f2 = f[4]
+        f6 = f[8] - 0.5 * ru - 0.5 * (f[3] - f[1])
+        f5 = f[7] + 0.5 * ru + 0.5 * (f[3] - f[1])
+        return jnp.stack([f[0], f[1], f2, f[3], f[4], f5, f6, f[7], f[8]])
+
+    def wvel_eq(f):
+        # equilibrium inlet with Wet/Dry density override (:109-126)
+        shape = f.shape[1:]
+        rho2 = jnp.broadcast_to(ctx.setting("InletDensity"),
+                                shape).astype(f.dtype)
+        rho2 = jnp.where(ctx.nt_is("Wet"), ctx.setting("WetDensity"), rho2)
+        rho2 = jnp.where(ctx.nt_is("Dry"), ctx.setting("DryDensity"), rho2)
+        ux = jnp.broadcast_to(vel, shape).astype(f.dtype)
+        return lbm.equilibrium(E, W, rho2, (ux, jnp.zeros(shape, f.dtype)))
+
+    f = ctx.boundary_case(f, {
+        ("Wall", "Solid"): lambda f: f[jnp.asarray(OPP)],
+        "MovingWall": moving_wall,
+        "EVelocity": lambda f: _zou_he_x(f, vel, "velocity", "E"),
+        "WPressure": lambda f: _zou_he_x(f, ctx.setting("InletDensity"),
+                                         "pressure", "W"),
+        "WVelocity": wvel_eq,
+        "EPressure": lambda f: _zou_he_x(f, ctx.setting("OutletDensity"),
+                                         "pressure", "E"),
+    })
+    f = jnp.where(ctx.nt_is("BGK")[None], _collision_bgk(ctx, f), f)
+    f = jnp.where(ctx.nt_is("MRT")[None], _collision_mrt(ctx, f), f)
+    return ctx.store({"f": f})
+
+
+def _collision_mrt(ctx: NodeCtx, f):
+    """MRT variant (reference CollisionMRT, src/d2q9_lee/Dynamics.c.Rt:484-523):
+    half the central force pre-added, non-conserved moments relaxed by
+    (S - 1), half the biased force post-added.
+
+    NOTE: the reference's MRT factor is literally ``(S - 1)``
+    (Dynamics.c.Rt:516) — the SIGN-FLIPPED counterpart of its own BGK
+    path's ``(1 - omega)``, so for S = omega != 1 the two collisions give
+    different effective viscosities.  We reproduce the reference literally;
+    use BGK nodes (as the reference's cases do) for physical runs."""
+    from tclb_tpu.ops.lbm import moments, from_moments
+    M = _MRT_BASIS
+    d, _, u, fB, fC = _fill(ctx, f)
+    fcx, fcy = _vec_of(fC)
+    fbx, fby = _vec_of(fB)
+    feq = lbm.equilibrium(E, W, d, u)
+    uFc = u[0] * fcx + u[1] * fcy
+    uFb = u[0] * fbx + u[1] * fby
+    f2 = f + 0.5 * jnp.stack(_force_term(feq, d, u, fC, uFc))
+    omega = ctx.setting("omega")
+    m = moments(M, f2)
+    meq = moments(M, feq)
+    out_m = []
+    for i in range(9):
+        if i < 3:
+            out_m.append(m[i])
+        else:
+            s = MRT_S_FIXED.get(i, None)
+            rate = (s - 1.0) if s is not None else (omega - 1.0)
+            out_m.append((m[i] - meq[i]) * rate + meq[i])
+    f3 = from_moments(M, jnp.stack(out_m))
+    return f3 + 0.5 * jnp.stack(_force_term(feq, d, u, fB, uFb))
+
+
+# the reference's classical (non-orthonormalized) d2q9 MRT matrix
+# (src/d2q9_lee/Dynamics.c.Rt:492-501)
+_MRT_BASIS = np.array([
+    [1, 1, 1, 1, 1, 1, 1, 1, 1],
+    [0, 1, 0, -1, 0, 1, -1, -1, 1],
+    [0, 0, 1, 0, -1, 1, 1, -1, -1],
+    [-4, -1, -1, -1, -1, 2, 2, 2, 2],
+    [4, -2, -2, -2, -2, 1, 1, 1, 1],
+    [0, -2, 0, 2, 0, 1, -1, -1, 1],
+    [0, 0, -2, 0, 2, 1, 1, -1, -1],
+    [0, 1, -1, 1, -1, 0, 0, 0, 0],
+    [0, 0, 0, 0, 0, 1, -1, 1, -1]], dtype=np.float64)
+
+
+def init_f2(ctx: NodeCtx):
+    """InitF2: f = feq(InitRho-rule density, (InletVelocity, 0)) (reference
+    InitF2 + InitRho, src/d2q9_lee/Dynamics.c.Rt:174-197,415-424)."""
+    shape = ctx.flags.shape
+    dt = ctx._fields.dtype
+    rho = jnp.broadcast_to(ctx.setting("InitDensity"), shape).astype(dt)
+    rho = jnp.where(ctx.nt_is("Wall") | ctx.nt_is("MovingWall"),
+                    ctx.setting("WallDensity"), rho)
+    rho = jnp.where(ctx.nt_is("EPressure"), ctx.setting("OutletDensity"),
+                    rho)
+    rho = jnp.where(ctx.nt_is("WPressure"), ctx.setting("InletDensity"),
+                    rho)
+    ux = jnp.broadcast_to(ctx.setting("InletVelocity"), shape).astype(dt)
+    f = lbm.equilibrium(E, W, rho, (ux, jnp.zeros(shape, dt)))
+    return {"f": f}
+
+
+def get_u(ctx: NodeCtx) -> jnp.ndarray:
+    f = ctx.group("f")
+    _, _, u, _, _ = _fill(ctx, f)
+    return jnp.stack([u[0], u[1], jnp.zeros_like(u[0])])
+
+
+def build():
+    d = _def()
+    model = d.finalize()
+
+    def _init_stage(ctx):
+        upd = init_f2(ctx)
+        return ctx.store(upd)
+
+    return model.bind(
+        run=run, init=_init_stage,
+        stages={"CalcRho": calc_rho, "CalcNu": calc_nu,
+                "InitF2": _init_stage},
+        quantities={
+            "Rho": lambda c: c.load("rho"),
+            "U": get_u,
+            "Nu": lambda c: c.load("nu"),
+            "P": lambda c: _mu0(c, c.load("rho")),
+        })

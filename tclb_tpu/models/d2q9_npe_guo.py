@@ -1,0 +1,282 @@
+"""d2q9_npe_guo — Nernst–Planck electrokinetics (Guo's coupled LBM).
+
+Behavioral parity target: reference model ``d2q9_npe_guo``
+(reference src/d2q9_npe_guo/Dynamics.R, Dynamics.c.Rt; validated there by
+python/test_eof.py against the electro-osmotic channel flow).  Five d2q9
+populations solve four coupled equations:
+
+* ``g`` — internal potential psi by Guo's Poisson LBM: rest weight
+  ``wp0 = 1/9``, equilibrium ``wp_i psi`` with ``wp = (1/9 - 1, 1/9 ...)``,
+  charge source ``dt wps RD`` with ``RD = -(2/3)(1/2 - tau_psi) dt rho_e /
+  epsilon`` (dt appears in both factors — a literal dt^2 scaling) and
+  ``tau_psi = 1`` (Dynamics.c.Rt:92-99,266-270);
+* ``phi`` — external potential by the same solver, source-free, driven by
+  Dirichlet ``phi_bc`` at pressure boundaries;
+* ``h_0``/``h_1`` — ion number densities ``n0``/``n1`` (valence +-ez):
+  advection-diffusion with equilibrium ``wi n (1 - e.u/cs2)`` (the
+  reference's literal form) and electro-migration source
+  ``- wi z_k (e.gradPsi) n_k B el_kbT``, ``B = 3 D / tau_D``,
+  ``tau_D = 3 D + 1/2`` (Dynamics.c.Rt:241-268);
+* ``f`` — fluid BGK with exact-difference forcing by the electric body
+  force ``F = -gradPhi rho_e / rho t_to_s^2`` (getF :390-405; the gradPsi
+  term is commented out in the reference and omitted here too).
+
+Charge density ``rho_e = el ez (n0 - n1)``; potential gradients are read
+off the first moments of the solver populations:
+``grad = -(3/2) sum_i (g_i - wp_i psi) e_i`` (:328-357).
+"""
+
+from __future__ import annotations
+
+import numpy as np
+import jax.numpy as jnp
+
+from tclb_tpu.core.lattice import NodeCtx
+from tclb_tpu.core.registry import ModelDef
+from tclb_tpu.models.d2q9 import E
+# Guo Poisson-solver weights/update shared with d2q9_poison_boltzmann
+from tclb_tpu.models.guo_poisson import WP, WP0, psi_of as _psi_of, \
+    collide as _guo_collide
+from tclb_tpu.ops import lbm
+
+W = lbm.weights(E)
+OPP = lbm.opposite(E)
+CS2 = 1.0 / 3.0
+TAU_PSI = 1.0
+TAU_PHI = 1.0
+_GROUPS = ("phi", "g", "f", "h_0", "h_1")
+
+
+def _def() -> ModelDef:
+    d = ModelDef("d2q9_npe_guo", ndim=2,
+                 description="Nernst-Planck electrokinetics (Guo)")
+    for gname in _GROUPS:
+        d.add_densities(gname, E)
+    d.add_quantity("F", unit="kgm/s2", vector=True)
+    d.add_quantity("U", unit="m/s", vector=True)
+    d.add_quantity("Rho", unit="kg/m3")
+    d.add_quantity("n0", unit="An/m3")
+    d.add_quantity("n1", unit="An/m3")
+    d.add_quantity("Psi", unit="V")
+    d.add_quantity("Phi", unit="V")
+    d.add_quantity("GradPsi", unit="V/m", vector=True)
+    d.add_quantity("GradPhi", unit="V/m", vector=True)
+    d.add_quantity("rho_e", unit="C/m3")
+    d.add_setting("n_inf_0")
+    d.add_setting("n_inf_1")
+    d.add_setting("el", default=1.0)
+    d.add_setting("el_kbT", default=1.0)
+    d.add_setting("epsilon", default=1.0)
+    d.add_setting("dt", default=1.0)
+    d.add_setting("psi0", default=1.0)
+    d.add_setting("phi0", default=1.0)
+    d.add_setting("ez", default=1.0)
+    d.add_setting("Ex", default=0.0)
+    d.add_setting("D", default=1.0 / 6.0, comment="ion diffusivity")
+    d.add_setting("nu", default=1 / 6, comment="viscosity")
+    d.add_setting("rho_bc", default=1.0, zonal=True)
+    d.add_setting("phi_bc", default=1.0, zonal=True)
+    d.add_setting("psi_bc", default=1.0, zonal=True,
+                  comment="zeta potential at walls")
+    d.add_setting("t_to_s", default=1.0)
+    d.add_global("TotalMomentum")
+    d.add_node_type("BottomSymmetry", "BOUNDARY")
+    d.add_node_type("TopSymmetry", "BOUNDARY")
+    return d
+
+
+def _stack(ctx, names):
+    return jnp.concatenate([ctx.group(n) for n in names])
+
+
+def _grad_of(g, pot):
+    """grad = -(3/2) sum_i (g_i - wp_i pot) e_i (reference getGradPsi)."""
+    gx = sum(float(E[i, 0]) * (g[i] - float(WP[i]) * pot)
+             for i in range(9) if E[i, 0])
+    gy = sum(float(E[i, 1]) * (g[i] - float(WP[i]) * pot)
+             for i in range(9) if E[i, 1])
+    return -1.5 * gx / TAU_PSI, -1.5 * gy / TAU_PSI
+
+
+def _macro(ctx, f, g, phi, h0, h1):
+    dt = f.dtype
+    rho = jnp.sum(f, axis=0)
+    n0 = jnp.sum(h0, axis=0)
+    n1 = jnp.sum(h1, axis=0)
+    psi = _psi_of(g)
+    pot = _psi_of(phi)
+    rho_e = ctx.setting("el") * ctx.setting("ez") * (n0 - n1)
+    gpsi = _grad_of(g, psi)
+    gphi = _grad_of(phi, pot)
+    ts = ctx.setting("t_to_s")
+    fx = -gphi[0] * rho_e / rho * ts * ts
+    fy = -gphi[1] * rho_e / rho * ts * ts
+    return rho, n0, n1, psi, pot, rho_e, gpsi, (fx, fy)
+
+
+def run(ctx: NodeCtx) -> jnp.ndarray:
+    s = _stack(ctx, _GROUPS)
+    phi, g, f, h0, h1 = (s[9 * i:9 * i + 9] for i in range(5))
+    dt = s.dtype
+
+    # ---------------- boundaries (reference Run :181-219) --------------- #
+    n_inf_0 = ctx.setting("n_inf_0")
+    n_inf_1 = ctx.setting("n_inf_1")
+    psi_bc = ctx.setting("psi_bc")
+    phi_bc = ctx.setting("phi_bc")
+    wi = jnp.asarray(W, dt).reshape((9,) + (1,) * (s.ndim - 1))
+    wp = jnp.asarray(WP, dt).reshape((9,) + (1,) * (s.ndim - 1))
+    full = s.shape[1:]
+
+    def _b(x):
+        return jnp.broadcast_to(x, (9,) + full)
+
+    def wall(stack):
+        phi_, g_, f_, h0_, h1_ = (stack[9 * i:9 * i + 9] for i in range(5))
+        f_ = f_[jnp.asarray(OPP)]
+        phi_ = phi_[jnp.asarray(OPP)]
+        g_ = _b(wp * psi_bc)
+        h0_ = _b(n_inf_0 * wi * jnp.exp(-ctx.setting("ez") * psi_bc
+                                        * ctx.setting("el_kbT")))
+        h1_ = _b(n_inf_1 * wi * jnp.exp(ctx.setting("ez") * psi_bc
+                                        * ctx.setting("el_kbT")))
+        return jnp.concatenate([phi_, g_, f_, h0_, h1_])
+
+    def pressure(stack, side):
+        from tclb_tpu.models.d2q9 import _zou_he_x
+        phi_, g_, f_, h0_, h1_ = (stack[9 * i:9 * i + 9] for i in range(5))
+        rho_b = ctx.setting("rho_bc") if side == "W" else 1.0
+        f_ = _zou_he_x(f_, rho_b, "pressure", side)
+        g_ = g_[jnp.asarray(OPP)]
+        h0_ = _b(n_inf_0 * wi)
+        h1_ = _b(n_inf_1 * wi)
+        phi_ = _b(wp * phi_bc)
+        return jnp.concatenate([phi_, g_, f_, h0_, h1_])
+
+    def symmetry(stack, top):
+        # reflect_to (2,6,5) <- (4,7,8) for bottom; reverse for top
+        if top:
+            sel, src = (4, 7, 8), (2, 6, 5)
+        else:
+            sel, src = (2, 6, 5), (4, 7, 8)
+        out = []
+        for b in range(5):
+            grp = stack[9 * b:9 * b + 9]
+            planes = [grp[i] for i in range(9)]
+            for t, sfrom in zip(sel, src):
+                planes[t] = grp[sfrom]
+            out.append(jnp.stack(planes))
+        return jnp.concatenate(out)
+
+    s = ctx.boundary_case(s, {
+        ("Wall", "Solid"): wall,
+        "WPressure": lambda st: pressure(st, "W"),
+        "EPressure": lambda st: pressure(st, "E"),
+        "BottomSymmetry": lambda st: symmetry(st, top=False),
+        "TopSymmetry": lambda st: symmetry(st, top=True),
+    })
+    phi, g, f, h0, h1 = (s[9 * i:9 * i + 9] for i in range(5))
+
+    # ---------------- collision (reference CollisionBGK :241-317) ------- #
+    rho, n0, n1, psi, pot, rho_e, gpsi, force = _macro(
+        ctx, f, g, phi, h0, h1)
+    ux = jnp.tensordot(jnp.asarray(E[:, 0], dt), f, axes=1) / rho
+    uy = jnp.tensordot(jnp.asarray(E[:, 1], dt), f, axes=1) / rho
+    # measured velocity (with half-force) enters the ion equilibria
+    umx = ux + force[0] * 0.5
+    umy = uy + force[1] * 0.5
+
+    d_ion = ctx.setting("D")
+    tau_d = 3.0 * d_ion + 0.5
+    bk = 3.0 * d_ion / tau_d * ctx.setting("el_kbT")
+    ez = ctx.setting("ez")
+    h0c, h1c = [], []
+    for i in range(9):
+        cu = float(E[i, 0]) * umx + float(E[i, 1]) * umy
+        S = float(E[i, 0]) * gpsi[0] + float(E[i, 1]) * gpsi[1]
+        heq0 = float(W[i]) * n0 * (1.0 - cu / CS2)
+        heq1 = float(W[i]) * n1 * (1.0 - cu / CS2)
+        h0c.append(h0[i] - (h0[i] - heq0) / tau_d
+                   - float(W[i]) * ez * S * n0 * bk)
+        h1c.append(h1[i] - (h1[i] - heq1) / tau_d
+                   + float(W[i]) * ez * S * n1 * bk)
+    h0c = jnp.stack(h0c)
+    h1c = jnp.stack(h1c)
+
+    gc = _guo_collide(g, psi, rho_e, TAU_PSI, ctx.setting("dt"),
+                      ctx.setting("epsilon"))
+    phic = phi - (phi - wp * pot) / TAU_PHI
+
+    omega = 1.0 / (3.0 * ctx.setting("nu") + 0.5)
+    feq = lbm.equilibrium(E, W, rho, (ux, uy))
+    feq2 = lbm.equilibrium(E, W, rho, (ux + force[0], uy + force[1]))
+    fc = f - omega * (f - feq) + (feq2 - feq)
+
+    coll = ctx.nt_in_group("COLLISION")[None]
+    f = jnp.where(coll, fc, f)
+    g = jnp.where(coll, gc, g)
+    phi = jnp.where(coll, phic, phi)
+    h0 = jnp.where(coll, h0c, h0)
+    h1 = jnp.where(coll, h1c, h1)
+    return ctx.store({"f": f, "g": g, "phi": phi, "h_0": h0, "h_1": h1})
+
+
+def init(ctx: NodeCtx) -> jnp.ndarray:
+    shape = ctx.flags.shape
+    dt = ctx._fields.dtype
+    ones = jnp.ones(shape, dt)
+    # g_i = wp0 psi0 for ALL i (reference Init :221-239) so that
+    # getPsi returns psi0; phi likewise
+    g = jnp.stack([ctx.setting("psi0") * WP0 * ones for _ in range(9)])
+    phi = jnp.stack([ctx.setting("phi0") * WP0 * ones for _ in range(9)])
+    f = lbm.equilibrium(E, W, ones, (jnp.zeros(shape, dt),) * 2)
+    h0 = jnp.stack([ctx.setting("n_inf_0") * float(W[i]) * ones
+                    for i in range(9)])
+    h1 = jnp.stack([ctx.setting("n_inf_1") * float(W[i]) * ones
+                    for i in range(9)])
+    return ctx.store({"f": f, "g": g, "phi": phi, "h_0": h0, "h_1": h1})
+
+
+def _q(fn):
+    def wrap(ctx):
+        s = _stack(ctx, _GROUPS)
+        phi, g, f, h0, h1 = (s[9 * i:9 * i + 9] for i in range(5))
+        return fn(ctx, *_macro(ctx, f, g, phi, h0, h1), f)
+    return wrap
+
+
+def build():
+    def u_of(ctx, rho, n0, n1, psi, pot, rho_e, gpsi, force, f):
+        dt = f.dtype
+        ux = jnp.tensordot(jnp.asarray(E[:, 0], dt), f, axes=1) / rho
+        uy = jnp.tensordot(jnp.asarray(E[:, 1], dt), f, axes=1) / rho
+        return jnp.stack([ux + 0.5 * force[0], uy + 0.5 * force[1],
+                          jnp.zeros_like(ux)])
+
+    def gpsi_q(ctx, rho, n0, n1, psi, pot, rho_e, gpsi, force, f):
+        return jnp.stack([gpsi[0], gpsi[1], jnp.zeros_like(gpsi[0])])
+
+    def gphi_q(ctx):
+        s = _stack(ctx, _GROUPS)
+        phi = s[0:9]
+        pot = _psi_of(phi)
+        gx, gy = _grad_of(phi, pot)
+        return jnp.stack([gx, gy, jnp.zeros_like(gx)])
+
+    return _def().finalize().bind(
+        run=run, init=init,
+        quantities={
+            "F": _q(lambda ctx, rho, n0, n1, psi, pot, rho_e, gpsi, force,
+                    f: jnp.stack([force[0], force[1],
+                                  jnp.zeros_like(force[0])])),
+            "U": _q(u_of),
+            "Rho": _q(lambda ctx, rho, *a: rho),
+            "n0": _q(lambda ctx, rho, n0, *a: n0),
+            "n1": _q(lambda ctx, rho, n0, n1, *a: n1),
+            "Psi": _q(lambda ctx, rho, n0, n1, psi, *a: psi),
+            "Phi": _q(lambda ctx, rho, n0, n1, psi, pot, *a: pot),
+            "GradPsi": _q(gpsi_q),
+            "GradPhi": gphi_q,
+            "rho_e": _q(lambda ctx, rho, n0, n1, psi, pot, rho_e, *a:
+                        rho_e),
+        })

@@ -1,0 +1,104 @@
+"""d2q9_poison_boltzmann — nonlinear Poisson–Boltzmann potential solver.
+
+Behavioral parity target: reference model ``d2q9_poison_boltzmann``
+(reference src/d2q9_poison_boltzmann/Dynamics.R, Dynamics.c.Rt).  A single
+``g`` population iterates Guo's Poisson LBM to a fixed point of
+``epsilon lap(psi) = -rho_e(psi)`` with the full nonlinear charge density
+``rho_e = -2 n_inf z el sinh(z el/(kb T) psi)`` (getrho_e :39-43).
+Equilibrium ``wp_i psi`` with ``wp = (1/9 - 1, 1/9 ...)``, source
+``dt wps RD``, ``RD = -(2/3)(1/2 - tau_psi) dt rho_e / epsilon``
+(:16-23,96-108).  Walls impose a Dirichlet zeta potential
+``g_i = wp_i psi_bc`` (:44-66).  The ``subiter`` plane counts fixed-point
+sweeps (CalcSubiter :110-113) — the reference drives convergence through
+repeated <Solve> iterations, and so do we.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+import jax.numpy as jnp
+
+from tclb_tpu.core.lattice import NodeCtx
+from tclb_tpu.core.registry import ModelDef
+from tclb_tpu.models.d2q9 import E
+from tclb_tpu.models.guo_poisson import WP0, WP, WPS, \
+    psi_of as _psi_of, collide as _guo_collide
+
+
+def _def() -> ModelDef:
+    d = ModelDef("d2q9_poison_boltzmann", ndim=2,
+                 description="nonlinear Poisson-Boltzmann solver")
+    d.add_densities("g", E)
+    d.add_density("subiter")
+    d.add_field("psi", dx=(-1, 1), dy=(-1, 1))
+    d.add_quantity("Psi")
+    d.add_quantity("Subiter")
+    d.add_quantity("rho_e", unit="kg/m3")
+    d.add_stage("BaseIteration", "Run")
+    d.add_stage("CalcPsi", "CalcPsi")
+    d.add_stage("CalcSubiter", "CalcSubiter", load_densities=False)
+    d.add_stage("BaseInit", "Init", load_densities=False)
+    d.add_action("Iteration", ("BaseIteration", "CalcPsi", "CalcSubiter"))
+    d.add_action("Init", ("BaseInit", "CalcPsi"))
+    d.add_setting("tau_psi", default=1.0)
+    d.add_setting("n_inf", default=1.0)
+    d.add_setting("z", default=1.0)
+    d.add_setting("el", default=1.0)
+    d.add_setting("kb", default=1.0)
+    d.add_setting("T", default=1.0)
+    d.add_setting("epsilon", default=1.0)
+    d.add_setting("dt", default=1.0)
+    d.add_setting("psi_bc", default=1.0, zonal=True,
+                  comment="zeta potential at walls")
+    d.add_setting("psi0", default=1.0, zonal=True)
+    return d
+
+
+def _rho_e(ctx: NodeCtx, psi):
+    z = ctx.setting("z")
+    return -2.0 * ctx.setting("n_inf") * z * ctx.setting("el") \
+        * jnp.sinh(z * ctx.setting("el") / ctx.setting("kb")
+                   / ctx.setting("T") * psi)
+
+
+def run(ctx: NodeCtx) -> jnp.ndarray:
+    g = ctx.group("g")
+    dt_ = g.dtype
+    wp = jnp.asarray(WP, dt_).reshape((9,) + (1,) * (g.ndim - 1))
+    g = ctx.boundary_case(g, {
+        ("Wall", "Solid"): lambda g: wp * ctx.setting("psi_bc"),
+    })
+    psi = _psi_of(g)
+    rho_e = _rho_e(ctx, psi)
+    gc = _guo_collide(g, psi, rho_e, ctx.setting("tau_psi"),
+                      ctx.setting("dt"), ctx.setting("epsilon"))
+    g = jnp.where(ctx.nt_in_group("COLLISION")[None], gc, g)
+    return ctx.store({"g": g})
+
+
+def calc_psi(ctx: NodeCtx):
+    return {"psi": _psi_of(ctx.group("g"))}
+
+
+def calc_subiter(ctx: NodeCtx):
+    return {"subiter": ctx.density("subiter") + 1.0}
+
+
+def init(ctx: NodeCtx) -> jnp.ndarray:
+    shape = ctx.flags.shape
+    dt_ = ctx._fields.dtype
+    wp = jnp.asarray(WP, dt_).reshape((9,) + (1,) * (len(shape)))
+    psi0 = jnp.broadcast_to(ctx.setting("psi0"), shape).astype(dt_)
+    g = wp * psi0[None]
+    return ctx.store({"g": g, "subiter": jnp.zeros(shape, dt_)})
+
+
+def build():
+    return _def().finalize().bind(
+        run=run, init=init,
+        stages={"CalcPsi": calc_psi, "CalcSubiter": calc_subiter},
+        quantities={
+            "Psi": lambda c: _psi_of(c.group("g")),
+            "Subiter": lambda c: c.density("subiter"),
+            "rho_e": lambda c: _rho_e(c, _psi_of(c.group("g"))),
+        })

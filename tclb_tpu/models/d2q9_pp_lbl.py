@@ -1,0 +1,216 @@
+"""d2q9_pp_LBL — pseudopotential multiphase, Lycett-Brown & Luo forcing.
+
+Behavioral parity target: reference model ``d2q9_pp_LBL``
+(reference src/d2q9_pp_LBL/Dynamics.R, Dynamics.c.Rt — "Improved forcing
+scheme in pseudopotential lattice Boltzmann methods for multiphase flow at
+arbitrarily high density ratios", maintained by T. Mitchell).  Two-stage
+iteration like the kuper family: ``calcPsi`` computes the pseudopotential
+``psi = sqrt(2 (p0 - rho/3)/(G/3))`` from the Carnahan–Starling EoS
+(Dynamics.c.Rt:217-224), then ``Run`` applies boundary conditions and a BGK
+collision with the LBL third-order-corrected Guo-style forcing
+(Dynamics.c.Rt:350-396: the ``gamma`` coefficient
+``1 - omega/4 - rho omega/(4 G cs2 psi^2)`` restores mechanical stability at
+high density ratio).  The Shan–Chen force is
+``F = -G psi(0) sum_i w_i psi(x+e_i) e_i`` (Dynamics.c.Rt:203-212; the
+templated symmetry adjustments of the R-section are dead code there — the
+python section regenerates R[] before use — and are not reproduced).
+
+Note the reference collides with ``tempomega`` (default 1), not ``omega``
+(its own comment: "omega seems to get overwritten in preamble??"); we keep
+both settings with the same semantics for config parity.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+import jax.numpy as jnp
+
+from tclb_tpu.core.lattice import NodeCtx
+from tclb_tpu.core.registry import ModelDef
+from tclb_tpu.models.d2q9 import E, _zou_he_x, _symmetry
+from tclb_tpu.ops import lbm
+
+W = lbm.weights(E)
+OPP = lbm.opposite(E)
+CS2 = 1.0 / 3.0
+
+
+def _def() -> ModelDef:
+    d = ModelDef("d2q9_pp_LBL", ndim=2,
+                 description="pseudopotential multiphase (Lycett-Brown/Luo "
+                             "forcing, Carnahan-Starling EoS)")
+    d.add_densities("f", E)
+    d.add_field("psi", dx=(-1, 1), dy=(-1, 1))
+    d.add_stage("BaseIteration", "Run")
+    d.add_stage("calcPsi", "calcPsi")
+    d.add_stage("BaseInit", "Init", load_densities=False)
+    d.add_action("Iteration", ("BaseIteration", "calcPsi"))
+    d.add_action("Init", ("BaseInit", "calcPsi"))
+    d.add_quantity("Rho", unit="kg/m3")
+    d.add_quantity("U", unit="m/s", vector=True)
+    d.add_quantity("F", unit="N", vector=True)
+    d.add_quantity("P", unit="Pa")
+    d.add_quantity("Psi", unit="1")
+    d.add_setting("G", default=-1.0, comment="interaction strength")
+    d.add_setting("T", default=0.0585, comment="effective temperature")
+    d.add_setting("alpha", default=0.25, comment="CS EoS parameter")
+    d.add_setting("R", default=0.25, comment="CS EoS parameter")
+    d.add_setting("beta", default=1.0, comment="CS EoS parameter")
+    d.add_setting("kappa", default=0.0, comment="surface tension parameter")
+    d.add_setting("eps_0", default=2.0, comment="mechanical stability coef")
+    d.add_setting("betaforcing", default=1.0, comment="beta forcing scheme")
+    d.add_setting("omega", comment="one over relaxation time")
+    d.add_setting("tempomega", default=1.0,
+                  comment="relaxation rate the reference actually collides "
+                          "with (src/d2q9_pp_LBL/Dynamics.c.Rt:352)")
+    d.add_setting("nu", default=1 / 6,
+                  derived={"omega": lambda nu: 1.0 / (3 * nu + 0.5)})
+    d.add_setting("Velocity", default=0.0, zonal=True)
+    d.add_setting("VelocityY", default=0.0, zonal=True)
+    d.add_setting("Density", default=1.0, zonal=True)
+    d.add_setting("GravitationY")
+    d.add_setting("GravitationX")
+    for i, dflt in enumerate([0, 0, 0, -1 / 3, 0, 0, 0, 0, 0]):
+        d.add_setting(f"S{i}", default=dflt, comment="MRT rate (unused in "
+                      "the BGK path, kept for config parity)")
+    d.add_global("PressureLoss", unit="1mPa")
+    d.add_global("OutletFlux", unit="1m2/s")
+    d.add_global("InletFlux", unit="1m2/s")
+    d.add_node_type("BottomSymmetry", "BOUNDARY")
+    d.add_node_type("TopSymmetry", "BOUNDARY")
+    d.add_node_type("RightSymmetry", "BOUNDARY")
+    return d
+
+
+def _cs_pressure(ctx: NodeCtx, rho):
+    """Carnahan–Starling EoS (reference getP,
+    src/d2q9_pp_LBL/Dynamics.c.Rt:146-153)."""
+    bp = rho * ctx.setting("beta") / 4.0
+    p0 = (rho * ctx.setting("R") * ctx.setting("T")
+          * (1.0 + bp + bp * bp - bp ** 3) / (1.0 - bp) ** 3
+          - ctx.setting("alpha") * rho * rho)
+    return p0
+
+
+def calc_psi(ctx: NodeCtx):
+    """psi = sqrt(2 (p0 - rho/3)/(G/3)) (reference calcPsi,
+    src/d2q9_pp_LBL/Dynamics.c.Rt:217-224).  For attractive G < 0 the
+    argument is non-negative wherever the EoS is below ideal; clamped at 0
+    against round-off (the reference lets sqrt produce NaN there)."""
+    f = ctx.group("f")
+    rho = jnp.sum(f, axis=0)
+    p0 = _cs_pressure(ctx, rho)
+    arg = 2.0 * (p0 - rho / 3.0) / (ctx.setting("G") / 3.0)
+    return {"psi": jnp.sqrt(jnp.maximum(arg, 0.0))}
+
+
+def _force(ctx: NodeCtx, rho):
+    """Shan–Chen force + gravity (reference PPForce/getF,
+    src/d2q9_pp_LBL/Dynamics.c.Rt:138-216)."""
+    psi0 = ctx.load("psi")
+    fx = sum(float(W[i] * E[i, 0])
+             * ctx.load("psi", int(E[i, 0]), int(E[i, 1]))
+             for i in range(1, 9) if E[i, 0])
+    fy = sum(float(W[i] * E[i, 1])
+             * ctx.load("psi", int(E[i, 0]), int(E[i, 1]))
+             for i in range(1, 9) if E[i, 1])
+    g = ctx.setting("G")
+    return (-g * psi0 * fx + ctx.setting("GravitationX") * rho,
+            -g * psi0 * fy + ctx.setting("GravitationY") * rho)
+
+
+def _collision_bgk(ctx: NodeCtx, f):
+    """BGK collision with the LBL forcing source term (reference
+    CollisionBGK, src/d2q9_pp_LBL/Dynamics.c.Rt:350-396; the 'Excel
+    generated' S block is the live one — it overwrites the sympy S)."""
+    dt = f.dtype
+    rho = jnp.sum(f, axis=0)
+    ux = jnp.tensordot(jnp.asarray(E[:, 0], dt), f, axes=1) / rho
+    uy = jnp.tensordot(jnp.asarray(E[:, 1], dt), f, axes=1) / rho
+    fx, fy = _force(ctx, rho)
+    om = ctx.setting("tempomega")
+    g = ctx.setting("G")
+    psi0 = ctx.load("psi")
+    psi_safe = jnp.where(jnp.abs(psi0) > 1e-30, psi0, 1e-30)
+    gamma = 1.0 - 0.25 * om - rho * om / (4.0 * g * CS2
+                                          * psi_safe * psi_safe)
+    feq = lbm.equilibrium(E, W, rho, (ux, uy))
+    out = []
+    ff = fx * fx + fy * fy
+    for i in range(9):
+        ex, ey = float(E[i, 0]), float(E[i, 1])
+        eu = ex * ux + ey * uy
+        ef = ex * fx + ey * fy
+        s = float(W[i]) * ((ex - ux + ex * eu / CS2) * fx
+                           + (ey - uy + ey * eu / CS2) * fy
+                           + (gamma / (2.0 * rho)) * (ef * ef / CS2 - ff)
+                           ) / CS2
+        out.append(f[i] - om * (f[i] - feq[i]) + s)
+    return jnp.stack(out)
+
+
+def run(ctx: NodeCtx) -> jnp.ndarray:
+    f = ctx.group("f")
+    vel = ctx.setting("Velocity")
+    den = ctx.setting("Density")
+
+    def _wvel_eq(f):
+        # reference WVelocity is an equilibrium inlet: SetEquilibrium with
+        # the zonal Density and Velocity (Dynamics.c.Rt:258-263)
+        shape = f.shape[1:]
+        rho = jnp.broadcast_to(den, shape).astype(f.dtype)
+        ux = jnp.broadcast_to(vel, shape).astype(f.dtype)
+        return lbm.equilibrium(E, W, rho, (ux, jnp.zeros(shape, f.dtype)))
+
+    f = ctx.boundary_case(f, {
+        ("Wall", "Solid"): lambda f: f[jnp.asarray(OPP)],
+        "EVelocity": lambda f: _zou_he_x(f, vel, "velocity", "E"),
+        "WPressure": lambda f: _zou_he_x(f, den, "pressure", "W"),
+        "WVelocity": _wvel_eq,
+        "EPressure": lambda f: _zou_he_x(f, den, "pressure", "E"),
+        "TopSymmetry": lambda f: _symmetry(f, top=True),
+        "BottomSymmetry": lambda f: _symmetry(f, top=False),
+    })
+    f = jnp.where(ctx.nt_in_group("COLLISION")[None],
+                  _collision_bgk(ctx, f), f)
+    return ctx.store({"f": f})
+
+
+def init(ctx: NodeCtx) -> jnp.ndarray:
+    shape = ctx.flags.shape
+    dt = ctx._fields.dtype
+    rho = jnp.broadcast_to(ctx.setting("Density"), shape).astype(dt)
+    ux = jnp.broadcast_to(ctx.setting("Velocity"), shape).astype(dt)
+    uy = jnp.broadcast_to(ctx.setting("VelocityY"), shape).astype(dt)
+    return ctx.store({"f": lbm.equilibrium(E, W, rho, (ux, uy))})
+
+
+def get_u(ctx: NodeCtx) -> jnp.ndarray:
+    """Velocity including the half-force shift (reference getU,
+    src/d2q9_pp_LBL/Dynamics.c.Rt:124-137)."""
+    f = ctx.group("f")
+    dt = f.dtype
+    rho = jnp.sum(f, axis=0)
+    fx, fy = _force(ctx, rho)
+    ux = (jnp.tensordot(jnp.asarray(E[:, 0], dt), f, axes=1) + 0.5 * fx) / rho
+    uy = (jnp.tensordot(jnp.asarray(E[:, 1], dt), f, axes=1) + 0.5 * fy) / rho
+    return jnp.stack([ux, uy, jnp.zeros_like(ux)])
+
+
+def get_f(ctx: NodeCtx) -> jnp.ndarray:
+    rho = jnp.sum(ctx.group("f"), axis=0)
+    fx, fy = _force(ctx, rho)
+    return jnp.stack([fx, fy, jnp.zeros_like(fx)])
+
+
+def build():
+    return _def().finalize().bind(
+        run=run, init=init,
+        quantities={
+            "Rho": lambda c: jnp.sum(c.group("f"), axis=0),
+            "U": get_u,
+            "F": get_f,
+            "P": lambda c: _cs_pressure(c, jnp.sum(c.group("f"), axis=0)),
+            "Psi": lambda c: c.load("psi"),
+        },
+        stages={"calcPsi": calc_psi})

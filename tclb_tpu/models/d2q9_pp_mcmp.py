@@ -1,0 +1,258 @@
+"""d2q9_pp_MCMP — Shan–Chen multi-component multi-phase (two populations).
+
+Behavioral parity target: reference model ``d2q9_pp_MCMP``
+(reference src/d2q9_pp_MCMP/Dynamics.R, Dynamics.c.Rt).  Two d2q9
+populations ``f`` and ``g`` with pseudopotentials ``psi_f = rho_f``,
+``psi_g = rho_g`` (walls carry the adhesion potentials ``Gad2/Gc`` and
+``Gad1/Gc`` respectively — Dynamics.c.Rt:189-212), cross-component
+Shan–Chen forces ``F_f = -Gc psi_f(0) sum w_i psi_g(x+e_i) e_i`` (+ the
+mirror for g, :127-180), the viscosity-weighted common velocity
+``u = (sum_k J_k/omega_k) / (sum_k rho_k/omega_k)`` (:93-115), and BGK
+collision of each component toward the common velocity shifted by its own
+force ``ueq_k = u + F_k/(omega_k rho_k)`` (:318-360).  Per-component Zou/He
+velocity/pressure boundaries (lib ZouHe with ``rho = 3 P + 1``), full
+bounce-back walls.  TotalDensity1/2 globals accumulate per collision node.
+
+The optional shear-layer init (SL_* settings, :252-289) initializes a
+double shear layer with a sinusoidal perturbation for the Kelvin–Helmholtz
+demo; implemented via the same closed forms.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+import jax.numpy as jnp
+
+from tclb_tpu.core.lattice import NodeCtx
+from tclb_tpu.core.registry import ModelDef
+from tclb_tpu.models.d2q9 import E
+from tclb_tpu.ops import lbm
+
+W = lbm.weights(E)
+OPP = lbm.opposite(E)
+OPP18 = np.concatenate([OPP, OPP + 9])
+
+
+def _def() -> ModelDef:
+    d = ModelDef("d2q9_pp_MCMP", ndim=2,
+                 description="Shan-Chen multi-component multi-phase")
+    d.add_densities("f", E)
+    d.add_densities("g", E)
+    d.add_field("psi_f", dx=(-1, 1), dy=(-1, 1))
+    d.add_field("psi_g", dx=(-1, 1), dy=(-1, 1))
+    d.add_stage("BaseIteration", "Run")
+    d.add_stage("CalcPsi_f", "CalcPsi_f")
+    d.add_stage("CalcPsi_g", "CalcPsi_g")
+    d.add_stage("BaseInit", "Init", load_densities=False)
+    d.add_action("Iteration", ("BaseIteration", "CalcPsi_f", "CalcPsi_g"))
+    d.add_action("Init", ("BaseInit", "CalcPsi_f", "CalcPsi_g"))
+    d.add_quantity("Rho", unit="kg/m3")
+    d.add_quantity("Rhof", unit="kg/m3")
+    d.add_quantity("Rhog", unit="kg/m3")
+    d.add_quantity("P", unit="Pa")
+    d.add_quantity("U", unit="m/s", vector=True)
+    d.add_quantity("Ff", unit="N", vector=True)
+    d.add_quantity("Fg", unit="N", vector=True)
+    d.add_setting("omega", comment="one over relaxation time, f")
+    d.add_setting("omega_g", comment="one over relaxation time, g")
+    d.add_setting("nu", default=1 / 6,
+                  derived={"omega": lambda nu: 1.0 / (3 * nu + 0.5)})
+    d.add_setting("nu_g", default=1 / 6,
+                  derived={"omega_g": lambda nu: 1.0 / (3 * nu + 0.5)})
+    d.add_setting("Velocity_f", default=0.0, zonal=True)
+    d.add_setting("Pressure_f", default=0.0, zonal=True)
+    d.add_setting("Velocity_g", default=0.0, zonal=True)
+    d.add_setting("Pressure_g", default=0.0, zonal=True)
+    d.add_setting("Density", default=1.0, zonal=True,
+                  comment="init density of component f")
+    d.add_setting("Density_dry", default=1.0, zonal=True,
+                  comment="init density of component g")
+    d.add_setting("Gc", comment="fluid-fluid interaction")
+    d.add_setting("Gad1", comment="fluid1-wall adhesion")
+    d.add_setting("Gad2", comment="fluid2-wall adhesion")
+    d.add_setting("R", default=1.0, comment="EoS gas const (unused in the "
+                  "live ideal-psi path, kept for config parity)")
+    d.add_setting("T", default=1.0)
+    d.add_setting("a", default=1.0)
+    d.add_setting("b", default=4.0)
+    d.add_setting("Smag", comment="Smagorinsky constant (MRT path only)")
+    d.add_setting("SL_U", comment="shear layer velocity")
+    d.add_setting("SL_lambda", comment="shear layer steepness")
+    d.add_setting("SL_delta", comment="shear layer disturbance")
+    d.add_setting("SL_L", comment="shear layer length scale (0 = off)")
+    d.add_setting("GravitationX")
+    d.add_setting("GravitationY")
+    d.add_global("TotalDensity1", unit="kg/m3")
+    d.add_global("TotalDensity2", unit="kg/m3")
+    d.add_global("PressureLoss", unit="1mPa")
+    d.add_global("OutletFlux", unit="1m2/s")
+    d.add_global("InletFlux", unit="1m2/s")
+    d.add_node_type("Smagorinsky", "LES")
+    d.add_node_type("Stab", "ENTROPIC")
+    return d
+
+
+def calc_psi_f(ctx: NodeCtx):
+    """psi_f = rho_f; wall nodes carry Gad2/Gc for the adhesion force
+    (reference CalcPsi_f, src/d2q9_pp_MCMP/Dynamics.c.Rt:189-200)."""
+    rho = jnp.sum(ctx.group("f"), axis=0)
+    return {"psi_f": jnp.where(ctx.nt_is("Wall"),
+                               ctx.setting("Gad2") / ctx.setting("Gc"), rho)}
+
+
+def calc_psi_g(ctx: NodeCtx):
+    rho = jnp.sum(ctx.group("g"), axis=0)
+    return {"psi_g": jnp.where(ctx.nt_is("Wall"),
+                               ctx.setting("Gad1") / ctx.setting("Gc"), rho)}
+
+
+def _sc_force(ctx: NodeCtx, own: str, other: str):
+    """Cross-component Shan-Chen force (reference getFf/getFg,
+    src/d2q9_pp_MCMP/Dynamics.c.Rt:127-180)."""
+    psi0 = ctx.load(own)
+    fx = sum(float(W[i] * E[i, 0])
+             * ctx.load(other, int(E[i, 0]), int(E[i, 1]))
+             for i in range(1, 9) if E[i, 0])
+    fy = sum(float(W[i] * E[i, 1])
+             * ctx.load(other, int(E[i, 0]), int(E[i, 1]))
+             for i in range(1, 9) if E[i, 1])
+    gc = ctx.setting("Gc")
+    return (-gc * psi0 * fx + ctx.setting("GravitationX"),
+            -gc * psi0 * fy + ctx.setting("GravitationY"))
+
+
+def _common_u(ctx: NodeCtx, f, g):
+    """Viscosity-weighted common velocity (reference getU,
+    src/d2q9_pp_MCMP/Dynamics.c.Rt:93-115)."""
+    dt = f.dtype
+    om_f, om_g = ctx.setting("omega"), ctx.setting("omega_g")
+    jfx = jnp.tensordot(jnp.asarray(E[:, 0], dt), f, axes=1)
+    jfy = jnp.tensordot(jnp.asarray(E[:, 1], dt), f, axes=1)
+    jgx = jnp.tensordot(jnp.asarray(E[:, 0], dt), g, axes=1)
+    jgy = jnp.tensordot(jnp.asarray(E[:, 1], dt), g, axes=1)
+    rf = jnp.sum(f, axis=0)
+    rg = jnp.sum(g, axis=0)
+    den = rf / om_f + rg / om_g
+    den = jnp.where(jnp.abs(den) > 1e-12, den, 1.0)
+    return (jfx / om_f + jgx / om_g) / den, (jfy / om_f + jgy / om_g) / den
+
+
+def _zou_he(ctx: NodeCtx, stack, side, kind, vel_s, pres_s):
+    """Per-component Zou/He on an x face: lib ZouHe with rho = 3 P + 1
+    (reference src/lib/boundary.R:63-104)."""
+    vel = ctx.setting(vel_s)
+    den = 3.0 * ctx.setting(pres_s) + 1.0
+    f = lbm.nebb_boundary(E, W, OPP, stack[:9], 0, side, kind,
+                          vel if kind == "velocity" else den)
+    g = lbm.nebb_boundary(E, W, OPP, stack[9:], 0, side, kind,
+                          ctx.setting(vel_s.replace("_f", "_g"))
+                          if kind == "velocity"
+                          else 3.0 * ctx.setting(pres_s.replace("_f", "_g"))
+                          + 1.0)
+    return jnp.concatenate([f, g])
+
+
+def run(ctx: NodeCtx) -> jnp.ndarray:
+    fg = jnp.concatenate([ctx.group("f"), ctx.group("g")])
+    fg = ctx.boundary_case(fg, {
+        ("Wall", "Solid"): lambda s: s[jnp.asarray(OPP18)],
+        "EVelocity": lambda s: _zou_he(ctx, s, -1, "velocity",
+                                       "Velocity_f", "Pressure_f"),
+        "WPressure": lambda s: _zou_he(ctx, s, +1, "pressure",
+                                       "Velocity_f", "Pressure_f"),
+        "WVelocity": lambda s: _zou_he(ctx, s, +1, "velocity",
+                                       "Velocity_f", "Pressure_f"),
+        "EPressure": lambda s: _zou_he(ctx, s, -1, "pressure",
+                                       "Velocity_f", "Pressure_f"),
+    })
+    f, g = fg[:9], fg[9:]
+    dt = f.dtype
+    rf = jnp.sum(f, axis=0)
+    rg = jnp.sum(g, axis=0)
+    ux, uy = _common_u(ctx, f, g)
+    ffx, ffy = _sc_force(ctx, "psi_f", "psi_g")
+    fgx, fgy = _sc_force(ctx, "psi_g", "psi_f")
+    om_f, om_g = ctx.setting("omega"), ctx.setting("omega_g")
+
+    def shifted(u_c, force, om, rho):
+        safe = jnp.where(rho > 1e-4, rho, 1.0)
+        return jnp.where(rho > 1e-4, u_c + force / (om * safe), u_c)
+
+    uf = (shifted(ux, ffx, om_f, rf), shifted(uy, ffy, om_f, rf))
+    ug = (shifted(ux, fgx, om_g, rg), shifted(uy, fgy, om_g, rg))
+    fc = f - om_f * (f - lbm.equilibrium(E, W, rf, uf))
+    gc = g - om_g * (g - lbm.equilibrium(E, W, rg, ug))
+    coll = ctx.nt_in_group("COLLISION")
+    ctx.add_global("TotalDensity1", rf, where=coll)
+    ctx.add_global("TotalDensity2", rg, where=coll)
+    f = jnp.where(coll[None], fc, f)
+    g = jnp.where(coll[None], gc, g)
+    return ctx.store({"f": f, "g": g})
+
+
+def init(ctx: NodeCtx) -> jnp.ndarray:
+    """Component equilibria from Density/Density_dry; optional double
+    shear layer (reference Init, src/d2q9_pp_MCMP/Dynamics.c.Rt:252-289);
+    wall nodes start empty."""
+    shape = ctx.flags.shape
+    dt = ctx._fields.dtype
+    rho_f = jnp.broadcast_to(ctx.setting("Density"), shape).astype(dt)
+    rho_g = jnp.broadcast_to(ctx.setting("Density_dry"), shape).astype(dt)
+    sl_l = ctx.setting("SL_L")
+    y = jnp.broadcast_to(
+        jnp.arange(shape[0], dtype=dt)[:, None], shape)
+    x = jnp.broadcast_to(jnp.arange(shape[1], dtype=dt)[None, :], shape)
+    sl_on = sl_l > 0
+    safe_l = jnp.where(sl_on, sl_l, 1.0)
+    ux_sl = jnp.where(
+        y < safe_l / 2,
+        ctx.setting("SL_U") * jnp.tanh(
+            ctx.setting("SL_lambda") * (y / safe_l - 0.25)),
+        ctx.setting("SL_U") * jnp.tanh(
+            ctx.setting("SL_lambda") * (0.75 - y / safe_l)))
+    uy_sl = (ctx.setting("SL_delta") * ctx.setting("SL_U")
+             * jnp.sin(2.0 * jnp.pi * (x / safe_l + 0.25)))
+    ux = jnp.where(sl_on, ux_sl, 0.0)
+    uy = jnp.where(sl_on, uy_sl, 0.0)
+    wall = ctx.nt_is("Wall")
+    rho_f = jnp.where(wall, 0.0, rho_f)
+    rho_g = jnp.where(wall, 0.0, rho_g)
+    f = lbm.equilibrium(E, W, rho_f,
+                        (ux + ctx.setting("Velocity_f"), uy))
+    g = lbm.equilibrium(E, W, rho_g,
+                        (ux + ctx.setting("Velocity_g"), uy))
+    return ctx.store({"f": f, "g": g})
+
+
+def get_u(ctx: NodeCtx) -> jnp.ndarray:
+    ux, uy = _common_u(ctx, ctx.group("f"), ctx.group("g"))
+    return jnp.stack([ux, uy, jnp.zeros_like(ux)])
+
+
+def get_p(ctx: NodeCtx) -> jnp.ndarray:
+    """Mixture pressure rho/3 + Gc psi_f psi_g / 3 (reference getP,
+    src/d2q9_pp_MCMP/Dynamics.c.Rt:181-188)."""
+    rho = jnp.sum(ctx.group("f"), axis=0) + jnp.sum(ctx.group("g"), axis=0)
+    return rho / 3.0 + ctx.setting("Gc") * ctx.load("psi_f") \
+        * ctx.load("psi_g") / 3.0
+
+
+def build():
+    def _fvec(own, other):
+        def q(ctx):
+            fx, fy = _sc_force(ctx, own, other)
+            return jnp.stack([fx, fy, jnp.zeros_like(fx)])
+        return q
+    return _def().finalize().bind(
+        run=run, init=init,
+        stages={"CalcPsi_f": calc_psi_f, "CalcPsi_g": calc_psi_g},
+        quantities={
+            "Rho": lambda c: jnp.sum(c.group("f"), axis=0)
+            + jnp.sum(c.group("g"), axis=0),
+            "Rhof": lambda c: jnp.sum(c.group("f"), axis=0),
+            "Rhog": lambda c: jnp.sum(c.group("g"), axis=0),
+            "P": get_p,
+            "U": get_u,
+            "Ff": _fvec("psi_f", "psi_g"),
+            "Fg": _fvec("psi_g", "psi_f"),
+        })

@@ -1,0 +1,258 @@
+"""d3q27_viscoplastic — Bingham viscoplastic rheology (regularized MRT).
+
+Behavioral parity target: reference model ``d3q27_viscoplastic``
+(reference src/d3q27_viscoplastic/Dynamics.c — hand-written, not
+templated).  Single-step stress-projection collision (Vikhansky-style):
+
+* He-forcing terms ``Phi_i = 3 w_i rho (e_i.F)`` and equilibria shifted by
+  ``-Phi/2`` (Dynamics.c:440-478);
+* the non-equilibrium momentum flux ``S_ab = sum_i (f_i - feq_i) e_a e_b``
+  is made deviatoric and contracted; nodes with ``S:S < 2 Y^2`` are
+  UNYIELDED: the stress is written back unscaled (no relaxation — rigid),
+  ``yield_stat = 1``, ``nu_app = 0``; yielded nodes scale the stress by
+  ``c = (6 nu - 1)/(6 nu + 1) + sqrt(2/S:S) Y omega`` — plain BGK recovery
+  for ``Y = 0`` — and report ``nu_app = nu + Y sqrt(S:S / 2)``
+  (Dynamics.c:481-520);
+* write-back ``f_i = 4.5 w_i (e_i . S . e_i') + feq_i + Phi_i`` where the
+  quadratic form carries the off-diagonal doubling of the reference's
+  1/3-1/12-1/48 coefficient table (Dynamics.c:522-538);
+* d3q27 Zou/He velocity & pressure faces on X and Y
+  (``{E,W,S,N}{Velocity,Pressure}_ZouHe``): unknowns take
+  ``f_bb + 6 w_i (e_i.J)`` with the normal momentum imposed/solved and the
+  tangential J chosen to zero the face's tangential momentum
+  (J_t = -3 x tangential momentum of the wall-parallel knowns)
+  (Dynamics.c:175-327);
+* Y/Z mirror symmetries, slice-monitor globals (XY/XZ/YZ slices).
+"""
+
+from __future__ import annotations
+
+import numpy as np
+import jax.numpy as jnp
+
+from tclb_tpu.core.lattice import NodeCtx
+from tclb_tpu.core.registry import ModelDef
+from tclb_tpu.ops import cumulant, lbm
+
+E = cumulant.velocity_set(3)
+W = lbm.weights(E)
+OPP = lbm.opposite(E)
+
+
+def _def() -> ModelDef:
+    d = ModelDef("d3q27_viscoplastic", ndim=3,
+                 description="Bingham viscoplastic (regularized MRT)")
+    d.add_densities("f", E)
+    d.add_density("nu_app")
+    d.add_density("yield_stat")
+    d.add_quantity("P", unit="Pa")
+    d.add_quantity("U", unit="m/s", vector=True)
+    d.add_quantity("nu_app", unit="m2/s")
+    d.add_quantity("yield_stat")
+    d.add_setting("nu", default=1 / 6, comment="plastic viscosity")
+    d.add_setting("Velocity", default=0.0, zonal=True)
+    d.add_setting("Pressure", default=0.0, zonal=True)
+    d.add_setting("ForceX")
+    d.add_setting("ForceY")
+    d.add_setting("ForceZ")
+    d.add_setting("YieldStress", default=0.0)
+    d.add_global("Flux", unit="m3/s")
+    d.add_global("TotalRho", unit="kg")
+    for pl in ("XY", "XZ", "YZ"):
+        for gname in ("vx", "vy", "vz", "rho1", "rho2", "area"):
+            d.add_global(pl + gname)
+    for nt in ("SymmetryY", "SymmetryZ",
+               "NVelocity_ZouHe", "SVelocity_ZouHe", "EVelocity_ZouHe",
+               "WVelocity_ZouHe", "NPressure_ZouHe", "SPressure_ZouHe",
+               "EPressure_ZouHe", "WPressure_ZouHe"):
+        d.add_node_type(nt, "BOUNDARY")
+    for nt in ("XYslice1", "XZslice1", "YZslice1",
+               "XYslice2", "XZslice2", "YZslice2"):
+        d.add_node_type(nt, "ADDITIONALS")
+    return d
+
+
+def _zou_he_3d(ctx, f, axis, side, kind):
+    """d3q27 Zou/He on an axis-normal face (reference Dynamics.c:175-327).
+
+    ``side=+1``: fluid lies in +axis (unknowns move +axis, a W/S-type
+    face); ``side=-1``: the opposite.  Velocity kind imposes the zonal
+    ``Velocity`` as the +axis velocity; pressure kind imposes
+    ``rho = 1 + 3 Pressure``.
+    """
+    dt = f.dtype
+    en = E[:, axis]
+    tang_idx = np.where(en == 0)[0]
+    into_idx = np.where(en == -side)[0]
+    unk_idx = np.where(en == side)[0]
+    s_t = sum(f[int(i)] for i in tang_idx)
+    s_i = sum(f[int(i)] for i in into_idx)
+    if kind == "velocity":
+        v = ctx.setting("Velocity")
+        rho = (s_t + 2.0 * s_i) / (1.0 - side * v)
+        jn = v * rho          # reference: Jn = Velocity * rho (signed)
+    else:
+        rho = 1.0 + 3.0 * ctx.setting("Pressure")
+        jn = (s_t + 2.0 * s_i - rho) / (-side)
+    # tangential J zeroing the face's tangential momentum
+    jt = {}
+    for t_ax in range(3):
+        if t_ax == axis:
+            continue
+        jt[t_ax] = -3.0 * sum(float(E[int(i), t_ax]) * f[int(i)]
+                              for i in tang_idx if E[int(i), t_ax])
+    out = [f[i] for i in range(27)]
+    for i in unk_idx:
+        i = int(i)
+        ej = float(E[i, axis]) * jn
+        for t_ax, val in jt.items():
+            if E[i, t_ax]:
+                ej = ej + float(E[i, t_ax]) * val
+        out[i] = f[int(OPP[i])] + 6.0 * float(W[i]) * ej
+    return jnp.stack(out)
+
+
+def _mirror(f, axis):
+    perm = np.zeros(27, dtype=np.int32)
+    for i, e in enumerate(E):
+        m = e.copy()
+        m[axis] = -m[axis]
+        (j,) = np.where((E == m).all(axis=1))
+        perm[i] = j[0]
+    return f[jnp.asarray(perm)]
+
+
+def _collision(ctx: NodeCtx, f):
+    dt = f.dtype
+    rho = jnp.sum(f, axis=0)
+    fx = ctx.setting("ForceX")
+    fy = ctx.setting("ForceY")
+    fz = ctx.setting("ForceZ")
+    ux = jnp.tensordot(jnp.asarray(E[:, 0], dt), f, axes=1) / rho + fx * 0.5
+    uy = jnp.tensordot(jnp.asarray(E[:, 1], dt), f, axes=1) / rho + fy * 0.5
+    uz = jnp.tensordot(jnp.asarray(E[:, 2], dt), f, axes=1) / rho + fz * 0.5
+    usq = ux * ux + uy * uy + uz * uz
+
+    phi, feq = [], []
+    for i in range(27):
+        ex, ey, ez = (float(v) for v in E[i])
+        ef = ex * fx + ey * fy + ez * fz
+        p = 3.0 * float(W[i]) * rho * ef if (ex or ey or ez) \
+            else jnp.zeros_like(rho)
+        eu = ex * ux + ey * uy + ez * uz
+        fe = float(W[i]) * rho * (1.0 + 3.0 * eu * (1.0 + 1.5 * eu)
+                                  - 1.5 * usq) - 0.5 * p
+        phi.append(p)
+        feq.append(fe)
+
+    # non-equilibrium momentum flux, deviatoric
+    S = {}
+    for a in range(3):
+        for b in range(a, 3):
+            s = None
+            for i in range(27):
+                c = float(E[i, a] * E[i, b])
+                if c == 0.0:
+                    continue
+                t = c * (f[i] - feq[i])
+                s = t if s is None else s + t
+            S[(a, b)] = s
+    tr3 = (S[(0, 0)] + S[(1, 1)] + S[(2, 2)]) / 3.0
+    for a in range(3):
+        S[(a, a)] = S[(a, a)] - tr3
+    scontr = sum((1.0 if a == b else 2.0) * S[(a, b)] * S[(a, b)]
+                 for a in range(3) for b in range(a, 3))
+
+    y = ctx.setting("YieldStress")
+    nu = ctx.setting("nu")
+    omega = 1.0 / (3.0 * nu + 0.5)
+    unyielded = scontr < 2.0 * y * y
+    safe = jnp.where(scontr > 0, scontr, 1.0)
+    sq2s = jnp.sqrt(2.0 / safe)
+    c_bgk = (6.0 * nu - 1.0) / (6.0 * nu + 1.0)
+    c = jnp.where(y < 1e-15, c_bgk, c_bgk + sq2s * y * omega)
+    scale = jnp.where(unyielded, 1.0, c)
+    nu_app = jnp.where(unyielded, 0.0, nu + y / sq2s)
+    yield_stat = jnp.where(unyielded, 1.0, 0.0)
+
+    out = []
+    for i in range(27):
+        ex, ey, ez = (float(v) for v in E[i])
+        quad = None
+        for (a, b), s_ab in S.items():
+            cc = (E[i, a] * E[i, b]) * (1.0 if a == b else 2.0)
+            if cc == 0:
+                continue
+            t = float(cc) * s_ab
+            quad = t if quad is None else quad + t
+        coef = 4.5 * float(W[i]) * quad * scale if quad is not None \
+            else jnp.zeros_like(rho)
+        out.append(coef + feq[i] + phi[i])
+    fc = jnp.stack(out)
+
+    # slice monitors (reference Dynamics.c:540-578)
+    for pl in ("XY", "XZ", "YZ"):
+        s1 = ctx.nt_is(pl + "slice1")
+        ctx.add_global(pl + "vx", ux, where=s1)
+        ctx.add_global(pl + "vy", uy, where=s1)
+        ctx.add_global(pl + "vz", uz, where=s1)
+        ctx.add_global(pl + "rho1", rho, where=s1)
+        ctx.add_global(pl + "area", jnp.ones_like(rho), where=s1)
+        ctx.add_global(pl + "rho2", rho, where=ctx.nt_is(pl + "slice2"))
+    return fc, nu_app, yield_stat
+
+
+def run(ctx: NodeCtx) -> jnp.ndarray:
+    f = ctx.group("f")
+    f = ctx.boundary_case(f, {
+        "EPressure_ZouHe": lambda f: _zou_he_3d(ctx, f, 0, -1, "pressure"),
+        "WPressure_ZouHe": lambda f: _zou_he_3d(ctx, f, 0, +1, "pressure"),
+        "SPressure_ZouHe": lambda f: _zou_he_3d(ctx, f, 1, +1, "pressure"),
+        "NPressure_ZouHe": lambda f: _zou_he_3d(ctx, f, 1, -1, "pressure"),
+        "WVelocity_ZouHe": lambda f: _zou_he_3d(ctx, f, 0, +1, "velocity"),
+        "NVelocity_ZouHe": lambda f: _zou_he_3d(ctx, f, 1, -1, "velocity"),
+        "SVelocity_ZouHe": lambda f: _zou_he_3d(ctx, f, 1, +1, "velocity"),
+        "EVelocity_ZouHe": lambda f: _zou_he_3d(ctx, f, 0, -1, "velocity"),
+        "SymmetryY": lambda f: _mirror(f, 1),
+        "SymmetryZ": lambda f: _mirror(f, 2),
+        ("Wall", "Solid"): lambda f: f[jnp.asarray(OPP)],
+    })
+    fc, nu_app, yield_stat = _collision(ctx, f)
+    coll = ctx.nt_is("MRT")[None]
+    f = jnp.where(coll, fc, f)
+    return ctx.store({"f": f,
+                      "nu_app": jnp.where(coll[0], nu_app,
+                                          ctx.density("nu_app")),
+                      "yield_stat": jnp.where(coll[0], yield_stat,
+                                              ctx.density("yield_stat"))})
+
+
+def init(ctx: NodeCtx) -> jnp.ndarray:
+    shape = ctx.flags.shape
+    dt = ctx._fields.dtype
+    rho = jnp.broadcast_to(1.0 + 3.0 * ctx.setting("Pressure"),
+                           shape).astype(dt)
+    zero = jnp.zeros(shape, dt)
+    f = lbm.equilibrium(E, W, rho, (zero, zero, zero))
+    return ctx.store({"f": f, "nu_app": zero, "yield_stat": zero})
+
+
+def get_u(ctx: NodeCtx) -> jnp.ndarray:
+    f = ctx.group("f")
+    dt = f.dtype
+    rho = jnp.sum(f, axis=0)
+    u = [(jnp.tensordot(jnp.asarray(E[:, a], dt), f, axes=1)
+          + 0.5 * ctx.setting(n)) / rho
+         for a, n in enumerate(("ForceX", "ForceY", "ForceZ"))]
+    return jnp.stack(u)
+
+
+def build():
+    return _def().finalize().bind(
+        run=run, init=init,
+        quantities={
+            "P": lambda c: (jnp.sum(c.group("f"), axis=0) - 1.0) / 3.0,
+            "U": get_u,
+            "nu_app": lambda c: c.density("nu_app"),
+            "yield_stat": lambda c: c.density("yield_stat"),
+        })

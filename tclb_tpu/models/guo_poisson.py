@@ -1,0 +1,40 @@
+"""Guo's Poisson-equation LBM solver — shared by d2q9_npe_guo and
+d2q9_poison_boltzmann (reference src/d2q9_npe_guo/Dynamics.c.Rt:28-30 and
+src/d2q9_poison_boltzmann/Dynamics.c.Rt:16-23 define the identical weights
+and update).
+
+The solver population ``g`` relaxes toward ``wp_i psi`` where
+``wp = (1/9 - 1, 1/9 x8)`` (note the negative rest weight) with the source
+``dt wps RD``, ``RD = -(2/3)(1/2 - tau_psi) dt rho_e / epsilon`` — the
+reference multiplies by dt in BOTH places, giving a dt^2 scaling of the
+source, and we reproduce that literally.  The potential is read back as
+``psi = sum_{i>0} g_i / (1 - wp0)``.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+import jax.numpy as jnp
+
+WP0 = 1.0 / 9.0
+WP = np.array([1.0 / 9.0 - 1.0] + [1.0 / 9.0] * 8)
+WPS = np.array([0.0] + [1.0 / 8.0] * 8)
+
+
+def psi_of(g):
+    """Potential from the solver populations (reference getPsi)."""
+    return sum(g[i] for i in range(1, 9)) / (1.0 - WP0)
+
+
+def wp_stack(dt, ndim):
+    return jnp.asarray(WP, dt).reshape((9,) + (1,) * ndim)
+
+
+def collide(g, psi, rho_e, tau_psi, dt, epsilon):
+    """One Guo Poisson sweep: g' = g - (g - wp psi)/tau + dt wps RD."""
+    dt_ = g.dtype
+    ndim = g.ndim - 1
+    wp = jnp.asarray(WP, dt_).reshape((9,) + (1,) * ndim)
+    wps = jnp.asarray(WPS, dt_).reshape((9,) + (1,) * ndim)
+    rd = -2.0 / 3.0 * (0.5 - tau_psi) * dt * rho_e / epsilon
+    return g - (g - wp * psi) / tau_psi + dt * wps * rd

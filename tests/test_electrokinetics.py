@@ -1,0 +1,119 @@
+"""Physics validation of the electrokinetics models: d2q9_poison_boltzmann
+against the Debye–Hückel solution, d2q9_npe_guo against the
+electro-osmotic-flow structure the reference validates with
+src/d2q9_npe_guo/python/test_eof.py.
+"""
+
+import jax.numpy as jnp
+import numpy as np
+
+from tclb_tpu.core.lattice import Lattice
+from tclb_tpu.models import get_model
+
+
+def test_pb_debye_huckel():
+    """Channel between two zeta-potential walls: for small zeta the
+    Poisson-Boltzmann equation linearizes to psi'' = kappa^2 psi with
+    kappa^2 = 2 n_inf z^2 el^2/(eps kb T); solution
+    psi = zeta cosh(kappa (y - c))/cosh(kappa h/2)."""
+    m = get_model("d2q9_poison_boltzmann")
+    ny, nx = 34, 16
+    zeta = 0.01
+    n_inf, eps = 0.01, 1.0
+    kappa = np.sqrt(2 * n_inf / eps)
+    lat = Lattice(m, (ny, nx), dtype=jnp.float64,
+                  settings={"tau_psi": 1.0, "n_inf": n_inf,
+                            "epsilon": eps, "psi_bc": zeta, "psi0": 0.0})
+    flags = np.full((ny, nx), m.flag_for("BGK"), dtype=np.uint16)
+    flags[0, :] = m.flag_for("Wall")
+    flags[-1, :] = m.flag_for("Wall")
+    lat.set_flags(flags)
+    lat.init()
+    lat.iterate(6000)   # fixed-point sweeps to convergence
+
+    psi = np.asarray(lat.get_quantity("Psi"))[:, nx // 2]
+    assert np.isfinite(psi).all()
+    y = np.arange(ny, dtype=float)
+    # wet-node Dirichlet: walls are rows 0 and ny-1
+    c = (ny - 1) / 2.0
+    ref = zeta * np.cosh(kappa * (y - c)) / np.cosh(kappa * c)
+    err = np.abs(psi[1:-1] - ref[1:-1]).max() / zeta
+    assert err < 0.03, err
+    # subiter counted the sweeps
+    assert float(np.asarray(lat.get_quantity("Subiter")).max()) >= 6000
+
+
+def test_npe_guo_equilibrium_double_layer():
+    """No external field: the ion densities must relax to the Boltzmann
+    distribution n_k = n_inf exp(-+ z el_kbT psi) against the self-
+    consistent psi, and the fluid must stay at rest."""
+    m = get_model("d2q9_npe_guo")
+    ny, nx = 34, 16
+    zeta = 0.05
+    n_inf = 0.01
+    lat = Lattice(m, (ny, nx), dtype=jnp.float64,
+                  settings={"n_inf_0": n_inf, "n_inf_1": n_inf,
+                            "psi_bc": zeta, "psi0": 0.0, "phi0": 0.0,
+                            "phi_bc": 0.0, "el_kbT": 1.0, "epsilon": 1.0,
+                            "nu": 1 / 6, "D": 1 / 6})
+    flags = np.full((ny, nx), m.flag_for("MRT"), dtype=np.uint16)
+    flags[0, :] = m.flag_for("Wall")
+    flags[-1, :] = m.flag_for("Wall")
+    lat.set_flags(flags)
+    lat.init()
+    lat.iterate(8000)
+
+    psi = np.asarray(lat.get_quantity("Psi"))[:, nx // 2]
+    n0 = np.asarray(lat.get_quantity("n0"))[:, nx // 2]
+    n1 = np.asarray(lat.get_quantity("n1"))[:, nx // 2]
+    u = np.asarray(lat.get_quantity("U"))
+    assert np.isfinite(psi).all() and np.isfinite(n0).all()
+    # Boltzmann-distributed ions against the computed psi (interior)
+    sl = slice(2, -2)
+    np.testing.assert_allclose(n0[sl], n_inf * np.exp(-psi[sl]),
+                               rtol=0.02)
+    np.testing.assert_allclose(n1[sl], n_inf * np.exp(+psi[sl]),
+                               rtol=0.02)
+    # counter-ion excess near the positive wall: n1 > n0 at the wall
+    assert n1[1] > n0[1]
+    # fluid at rest (no external field)
+    assert np.abs(u[:2]).max() < 1e-8
+
+
+def test_npe_guo_eof_profile():
+    """Electro-osmotic flow: an external-potential gradient along x (via
+    phi_bc zones at W/E pressure boundaries) over charged walls drives a
+    plug-like flow whose profile follows the Smoluchowski structure
+    u(y) ~ (psi(y) - zeta): maximal at the centre, zero at the walls —
+    the validation target of the reference's python/test_eof.py."""
+    m = get_model("d2q9_npe_guo")
+    ny, nx = 30, 64
+    zeta = 0.05
+    n_inf = 0.01
+    lat = Lattice(m, (ny, nx), dtype=jnp.float64,
+                  settings={"n_inf_0": n_inf, "n_inf_1": n_inf,
+                            "psi_bc": zeta, "psi0": 0.0, "phi0": 0.0,
+                            "phi_bc": 0.0, "el_kbT": 1.0, "epsilon": 1.0,
+                            "nu": 1 / 6, "D": 1 / 6, "rho_bc": 1.0})
+    flags = np.full((ny, nx), m.flag_for("MRT"), dtype=np.uint16)
+    flags[0, :] = m.flag_for("Wall")
+    flags[-1, :] = m.flag_for("Wall")
+    flags[1:-1, 0] = m.flag_for("WPressure", "MRT", zone=1)
+    flags[1:-1, -1] = m.flag_for("EPressure", "MRT")
+    lat.set_flags(flags)
+    lat.set_setting("phi_bc", 0.5, zone=1)   # potential drop along x
+    lat.init()
+    lat.iterate(8000)
+
+    u = np.asarray(lat.get_quantity("U"))
+    ux = u[0][:, nx // 2]
+    psi = np.asarray(lat.get_quantity("Psi"))[:, nx // 2]
+    assert np.isfinite(ux).all()
+    # flow exists and is plug-shaped: centre fast, near-wall slow
+    assert abs(ux[ny // 2]) > 5 * abs(ux[1] - ux[ny // 2] * (
+        (psi[1] - zeta) / (psi[ny // 2] - zeta)))
+    # profile follows (psi - zeta) shape: normalized u matches normalized
+    # (psi - zeta) within a few percent in the interior
+    shape_u = ux / ux[ny // 2]
+    shape_p = (psi - zeta) / (psi[ny // 2] - zeta)
+    np.testing.assert_allclose(shape_u[3:-3], shape_p[3:-3], atol=0.08)

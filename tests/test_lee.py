@@ -1,0 +1,119 @@
+"""Physics validation of d2q9_lee (Lee multiphase, potential forcing).
+
+The double-well chemical potential mu0 = 2 Beta (r-rl)(r-rv)(2r-rv-rl)
+has minima exactly at rho = LiquidDensity and rho = VaporDensity: a flat
+interface must relax to those bulk densities with a tanh profile of width
+set by Kappa/Beta, conserving mass.
+"""
+
+import jax.numpy as jnp
+import numpy as np
+
+from tclb_tpu.core.lattice import Lattice
+from tclb_tpu.models import get_model
+
+RL, RV = 1.0, 0.1
+
+
+def _make(n=64, beta=0.02, kappa=0.02):
+    m = get_model("d2q9_lee")
+    lat = Lattice(m, (n, n), dtype=jnp.float64,
+                  settings={"nu": 1 / 6, "LiquidDensity": RL,
+                            "VaporDensity": RV, "Beta": beta,
+                            "Kappa": kappa, "InitDensity": RV})
+    return m, lat
+
+
+def _set_rho_profile(lat, rho):
+    """Set f to equilibrium at the given density profile (zero velocity)."""
+    base = np.asarray(lat.get_density("f[0]")) * 0  # shape
+    from tclb_tpu.ops import lbm
+    from tclb_tpu.models.d2q9 import E
+    W = lbm.weights(E)
+    feq = np.asarray(lbm.equilibrium(E, W, jnp.asarray(rho),
+                                     (jnp.zeros_like(jnp.asarray(rho)),) * 2))
+    for i in range(9):
+        lat.set_density(f"f[{i}]", feq[i])
+
+
+def test_lee_flat_interface_bulk_densities():
+    n = 64
+    m, lat = _make(n)
+    flags = np.full((n, n), m.flag_for("BGK"), dtype=np.uint16)
+    lat.set_flags(flags)
+    lat.init()
+    y = np.arange(n)
+    prof = RV + (RL - RV) * 0.5 * (1 + np.tanh((y[:, None] - n / 2) / 4.0))
+    rho0 = np.broadcast_to(prof, (n, n)).copy()
+    _set_rho_profile(lat, rho0)
+    lat.iterate(2)   # refresh rho/nu fields from the new f
+    mass0 = float(np.asarray(lat.get_quantity("Rho")).sum())
+
+    lat.iterate(2000)
+    rho = np.asarray(lat.get_quantity("Rho"))
+    assert np.isfinite(rho).all()
+    # Lee's mixed-difference forcing conserves mass only approximately
+    # (the reference ships a Mass global precisely to monitor this drift);
+    # bound the drift rather than demand exactness
+    np.testing.assert_allclose(rho.sum(), mass0, rtol=5e-3)
+    # bulk densities sit near the double-well minima (discrete-lattice
+    # equilibrium shifts the vapor branch by a few percent of rho_l-rho_v)
+    np.testing.assert_allclose(rho[5, :].mean(), RV, atol=0.03)
+    np.testing.assert_allclose(rho[-5, :].mean(), RL, atol=0.03)
+    # interface is monotone along y between the two bulks (the periodic
+    # wrap carries a second, mirrored interface near y=0 — exclude it)
+    mid = rho[:, n // 2]
+    assert (np.diff(mid[8:n - 12]) > -1e-3).all()
+
+
+def test_lee_chemical_potential_flat_in_equilibrium():
+    """At equilibrium the chemical potential nu must be (nearly) uniform
+    across the interface — that is the defining property of the Lee
+    potential form."""
+    n = 64
+    m, lat = _make(n)
+    flags = np.full((n, n), m.flag_for("BGK"), dtype=np.uint16)
+    lat.set_flags(flags)
+    lat.init()
+    y = np.arange(n)
+    prof = RV + (RL - RV) * 0.5 * (1 + np.tanh((y[:, None] - n / 2) / 4.0))
+    _set_rho_profile(lat, np.broadcast_to(prof, (n, n)).copy())
+    lat.iterate(4000)
+    nu = np.asarray(lat.get_quantity("Nu"))
+    rho = np.asarray(lat.get_quantity("Rho"))
+    assert np.isfinite(nu).all()
+    # nu spread across the domain is small compared to the barrier scale
+    barrier = 2 * 0.02 * (RL - RV) ** 3   # ~ mu0 magnitude scale
+    assert nu.max() - nu.min() < 0.2 * barrier, (nu.min(), nu.max())
+    # still two phases
+    assert rho.max() > 0.8 * RL and rho.min() < 2 * RV
+
+
+def test_lee_moving_wall_couette():
+    """Single-phase configuration (rho = liquid everywhere; the double well
+    pins the density at the liquid minimum): a MovingWall lid drives a
+    linear Couette profile."""
+    ny, nx = 32, 16
+    m, lat = _make(ny)
+    lat = Lattice(m, (ny, nx), dtype=jnp.float64,
+                  settings={"nu": 1 / 6, "LiquidDensity": RL,
+                            "VaporDensity": RV, "Beta": 0.02, "Kappa": 0.02,
+                            "InitDensity": RL, "WallDensity": RL,
+                            "MovingWallVelocity": 0.05})
+    flags = np.full((ny, nx), m.flag_for("BGK"), dtype=np.uint16)
+    # the reference MovingWall reconstructs the UPWARD populations
+    # (f2, f5, f6 — src/d2q9_lee/Dynamics.c.Rt:62-71): it is a lid at the
+    # bottom of the fluid
+    flags[0, :] = m.flag_for("MovingWall", "BGK")   # wet node: collides
+    flags[-1, :] = m.flag_for("Wall")
+    lat.set_flags(flags)
+    lat.init()
+    lat.iterate(3000)
+    u = np.asarray(lat.get_quantity("U"))
+    ux = u[0][:, nx // 2]
+    assert np.isfinite(ux).all()
+    # linear profile from ~lid velocity at the bottom to 0 at the wall
+    y = np.arange(1, ny - 1)
+    fit = np.polyfit(y, ux[1:-1], 1)
+    expect_slope = -0.05 / (ny - 1)
+    np.testing.assert_allclose(fit[0], expect_slope, rtol=0.15)

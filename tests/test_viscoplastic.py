@@ -1,0 +1,89 @@
+"""Physics validation of d3q27_viscoplastic: Bingham plug flow.
+
+A force-driven channel of half-width h with yield stress Y has the exact
+profile: sheared zones near the walls, and a rigid plug for
+|y - c| < y0 = Y / (rho g).  The model must (a) recover plain Poiseuille
+when Y = 0, (b) show a flattened plug and unyielded nodes when Y > 0.
+"""
+
+import jax.numpy as jnp
+import numpy as np
+
+from tclb_tpu.core.lattice import Lattice
+from tclb_tpu.models import get_model
+
+
+def _channel(ny, yield_stress, g, niter=4000):
+    m = get_model("d3q27_viscoplastic")
+    nz, nx = 3, 4
+    lat = Lattice(m, (nz, ny, nx), dtype=jnp.float64,
+                  settings={"nu": 1 / 6, "ForceX": g,
+                            "YieldStress": yield_stress})
+    flags = np.full((nz, ny, nx), m.flag_for("MRT"), dtype=np.uint16)
+    flags[:, 0, :] = m.flag_for("Wall")
+    flags[:, -1, :] = m.flag_for("Wall")
+    lat.set_flags(flags)
+    lat.init()
+    lat.iterate(niter)
+    u = np.asarray(lat.get_quantity("U"))
+    ys = np.asarray(lat.get_quantity("yield_stat"))
+    return u[0][nz // 2, :, nx // 2], ys[nz // 2, :, nx // 2]
+
+
+def test_newtonian_limit_poiseuille():
+    """Y = 0 must recover the parabolic Poiseuille profile."""
+    ny, g = 19, 1e-5
+    ux, _ = _channel(ny, 0.0, g)
+    assert np.isfinite(ux).all()
+    y = np.arange(ny, dtype=float)
+    # full-way bounce-back: wall planes half-way between the wall node and
+    # the first fluid node, so the channel spans [0.5, ny-1.5]
+    h = (ny - 2) / 2.0
+    c = (ny - 1) / 2.0
+    nu = 1 / 6
+    ref = g / (2 * nu) * (h ** 2 - (y - c) ** 2)
+    err = np.abs(ux[1:-1] - ref[1:-1]).max() / ref.max()
+    assert err < 0.03, err
+
+
+def test_bingham_plug():
+    """Y > 0: central plug moves rigidly (flat profile, unyielded nodes),
+    velocity is below the Newtonian profile everywhere."""
+    ny, g = 19, 1e-5
+    y0_frac = 0.4    # plug half-width as fraction of channel half-width
+    h = (ny - 1) / 2.0
+    yield_stress = y0_frac * h * g
+    ux_b, ystat = _channel(ny, yield_stress, g, niter=8000)
+    ux_n, _ = _channel(ny, 0.0, g)
+    assert np.isfinite(ux_b).all()
+    # slower than Newtonian everywhere (yield stress dissipates)
+    assert ux_b.max() < ux_n.max()
+    assert ux_b.max() > 0
+    c = ny // 2
+    # plug: central nodes unyielded and flat
+    assert ystat[c] == 1.0
+    plug = np.abs(np.arange(ny) - c) <= y0_frac * h * 0.5
+    spread = ux_b[plug].max() - ux_b[plug].min()
+    assert spread < 0.02 * ux_b.max(), spread
+    # near-wall nodes are yielded (sheared)
+    assert ystat[1] == 0.0 and ystat[-2] == 0.0
+
+
+def test_zou_he_inlet_outlet():
+    """WVelocity/EPressure duct: finite and mass-consistent flow."""
+    m = get_model("d3q27_viscoplastic")
+    nz, ny, nx = 3, 12, 24
+    lat = Lattice(m, (nz, ny, nx), dtype=jnp.float64,
+                  settings={"nu": 1 / 6, "Velocity": 0.02})
+    flags = np.full((nz, ny, nx), m.flag_for("MRT"), dtype=np.uint16)
+    flags[:, 0, :] = m.flag_for("Wall")
+    flags[:, -1, :] = m.flag_for("Wall")
+    flags[:, 1:-1, 0] = m.flag_for("WVelocity_ZouHe", "MRT")
+    flags[:, 1:-1, -1] = m.flag_for("EPressure_ZouHe", "MRT")
+    lat.set_flags(flags)
+    lat.init()
+    lat.iterate(2000)
+    u = np.asarray(lat.get_quantity("U"))
+    assert np.isfinite(u).all()
+    # inflow develops through the duct
+    assert u[0][nz // 2, ny // 2, nx // 2] > 0.01

@@ -26,6 +26,7 @@ _REGISTRY: dict[str, str] = {
     "d3q27_BGK": "tclb_tpu.models.d3q27_bgk",
     "d3q27_BGK_galcor": "tclb_tpu.models.d3q27_bgk:build_galcor",
     "d3q27_cumulant": "tclb_tpu.models.d3q27_cumulant",
+    "d3q27_cumulant_qibb_small": "tclb_tpu.models.d3q27_cumulant_qibb",
     "d3q27_viscoplastic": "tclb_tpu.models.d3q27_viscoplastic",
     "d2q9_new": "tclb_tpu.models.d2q9_new",
     "d2q9_heat": "tclb_tpu.models.d2q9_heat",

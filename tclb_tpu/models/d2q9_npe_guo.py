@@ -79,6 +79,8 @@ def _def() -> ModelDef:
     d.add_setting("psi_bc", default=1.0, zonal=True,
                   comment="zeta potential at walls")
     d.add_setting("t_to_s", default=1.0)
+    # never accumulated — the reference's AddToTotalMomentum call is
+    # commented out (src/d2q9_npe_guo/Dynamics.c.Rt:252); config parity
     d.add_global("TotalMomentum")
     d.add_node_type("BottomSymmetry", "BOUNDARY")
     d.add_node_type("TopSymmetry", "BOUNDARY")

@@ -21,7 +21,7 @@ import jax.numpy as jnp
 from tclb_tpu.core.lattice import NodeCtx
 from tclb_tpu.core.registry import ModelDef
 from tclb_tpu.models.d2q9 import E
-from tclb_tpu.models.guo_poisson import WP0, WP, WPS, \
+from tclb_tpu.models.guo_poisson import WP, \
     psi_of as _psi_of, collide as _guo_collide
 
 

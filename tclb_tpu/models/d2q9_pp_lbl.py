@@ -78,6 +78,9 @@ def _def() -> ModelDef:
     d.add_global("InletFlux", unit="1m2/s")
     d.add_node_type("BottomSymmetry", "BOUNDARY")
     d.add_node_type("TopSymmetry", "BOUNDARY")
+    # declared for config parity; the reference's Run() switch never
+    # dispatches SymmetryRight (its handler exists but is unreachable,
+    # src/d2q9_pp_LBL/Dynamics.c.Rt:70-99,287-300) — same here
     d.add_node_type("RightSymmetry", "BOUNDARY")
     return d
 

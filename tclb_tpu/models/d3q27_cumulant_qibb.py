@@ -1,0 +1,117 @@
+"""d3q27_cumulant_qibb_small — cumulant collision with interpolated
+(Q-cut) bounce-back for off-grid walls.
+
+Behavioral parity target: reference model ``d3q27_cumulant_qibb_small``
+(reference src/d3q27_cumulant_qibb_small/Dynamics.c.Rt; Q-cut storage
+``cut_t``/``CUT_LEN`` in src/types.h:16-20, painted by
+Lattice::CutsOverwrite, src/Lattice.cu.Rt:907-922).  Per streaming link a
+wall-cut distance ``q in [0, 1]`` (fraction of the link inside the fluid)
+drives Bouzidi-style interpolated bounce-back around the cumulant
+collision:
+
+* pre-collision (Dynamics.c.Rt:302-308): on a QIBB node, every cut link
+  replaces its pulled-in population ``f[bounce(i)]`` (which came from the
+  solid side) with the node's OWN pre-streaming ``f_i`` — plain on-node
+  bounce-back — and the post-patch stack is saved as ``f_pre``;
+* post-collision (:480-489): cut links blend
+  ``f_i <- ((1-q) f_pre_i + q (f_i + f_bounce_i)) / (1 + q)``,
+  which reduces to half-way bounce-back at q = 1/2 and anchors the
+  zero-velocity plane at the true wall location.
+
+Cut distances are stored as 26 per-direction Fields ``q[i]`` (sentinel
+``-1`` = no cut = the reference's NO_CUT=255); the geometry helper
+``utils.geometry.cuts_from_sdf`` paints them from a signed distance
+function (the reference quantizes to 0.005 steps — we keep full floats).
+"""
+
+from __future__ import annotations
+
+import numpy as np
+import jax.numpy as jnp
+
+from tclb_tpu.core.lattice import NodeCtx
+from tclb_tpu.models import family
+from tclb_tpu.ops import cumulant, lbm
+
+E = cumulant.velocity_set(3)
+W = lbm.weights(E)
+OPP = lbm.opposite(E)
+
+
+def _def():
+    d = family.base_def("d3q27_cumulant_qibb_small", E,
+                        "3D cumulant with interpolated (Q-cut) bounce-back",
+                        faces="WENS", symmetries="NS", objectives=False)
+    d.add_setting("nubuffer", default=0.01)
+    d.add_setting("GalileanCorrection", default=1.0)
+    d.add_setting("omega_bulk", default=1.0)
+    for ax in ("X", "Y", "Z"):
+        d.add_setting(f"Force{ax}")
+    d.add_global("Flux", unit="m3/s")
+    d.add_node_type("QIBB", "HO_BOUNDARY")
+    d.add_node_type("Buffer", "ADDITIONALS")
+    # per-direction wall-cut distances (reference cut_t Q planes)
+    for i in range(1, 27):
+        d.add_density(f"q[{i}]", group="q")
+    d.add_quantity("P", unit="Pa")
+    return d
+
+
+def _force(ctx: NodeCtx):
+    return tuple(ctx.setting(f"Force{ax}") + g for ax, g in
+                 zip(("X", "Y", "Z"), family.gravity_of(ctx)))
+
+
+def run(ctx: NodeCtx) -> jnp.ndarray:
+    f = ctx.group("f")
+    dt = f.dtype
+    f = family.apply_boundaries(ctx, f, E, W, OPP)
+
+    qibb = ctx.nt_is("QIBB")
+    cuts = ctx.group("q")          # (26, *shape), aligned with E[1:]
+    # pre-collision: cut links take the node's own pre-streaming f_i in
+    # place of the value pulled from the solid side
+    planes = [f[i] for i in range(27)]
+    for i in range(1, 27):
+        has_cut = qibb & (cuts[i - 1] >= 0.0)
+        own = ctx.load(f"f[{i}]")      # un-streamed (pre-pull) value
+        b = int(OPP[i])
+        planes[b] = jnp.where(has_cut, own, planes[b])
+    f = jnp.stack(planes)
+    fpre = f
+
+    shape = f.shape[1:]
+    om_visc = ctx.setting("omega")
+    om_buffer = 1.0 / (3.0 * ctx.setting("nubuffer") + 0.5)
+    om = jnp.where(ctx.nt_is("Buffer"), om_buffer, om_visc).astype(dt)
+    F = f.reshape((3, 3, 3) + shape)
+    Fp, rho, (ux, uy, uz) = cumulant.collide_d3q27(
+        F, om, ctx.setting("omega_bulk"), force=_force(ctx),
+        correlated=True)
+    coll = ctx.nt_in_group("COLLISION")
+    f = jnp.where(coll[None], Fp.reshape((27,) + shape), f)
+    ctx.add_global("Flux", ux, where=coll)
+
+    # post-collision: interpolated bounce-back on cut links
+    planes = [f[i] for i in range(27)]
+    out = list(planes)
+    for i in range(1, 27):
+        has_cut = qibb & (cuts[i - 1] >= 0.0)
+        q = jnp.maximum(cuts[i - 1], 0.0)
+        b = int(OPP[i])
+        blended = ((1.0 - q) * fpre[i] + q * (planes[i] + planes[b])) \
+            / (1.0 + q)
+        out[i] = jnp.where(has_cut, blended, out[i])
+    f = jnp.stack(out)
+    return ctx.store({"f": f})
+
+
+def init(ctx: NodeCtx) -> jnp.ndarray:
+    # preserve painted cuts across Init (they are static geometry data)
+    return family.standard_init(ctx, E, W, extra={"q": ctx.group("q")})
+
+
+def build():
+    q = family.make_getters(E, force_of=_force)
+    q["P"] = lambda c: (jnp.sum(c.group("f"), axis=0) - 1.0) / 3.0
+    return _def().finalize().bind(run=run, init=init, quantities=q)

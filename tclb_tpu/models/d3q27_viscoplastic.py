@@ -56,6 +56,8 @@ def _def() -> ModelDef:
     d.add_setting("ForceY")
     d.add_setting("ForceZ")
     d.add_setting("YieldStress", default=0.0)
+    # Flux/TotalRho are declared but never accumulated in the reference
+    # either (no AddToFlux/AddToTotalRho in Dynamics.c) — config parity
     d.add_global("Flux", unit="m3/s")
     d.add_global("TotalRho", unit="kg")
     for pl in ("XY", "XZ", "YZ"):

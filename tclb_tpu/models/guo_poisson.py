@@ -26,10 +26,6 @@ def psi_of(g):
     return sum(g[i] for i in range(1, 9)) / (1.0 - WP0)
 
 
-def wp_stack(dt, ndim):
-    return jnp.asarray(WP, dt).reshape((9,) + (1,) * ndim)
-
-
 def collide(g, psi, rho_e, tau_psi, dt, epsilon):
     """One Guo Poisson sweep: g' = g - (g - wp psi)/tau + dt wps RD."""
     dt_ = g.dtype

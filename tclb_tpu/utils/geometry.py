@@ -383,3 +383,60 @@ class Geometry:
         if self.ndim == 2:
             return self.flags[0]
         return self.flags
+
+
+# --------------------------------------------------------------------------- #
+# Q-cut painting (interpolated bounce-back wall distances)
+# --------------------------------------------------------------------------- #
+
+
+def cuts_from_sdf(sdf, shape, E) -> np.ndarray:
+    """Per-direction wall-cut distances from a signed distance function
+    (the host-side analogue of the reference's Geometry cut generation
+    consumed by Lattice::CutsOverwrite, src/Lattice.cu.Rt:907-922;
+    storage semantics of src/types.h:16-20 with -1 as NO_CUT and the
+    fraction kept as a float instead of the reference's 0.005 quanta).
+
+    ``sdf(coords)`` maps an (ndim, *shape) array of node coordinates
+    (index order matching ``shape``: z,y,x / y,x) to signed distances —
+    positive in the fluid, negative in the solid.  For every fluid node
+    whose ``E[i]`` neighbor is solid, the cut fraction along the link is
+    the linear interpolation of the surface crossing:
+    ``q = sdf(x) / (sdf(x) - sdf(x + e_i))``.
+
+    Returns (len(E) - 1, *shape) float32, aligned with ``E[1:]`` (the
+    rest vector carries no link).
+    """
+    shape = tuple(int(s) for s in shape)
+    ndim = len(shape)
+    grids = np.meshgrid(*[np.arange(s, dtype=np.float64) for s in shape],
+                        indexing="ij")
+    coords = np.stack(grids)
+    d0 = np.asarray(sdf(coords), dtype=np.float64)
+    out = np.full((len(E) - 1,) + shape, -1.0, dtype=np.float32)
+    for i in range(1, len(E)):
+        # E rows are (dx[, dy[, dz]]) = x first; index order is reversed
+        off = np.array(list(E[i][::-1]) + [0] * (ndim - len(E[i])),
+                       dtype=np.float64)[:ndim]
+        dn = np.asarray(sdf(coords + off.reshape((ndim,) + (1,) * ndim)),
+                        dtype=np.float64)
+        crossing = (d0 > 0.0) & (dn <= 0.0)
+        with np.errstate(divide="ignore", invalid="ignore"):
+            q = d0 / (d0 - dn)
+        out[i - 1] = np.where(crossing, np.clip(q, 0.0, 1.0), -1.0)
+    return out
+
+
+def sphere_sdf(center, radius):
+    """SDF of a solid sphere/cylinder: negative inside (coords in index
+    order, matching :func:`cuts_from_sdf`); pass fewer center components
+    than dimensions to get a cylinder extruded along the leading axes."""
+    center = np.asarray(center, dtype=np.float64)
+
+    def sdf(coords):
+        nd = coords.shape[0]
+        use = coords[nd - len(center):]
+        r = np.sqrt(sum((use[k] - center[k]) ** 2
+                        for k in range(len(center))))
+        return r - radius
+    return sdf

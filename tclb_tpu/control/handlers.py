@@ -138,6 +138,7 @@ class acSolve(GenericAction):
                     next_it = it
             steps = next_it
             s.iter += steps
+            s.update_synthetic_turbulence(steps)
             s.lattice.iterate(steps)
             for h in s.hands:
                 if h.now(s.iter):
@@ -641,8 +642,89 @@ class acNop(Handler):
         return 0
 
 
+class acSyntheticTurbulence(Handler):
+    """<SyntheticTurbulence>: configure the synthetic-inflow turbulence
+    generator (reference acSyntheticTurbulence,
+    src/Handlers.cpp.Rt:2532-2642).  Wave parameters accept
+    <name>WaveLength (inverted), <name>WaveNumber, or <name>WaveFrequency
+    (x 2 pi), all unit-converted."""
+
+    def _wave_number(self, name: str):
+        u = self.solver.units
+        val = None
+        a = self.node.get(name + "WaveLength")
+        if a is not None:
+            val = 1.0 / u.alt(a)
+        a = self.node.get(name + "WaveNumber")
+        if a is not None:
+            val = u.alt(a)
+        a = self.node.get(name + "WaveFrequency")
+        if a is not None:
+            val = u.alt(a) * 2.0 * math.pi
+        return val
+
+    def init(self) -> int:
+        super().init()
+        from tclb_tpu.utils.turbulence import SyntheticTurbulence
+        st = SyntheticTurbulence()
+        nmodes = int(self.node.get("Modes", 100))
+        spec = self.node.get("Spectrum", "Von Karman")
+        if spec == "Von Karman":
+            main_wn = self._wave_number("Main")
+            diff_wn = self._wave_number("Diffusion")
+            if main_wn is None or diff_wn is None:
+                raise ValueError(
+                    "Von Karman spectrum needs MainWaveNumber and "
+                    "DiffusionWaveNumber (or WaveLength/Frequency forms)")
+            max_wn = self._wave_number("Shortest")
+            if max_wn is None:
+                max_wn = 2.0 * math.pi / 4.0   # 2 pi over 4 elements
+            min_wn = self._wave_number("Longest")
+            if min_wn is None:
+                min_wn = main_wn / 2.0
+            frac = st.set_von_karman(main_wn, diff_wn, min_wn, max_wn,
+                                     nmodes)
+            if frac < 0.7:
+                print(f"NOTICE: synthetic turbulence resolves only "
+                      f"{frac:.0%} of the spectrum")
+        elif spec == "One Wave":
+            wn = self._wave_number("")
+            if wn is None:
+                raise ValueError("One Wave spectrum needs a WaveNumber")
+            st.set_one_wave(wn)
+        else:
+            raise ValueError(f"unknown spectrum {spec!r}")
+        t_wn = self._wave_number("Time")
+        if t_wn is None:
+            raise ValueError("synthetic turbulence needs TimeWaveNumber "
+                             "(iteration correlation scale)")
+        st.set_time_scale(t_wn)
+        self.solver.synthetic_turbulence = st
+        return 0
+
+
+class cbAveraging(Handler):
+    """<Average>: reset the running averages (average=True densities) and
+    restart the sample counter (reference cbAveraging,
+    src/Handlers.cpp.Rt:1158-1174 + Lattice::resetAverage,
+    src/Lattice.cu.Rt:1193-1201)."""
+
+    kind = "callback"
+
+    def init(self) -> int:
+        super().init()
+        self.solver.lattice.reset_average()
+        return 0
+
+    def do_it(self) -> int:
+        self.solver.lattice.reset_average()
+        return 0
+
+
 _HANDLERS = {
     "CLBConfig": MainContainer,
+    "SyntheticTurbulence": acSyntheticTurbulence,
+    "Average": cbAveraging,
     "Solve": acSolve,
     "Repeat": acRepeat,
     "Geometry": acGeometry,

@@ -55,6 +55,7 @@ class Solver:
         self.start_walltime = time.time()
         self.conf_name = "run"
         self.stop_flag = False
+        self.synthetic_turbulence = None   # set by <SyntheticTurbulence>
 
     # -- naming (reference Solver::outIterFile/outGlobalFile) --------------- #
 
@@ -85,7 +86,24 @@ class Solver:
     def gauge(self) -> None:
         self.units.make_gauge()
 
-    # -- logging (reference initLog/writeLog, src/Solver.cpp.Rt:120-206) ---- #
+    # -- synthetic turbulence (reference ST.Generate per iteration,
+    #    src/Lattice.cu.Rt:391-397; segment-wise here — utils/turbulence) -- #
+
+    def update_synthetic_turbulence(self, steps: int) -> None:
+        """Advance the SynthT* coupling planes by one handler segment of
+        ``steps`` iterations with the variance-exact AR(1) update."""
+        st = self.synthetic_turbulence
+        m = self.model
+        if st is None or st.nmodes == 0 or "SynthT" not in m.groups:
+            return
+        fluct = st.evaluate(self.shape)
+        k_aa = st.ar1_factor(steps)
+        k_bb = float(np.sqrt(max(0.0, 1.0 - k_aa * k_aa)))
+        lat = self.lattice
+        names = [m.storage_names[i] for i in m.groups["SynthT"]]
+        for comp, name in enumerate(names):
+            old = np.asarray(lat.get_density(name))
+            lat.set_density(name, k_aa * old + k_bb * fluct[comp])
 
     def log_row(self) -> dict[str, float]:
         m = self.model

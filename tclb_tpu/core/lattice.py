@@ -152,7 +152,7 @@ class NodeCtx:
     def __init__(self, model: Model, fields: jnp.ndarray, raw: jnp.ndarray,
                  flags: jnp.ndarray, params: SimParams,
                  loader: Optional[Callable] = None,
-                 iteration: Any = 0):
+                 iteration: Any = 0, avg_start: Any = 0):
         self.model = model
         self._fields = fields      # pulled (streamed) storage
         self._raw = raw            # un-streamed storage (for Field loads)
@@ -160,8 +160,15 @@ class NodeCtx:
         self.flags = flags
         self.params = params
         self.iteration = iteration
+        self.avg_start = avg_start
         self._globals: dict[str, jnp.ndarray] = {}
         self._zone_ids = None
+
+    def avg_samples(self) -> jnp.ndarray:
+        """Iterations accumulated into the running averages since the last
+        <Average> reset (reference ``iter - reset_iter``); at least 1."""
+        n = jnp.asarray(self.iteration) - jnp.asarray(self.avg_start)
+        return jnp.maximum(n.astype(self._fields.dtype), 1.0)
 
     # -- field access ------------------------------------------------------- #
 
@@ -442,9 +449,10 @@ def make_sampled_iterate(model: Model, points: np.ndarray,
                 for k in range(points.shape[1]))
     qfns = [(q, model.quantity_fns[q]) for q in quantities]
 
-    def sample(state: LatticeState, params: SimParams) -> jnp.ndarray:
+    def sample(state: LatticeState, params: SimParams,
+               avg_start: Any = 0) -> jnp.ndarray:
         ctx = NodeCtx(model, state.fields, state.fields, state.flags, params,
-                      iteration=state.iteration)
+                      iteration=state.iteration, avg_start=avg_start)
         cols = []
         for _, fn in qfns:
             with jax.default_matmul_precision("highest"):
@@ -455,10 +463,11 @@ def make_sampled_iterate(model: Model, points: np.ndarray,
                 cols.append(plane[(slice(None),) + idx].T)
         return jnp.concatenate(cols, axis=-1)
 
-    def iterate(state: LatticeState, params: SimParams, niter: int):
+    def iterate(state: LatticeState, params: SimParams, niter: int,
+                avg_start=0):
         def body(s, _):
             s2 = step(s, params)
-            return s2, sample(s2, params)
+            return s2, sample(s2, params, avg_start)
         return jax.lax.scan(body, state, None, length=niter)
 
     return iterate
@@ -513,6 +522,7 @@ class Lattice:
         self._init = jax.jit(make_action_step(model, "Init"), donate_argnums=0)
         self.sampler = None
         self._iterate_sampled = None
+        self.avg_start = 0    # iteration of the last <Average> reset
 
     # -- setup -------------------------------------------------------------- #
 
@@ -581,7 +591,8 @@ class Lattice:
         if self.sampler is not None:
             it0 = int(self.state.iteration)
             self.state, samples = self._iterate_sampled(
-                self.state, self.params, niter)
+                self.state, self.params, niter,
+                jnp.asarray(self.avg_start, jnp.int32))
             self.sampler.append(it0, np.asarray(samples))
         else:
             self.state = self._iterate(self.state, self.params, niter)
@@ -604,9 +615,26 @@ class Lattice:
         fn = self.model.quantity_fns[name]
         ctx = NodeCtx(self.model, self.state.fields, self.state.fields,
                       self.state.flags, self.params,
-                      iteration=self.state.iteration)
+                      iteration=self.state.iteration,
+                      avg_start=self.avg_start)
         with jax.default_matmul_precision("highest"):
             return fn(ctx)
+
+    def reset_average(self) -> None:
+        """Zero the ``average=True`` storage planes and restart the sample
+        counter (reference Lattice::resetAverage,
+        src/Lattice.cu.Rt:1193-1201: CudaMemset of each averaged plane +
+        ``reset_iter = iter``)."""
+        m = self.model
+        idx = [i for i, d in enumerate(m.densities) if d.average]
+        if idx:
+            fields = self.state.fields
+            for i in idx:
+                fields = fields.at[i].set(0.0)
+            self.state = dataclasses.replace(self.state, fields=fields)
+            if self._place is not None:
+                self.state, self.params = self._place()
+        self.avg_start = int(self.state.iteration)
 
     def get_density(self, name: str) -> jnp.ndarray:
         return self.state.fields[self.model.storage_index[name]]

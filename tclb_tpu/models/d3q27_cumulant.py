@@ -65,12 +65,18 @@ def run(ctx: NodeCtx) -> jnp.ndarray:
     dt = f.dtype
     vel = ctx.setting("Velocity")
     # turbulent inlet: mean + synthetic fluctuation from the coupling
-    # buffers scaled by the zonal Turbulence intensity
-    # (reference WVelocityTurbulent, src/d3q27_cumulant/Dynamics.c.Rt)
-    turb_u = vel + ctx.setting("Turbulence") * ctx.density("SynthTX")
+    # buffers (SynthT* carry the AR(1)-smoothed unit-variance field filled
+    # by the <SyntheticTurbulence> handler) scaled by the zonal Turbulence
+    # intensity; the full fluctuation VECTOR is imposed — normal component
+    # on top of the mean, tangential via the ZouHe V3 mechanism (reference
+    # WVelocityTurbulent, src/d3q27_cumulant/Dynamics.c.Rt:210-222)
+    turb = ctx.setting("Turbulence")
+    turb_u = vel + turb * ctx.density("SynthTX")
     extra = {
         "WVelocityTurbulent": lambda f: lbm.nebb_boundary(
-            E, W, OPP, f, 0, +1, "velocity", turb_u),
+            E, W, OPP, f, 0, +1, "velocity", turb_u,
+            vt={1: turb * ctx.density("SynthTY"),
+                2: turb * ctx.density("SynthTZ")}),
     }
     f = family.apply_boundaries(ctx, f, E, W, OPP, extra=extra)
 
@@ -111,15 +117,13 @@ def get_p(ctx: NodeCtx) -> jnp.ndarray:
 
 
 def get_avg_u(ctx: NodeCtx) -> jnp.ndarray:
-    n = jnp.maximum(ctx.iteration.astype(ctx._fields.dtype)
-                    if hasattr(ctx.iteration, "astype") else 1.0, 1.0)
-    return ctx.group("avgU") / n
+    # samples since the last <Average> reset (reference divides by
+    # iter - reset_iter; ctx.avg_samples carries reset_iter)
+    return ctx.group("avgU") / ctx.avg_samples()
 
 
 def get_avg_p(ctx: NodeCtx) -> jnp.ndarray:
-    n = jnp.maximum(ctx.iteration.astype(ctx._fields.dtype)
-                    if hasattr(ctx.iteration, "astype") else 1.0, 1.0)
-    return ctx.density("avgP") / n
+    return ctx.density("avgP") / ctx.avg_samples()
 
 
 def build():

@@ -8,6 +8,7 @@ with numpy (constants) + jnp (traced), and let XLA do the fusing.
 from __future__ import annotations
 
 import math
+from typing import Optional
 
 import numpy as np
 import jax.numpy as jnp
@@ -163,7 +164,8 @@ def bgk_collide(E: np.ndarray, W: np.ndarray, f: jnp.ndarray, omega,
 
 
 def nebb_boundary(E: np.ndarray, W: np.ndarray, OPP: np.ndarray,
-                  f: jnp.ndarray, axis: int, side: int, kind: str, value):
+                  f: jnp.ndarray, axis: int, side: int, kind: str, value,
+                  vt: Optional[dict] = None):
     """Generic straight-wall velocity/pressure boundary by non-equilibrium
     bounce-back (Zou & He's closure generalized to any face/velocity set —
     the role of the reference's per-model ZouHe() template,
@@ -178,7 +180,13 @@ def nebb_boundary(E: np.ndarray, W: np.ndarray, OPP: np.ndarray,
     ``Q_t`` the tangential momentum carried by the wall-parallel knowns
     (Zou & He's d2q9 ``0.5 (f[2]-f[4])`` terms, generalized to 3D a la
     Hecht & Harting) — the closure the reference ZouHe applies
-    (src/lib/boundary.R); the imposed tangential velocity is zero.
+    (src/lib/boundary.R); the imposed tangential velocity defaults to zero.
+
+    ``vt`` optionally imposes NONZERO tangential velocities:
+    ``{axis: value}`` planes/scalars — the reference lib ZouHe's ``V3``
+    argument (used by the turbulent inlet,
+    src/d3q27_cumulant/Dynamics.c.Rt:210-222): each adds ``rho v_t`` to
+    the corresponding tangential momentum target.
     """
     dt = f.dtype
     en = E[:, axis].astype(np.int64)
@@ -198,8 +206,15 @@ def nebb_boundary(E: np.ndarray, W: np.ndarray, OPP: np.ndarray,
     # non-equilibrium bounce-back: f_i = f_opp(i) + 6 w_i rho e_i.u
     eu = jnp.asarray(en, dt).reshape(sh) * un
     corr = 6.0 * jnp.asarray(W, dt).reshape(sh) * rho * eu
-    # tangential closure: redistribute the excess tangential momentum of the
-    # wall-parallel populations onto the unknowns (target u_t = 0)
+    # tangential closure: redistribute the excess tangential momentum of
+    # the wall-parallel populations onto the unknowns, weight-proportional:
+    # corr_i += 6 w_i e_t J_t with J_t = -3 q_t + rho v_t — exactly the
+    # reference lib ZouHe's solved tangential moment + V3 shift
+    # (src/lib/boundary.R:83-101; the hand-written d3q27 BCs' Jy/Jz =
+    # tangential sums / (-1/3) are the same solve).  In d2q9 this reduces
+    # to the classic 0.5 (f[2]-f[4]) terms (6 w_diag 3 = 1/2); a flat
+    # 0.5 q_t per unknown would over-correct 3x on d3q19/d3q27 faces and
+    # blow up under sheared/turbulent inflow.
     for t_ax in range(E.shape[1]):
         if t_ax == axis:
             continue
@@ -207,7 +222,11 @@ def nebb_boundary(E: np.ndarray, W: np.ndarray, OPP: np.ndarray,
         if not et.any():
             continue
         q_t = jnp.sum((tang * jnp.asarray(et, dt)).reshape(sh) * f, axis=0)
-        corr = corr - jnp.asarray(et, dt).reshape(sh) * (0.5 * q_t)
+        j_t = -3.0 * q_t
+        if vt and t_ax in vt:
+            j_t = j_t + rho * vt[t_ax]
+        corr = corr + 6.0 * jnp.asarray(W, dt).reshape(sh) \
+            * jnp.asarray(et, dt).reshape(sh) * j_t
     f_bb = f[jnp.asarray(OPP)]
     return jnp.where(jnp.asarray(en == side).reshape(sh), f_bb + corr, f)
 

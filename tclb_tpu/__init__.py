@@ -12,7 +12,7 @@ kernels with Tapenade (reference tools/makeAD), this framework uses `jax.grad`
 with checkpoint policies.
 """
 
-__version__ = "0.1.0"
+__version__ = "0.2.0"
 
 from tclb_tpu.core.registry import ModelDef, Model  # noqa: F401
 from tclb_tpu.core.lattice import Lattice  # noqa: F401

@@ -1,0 +1,122 @@
+"""Command-line entry point: ``python -m tclb_tpu`` (or the ``tclb``
+console script).
+
+Parity target: the reference's per-model binaries
+``CLB/<model>/main case.xml [devices]`` (reference src/main.cpp.Rt:220-252)
+— one runtime here, the model selected by flag or by the config's
+``<CLBConfig model=...>`` attribute, plus catalogue introspection commands
+(the reference generates per-model wiki docs instead,
+src/Model.md.Rt/src/Models.md.Rt).
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+
+
+def _cmd_run(args) -> int:
+    import xml.etree.ElementTree as ET
+
+    # honor the config's model attribute when --model is absent
+    model_name = args.model
+    if model_name is None:
+        root = ET.parse(args.case).getroot()
+        model_name = root.get("model")
+    if model_name is None:
+        print("error: no --model flag and no model= attribute on "
+              "<CLBConfig>", file=sys.stderr)
+        return 2
+
+    import jax
+    import jax.numpy as jnp
+    from tclb_tpu.control.solver import run_config
+    from tclb_tpu.models import get_model
+
+    model = get_model(model_name)
+    mesh = None
+    if args.mesh:
+        import numpy as np
+        from jax.sharding import Mesh
+        axes = tuple(int(v) for v in args.mesh.split("x"))
+        names = ("y", "x") if model.ndim == 2 else ("z", "y", "x")
+        if len(axes) != len(names):
+            print(f"error: --mesh needs {len(names)} factors for a "
+                  f"{model.ndim}D model", file=sys.stderr)
+            return 2
+        n = int(np.prod(axes))
+        mesh = Mesh(np.asarray(jax.devices()[:n]).reshape(axes), names)
+    dtype = {"f32": jnp.float32, "f64": jnp.float64}[args.precision]
+    if dtype is jnp.float64:
+        jax.config.update("jax_enable_x64", True)
+
+    solver = run_config(args.case, model, mesh=mesh, dtype=dtype,
+                        output=args.output)
+    print(f"done: {solver.iter} iterations")
+    return 0
+
+
+def _cmd_models(args) -> int:
+    from tclb_tpu.models import get_model, list_models
+    for name in list_models():
+        if args.verbose:
+            m = get_model(name)
+            print(f"{name:32s} {m.ndim}D  {m.description}")
+        else:
+            print(name)
+    return 0
+
+
+def _cmd_describe(args) -> int:
+    """Model introspection (the reference's generated per-model wiki page,
+    src/Model.md.Rt)."""
+    from tclb_tpu.models import get_model
+    m = get_model(args.model)
+    info = {
+        "name": m.name,
+        "ndim": m.ndim,
+        "description": m.description,
+        "densities": list(m.storage_names),
+        "settings": [{"name": s.name, "default": s.default,
+                      "zonal": s.zonal, "comment": s.comment}
+                     for s in m.settings],
+        "quantities": sorted(m.quantity_fns),
+        "globals": [g.name for g in m.globals_],
+        "node_types": sorted(m.node_types),
+        "stages": sorted(m.stages),
+        "actions": {k: list(v) for k, v in m.actions.items()},
+    }
+    print(json.dumps(info, indent=2, default=str))
+    return 0
+
+
+def main(argv=None) -> int:
+    p = argparse.ArgumentParser(
+        prog="tclb", description="TPU-native lattice-Boltzmann framework")
+    sub = p.add_subparsers(dest="cmd", required=True)
+
+    r = sub.add_parser("run", help="run an XML case file")
+    r.add_argument("case", help="case.xml config")
+    r.add_argument("--model", "-m", help="model name (or model= attr in "
+                   "the config)")
+    r.add_argument("--output", "-o", default=None, help="output prefix")
+    r.add_argument("--mesh", default=None,
+                   help="device mesh, e.g. 2x4 (z-y-x major)")
+    r.add_argument("--precision", choices=("f32", "f64"), default="f32")
+    r.set_defaults(fn=_cmd_run)
+
+    ls = sub.add_parser("models", help="list the model catalogue")
+    ls.add_argument("--verbose", "-v", action="store_true")
+    ls.set_defaults(fn=_cmd_models)
+
+    d = sub.add_parser("describe", help="dump a model's registry as JSON")
+    d.add_argument("model")
+    d.set_defaults(fn=_cmd_describe)
+
+    args = p.parse_args(argv)
+    return args.fn(args)
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -19,6 +19,7 @@ from typing import Optional
 import numpy as np
 
 from tclb_tpu.control.solver import ITERATION_STOP, Solver
+from tclb_tpu.utils import log
 
 
 class Handler:
@@ -111,6 +112,9 @@ class MainContainer(GenericAction):
         self.every_iter = 0.0
         if self.node.get("output"):
             self.solver.output_prefix = self.node.get("output")
+        # annotated provenance copy of the config (reference MainContainer
+        # dump with version/precision/backend, src/Handlers.cpp.Rt:1504-1522)
+        self.solver.dump_config(self.node)
         ret = self.execute_internal()
         self.unstack()
         return ret
@@ -140,6 +144,7 @@ class acSolve(GenericAction):
             s.iter += steps
             s.update_synthetic_turbulence(steps)
             s.lattice.iterate(steps)
+            s.progress(steps)
             for h in s.hands:
                 if h.now(s.iter):
                     r = h.do_it()
@@ -223,7 +228,7 @@ class acParams(Handler):
                 if zname in s.geometry.setting_zones:
                     zone = s.geometry.setting_zones[zname]
                 else:
-                    print(f"WARNING: unknown zone {zname!r} "
+                    log.warning(f"unknown zone {zname!r} "
                           f"(setting {par})")
                     continue
             if par in m.setting_index:
@@ -351,7 +356,7 @@ class conControl(Handler):
                 if zname in s.geometry.setting_zones:
                     zones = [s.geometry.setting_zones[zname]]
                 else:
-                    print(f"WARNING: unknown zone {zname!r} (Control "
+                    log.warning(f"unknown zone {zname!r} (Control "
                           f"setting {par})")
                     continue
             if par not in s.model.setting_index:
@@ -486,7 +491,7 @@ class cbFailcheck(Handler):
                 continue
             arr = np.asarray(s.lattice.get_quantity(q.name))
             if not np.isfinite(arr).all():
-                print(f"Failcheck: {q.name} has non-finite values")
+                log.warning(f"Failcheck: {q.name} has non-finite values")
                 bad = True
                 break
         if bad:
@@ -685,8 +690,8 @@ class acSyntheticTurbulence(Handler):
             frac = st.set_von_karman(main_wn, diff_wn, min_wn, max_wn,
                                      nmodes)
             if frac < 0.7:
-                print(f"NOTICE: synthetic turbulence resolves only "
-                      f"{frac:.0%} of the spectrum")
+                log.notice(f"synthetic turbulence resolves only "
+                           f"{frac:.0%} of the spectrum")
         elif spec == "One Wave":
             wn = self._wave_number("")
             if wn is None:

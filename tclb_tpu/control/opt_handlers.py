@@ -26,6 +26,7 @@ from tclb_tpu.adjoint import (BSpline, CompositeDesign, Fourier,
                               make_steady_gradient, make_unsteady_gradient,
                               optimize, threshold_topology)
 from tclb_tpu.control.handlers import Handler, GenericAction, register_handler
+from tclb_tpu.utils import log
 from tclb_tpu.control.solver import Solver
 
 
@@ -197,9 +198,9 @@ class acFDTest(GenericAction):
         worst = max((r["rel_err"] for r in records
                      if not (r["adjoint"] == 0 and abs(r["fd"]) < 1e-12)),
                     default=0.0)
-        print(f"FDTest: objective={float(obj):.6g} worst rel err={worst:.3e}")
+        log.info(f"FDTest: objective={float(obj):.6g} worst rel err={worst:.3e}")
         for r in records:
-            print(f"  component {r['index']}: adjoint={r['adjoint']:.8g} "
+            log.info(f"  component {r['index']}: adjoint={r['adjoint']:.8g} "
                   f"fd={r['fd']:.8g} rel_err={r['rel_err']:.3e}")
         return 0
 
@@ -266,7 +267,7 @@ class acOptimize(GenericAction):
 
         def cb(k, obj, theta):
             s.opt_iter = k
-            print(f"Optimize[{method}] eval {k}: objective={obj:.8g}")
+            log.info(f"Optimize[{method}] eval {k}: objective={obj:.8g}")
 
         theta0 = design.get(s.lattice.state, s.lattice.params)
         theta, obj = optimize(grad_fn, theta0, method=method,

@@ -189,19 +189,17 @@ class NodeCtx:
         streaming strategy so sharded runs fetch across shard boundaries."""
         return self._loader(self.model.storage_index[name], dx, dy, dz)
 
-    def store(self, groups: dict[str, jnp.ndarray]) -> jnp.ndarray:
-        """Write group stacks back into the full storage stack and return it
-        (the reference's push_<Stage> writes, src/LatticeAccess.inc.cpp.Rt:216-225).
-        Unmentioned storage keeps its streamed value."""
-        buf = self._fields
-        for g, stack in groups.items():
-            idx = self.model.groups[g]
-            if len(idx) == 1:
-                buf = buf.at[idx[0]].set(stack[0] if stack.ndim > buf.ndim - 1
-                                         else stack)
-            else:
-                buf = buf.at[jnp.array(idx)].set(stack)
-        return buf
+    def store(self, groups: dict[str, jnp.ndarray]) -> dict:
+        """Declare the stage's write set: group/plane name -> new stack
+        (the reference's push_<Stage> writes,
+        src/LatticeAccess.inc.cpp.Rt:216-225, restricted to the stage's
+        ``save`` set, AddStage in src/conf.R:290).  The engine writes ONLY
+        these planes back into storage; unmentioned planes keep their
+        previous (un-streamed) value — which equals the streamed value for
+        every zero-velocity plane, and saves the HBM write for
+        never-changing planes (BC buffers, coupling fields, cut
+        distances)."""
+        return groups
 
     # -- settings ----------------------------------------------------------- #
 
@@ -346,16 +344,27 @@ def make_stage_step(model: Model, stage_name: str,
                       loader=streaming.make_loader(raw),
                       iteration=state.iteration)
         new_fields = fn(ctx)
-        # A stage may return a partial update (dict name->plane): only the
-        # named planes are saved, everything else keeps its UN-streamed
-        # storage — the reference's per-stage save set (AddStage save=...,
-        # e.g. d2q9_kuper's CalcPhi saves only phi while reading streamed f,
-        # src/d2q9_kuper/Dynamics.R:15-19).  A full-array return (ctx.store)
-        # is a streaming stage: it persists the pulled+collided populations.
+        # A stage returns its write set as a dict (group or plane name ->
+        # stack/plane): only the named planes are saved, everything else
+        # keeps its UN-streamed storage — the reference's per-stage save
+        # set (AddStage save=..., src/conf.R:290; e.g. d2q9_kuper's
+        # CalcPhi saves only phi while reading streamed f).  This is the
+        # cheap half of the 1R+1W traffic story: never-changing planes
+        # (BC buffers, SynthT, cut distances) are not rewritten per step.
+        # A full-array return still means "replace the whole stack".
         if isinstance(new_fields, dict):
             buf = raw
-            for name, plane in new_fields.items():
-                buf = buf.at[model.storage_index[name]].set(plane)
+            for name, stack in new_fields.items():
+                if name in model.groups:
+                    idx = model.groups[name]
+                    if len(idx) == 1:
+                        plane = stack[0] if stack.ndim > buf.ndim - 1 \
+                            else stack
+                        buf = buf.at[idx[0]].set(plane)
+                    else:
+                        buf = buf.at[jnp.array(idx)].set(stack)
+                else:
+                    buf = buf.at[model.storage_index[name]].set(stack)
             new_fields = buf
         # Solid/Wall nodes keep the engine's semantics from the model's Run();
         # nothing special here — BCs are the model's job via ctx.boundary_case.
@@ -638,6 +647,17 @@ class Lattice:
 
     def get_density(self, name: str) -> jnp.ndarray:
         return self.state.fields[self.model.storage_index[name]]
+
+    def set_density_planes(self, values: dict) -> None:
+        """Write several storage planes with ONE device placement (a
+        per-plane set_density would re-shard the whole state each time)."""
+        fields = self.state.fields
+        for name, value in values.items():
+            fields = fields.at[self.model.storage_index[name]].set(
+                jnp.asarray(value, dtype=self.dtype))
+        self.state = dataclasses.replace(self.state, fields=fields)
+        if self._place is not None:
+            self.state, self.params = self._place()
 
     def set_density(self, name: str, value: np.ndarray) -> None:
         self.state = dataclasses.replace(

@@ -53,13 +53,10 @@ def _neq_stress(ctx: NodeCtx, f: jnp.ndarray):
 
 
 def run(ctx: NodeCtx) -> jnp.ndarray:
-    out = d2q9_heat.run(ctx)
+    out = d2q9_heat.run(ctx)   # write-set dict {"f": ..., "T": ...}
     # erosion: Destroy nodes lose scalar at rate * SS^power
-    m = ctx.model
-    fidx = jnp.asarray(m.groups["f"])
-    tidx = jnp.asarray(m.groups["T"])
-    f = out[fidx]
-    fT = out[tidx]
+    f = out["f"]
+    fT = out["T"]
     _, _, _, ss = _neq_stress(ctx, f)
     rate = ctx.setting("DestructionRate") \
         * jnp.power(jnp.maximum(ss, 1e-30), ctx.setting("DestructionPower"))
@@ -68,7 +65,7 @@ def run(ctx: NodeCtx) -> jnp.ndarray:
                       jnp.ones_like(rate))
     ctx.add_global("DestroyedCellFlux",
                    jnp.sum(fT, axis=0) * (1.0 - scale), where=destroy)
-    return out.at[tidx].set(fT * scale[None])
+    return {**out, "T": fT * scale[None]}
 
 
 def build():

@@ -30,18 +30,15 @@ def _def():
 
 
 def run(ctx: NodeCtx) -> jnp.ndarray:
-    out = d2q9_heat.run(ctx)
+    out = d2q9_heat.run(ctx)   # write-set dict {"f": ..., "T": ...}
     # temperature additionally diffuses through Solid regions
-    m = ctx.model
-    tidx = jnp.asarray(m.groups["T"])
-    fT = out[tidx]
+    fT = out["T"]
     temp = jnp.sum(fT, axis=0)
-    dt = fT.dtype
     z = jnp.zeros_like(temp)
     om_s = 1.0 / (3.0 * ctx.setting("SolidAlfa") + 0.5)
     tc = fT + om_s * (_t_eq(temp, z, z) - fT)
     solid = ctx.nt_is("Solid")[None]
-    return out.at[tidx].set(jnp.where(solid, tc, fT))
+    return {**out, "T": jnp.where(solid, tc, fT)}
 
 
 def build():

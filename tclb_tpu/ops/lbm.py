@@ -224,7 +224,12 @@ def nebb_boundary(E: np.ndarray, W: np.ndarray, OPP: np.ndarray,
         q_t = jnp.sum((tang * jnp.asarray(et, dt)).reshape(sh) * f, axis=0)
         j_t = -3.0 * q_t
         if vt and t_ax in vt:
-            j_t = j_t + rho * vt[t_ax]
+            # full imposition: the j_t -> total-momentum slope of the 6 w
+            # distribution is 1/3, so the target needs 3 rho v_t.  (The
+            # reference lib ZouHe adds only rho V3 here — lib/boundary.R:
+            # 83-101 — which imposes a third of the requested tangential
+            # velocity; deliberate deviation, documented.)
+            j_t = j_t + 3.0 * rho * vt[t_ax]
         corr = corr + 6.0 * jnp.asarray(W, dt).reshape(sh) \
             * jnp.asarray(et, dt).reshape(sh) * j_t
     f_bb = f[jnp.asarray(OPP)]

@@ -153,7 +153,9 @@ class Solver:
         k_bb = float(np.sqrt(max(0.0, 1.0 - k_aa * k_aa)))
         lat = self.lattice
         idx = list(m.groups["SynthT"])
-        old = np.asarray(lat.state.fields)[idx]
+        # slice on device first: only the SynthT planes cross to the host
+        import jax.numpy as jnp
+        old = np.asarray(lat.state.fields[jnp.asarray(idx)])
         lat.set_density_planes(
             {m.storage_names[i]: k_aa * old[c] + k_bb * fluct[c]
              for c, i in enumerate(idx)})

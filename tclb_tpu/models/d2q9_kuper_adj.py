@@ -32,10 +32,9 @@ def calc_phi(ctx: NodeCtx):
     return {"phi": out["phi"] * ctx.density("wd")}
 
 
-def init(ctx: NodeCtx) -> jnp.ndarray:
-    out = d2q9_kuper.init(ctx)
-    wd = jnp.ones((1,) + ctx.flags.shape, out.dtype)
-    return out.at[ctx.model.storage_index["wd"]].set(wd[0])
+def init(ctx: NodeCtx):
+    out = d2q9_kuper.init(ctx)   # write-set dict
+    return {**out, "wd": jnp.ones(ctx.flags.shape, ctx._fields.dtype)}
 
 
 def build():

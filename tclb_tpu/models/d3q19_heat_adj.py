@@ -5,11 +5,24 @@ Behavioral parity targets: reference models ``d3q19_heat_adj``,
 ``d3q19_heat_adj_art`` and ``d3q19_heat_adj_prop``
 (reference src/d3q19_heat_adj*/Dynamics.R, ADJOINT=1): d3q19 flow +
 advected temperature with a design field ``w`` — Brinkman velocity
-penalization and w-interpolated diffusivity.  The reference's _art/_prop
-variants differ in how their Tapenade tapes are generated/propagated —
-an implementation detail of source-transform AD with no analogue here
-(jax.grad differentiates the same physics) — so all three names share one
-TPU-native physics definition.
+penalization and w-interpolated diffusivity.  The variants differ in how
+the design field penalizes momentum:
+
+* base: post-collision momentum scaled by ``w`` (w=0 kills the flow);
+* ``_art``: momentum scaled by ``omT = 2 w - 1`` — w=0 REVERSES the
+  momentum, a bounce-back-like 'artificial' solid that penalizes leakage
+  harder (reference src/d3q19_heat_adj_art/Dynamics.c:361-366);
+* ``_prop``: the design weight PROPAGATES along +x through the streamed
+  pair ``w0/w1``: on Propagate-flagged nodes
+  ``w0 = w - PropagateX (1 - w1(x-1))`` — upstream solid material shades
+  the nodes behind it (continuous-casting-style moving design,
+  reference src/d3q19_heat_adj_prop/Dynamics.c.Rt:199-203); momentum and
+  diffusivity use the propagated ``w0`` and a ``MaterialPenalty`` global
+  ``w0 (1 - w0)`` penalizes intermediate material (:230-232).
+
+The reference's Tapenade tape differences between the variants are an
+implementation detail of source-transform AD with no analogue here —
+``jax.grad`` differentiates each variant's own physics.
 """
 
 from __future__ import annotations
@@ -23,7 +36,7 @@ from tclb_tpu.models.d3q19 import E, OPP, W
 from tclb_tpu.ops import lbm
 
 
-def _make(name: str):
+def _make(name: str, variant: str = "base"):
     def _def():
         d = family.base_def(name, E, "3D conjugate-heat topology opt",
                             faces="WE", symmetries="NS")
@@ -41,6 +54,16 @@ def _make(name: str):
         d.add_global("HeatFlux")
         d.add_global("Material")
         d.add_global("Drag")
+        if variant == "prop":
+            # streamed weight pair: w0 streams -x, w1 streams +x
+            # (reference 'weight fluid-solid moving in X',
+            # src/d3q19_heat_adj_prop/Dynamics.R:78-81)
+            d.add_density("w0", dx=-1, group="wm")
+            d.add_density("w1", dx=1, group="wm")
+            d.add_setting("PropagateX", default=0.0,
+                          comment="strength of +x design propagation")
+            d.add_global("MaterialPenalty")
+            d.add_node_type("Propagate", "ADDITIONALS")
         return d
 
     def run(ctx: NodeCtx) -> jnp.ndarray:
@@ -57,27 +80,49 @@ def _make(name: str):
                 jnp.broadcast_to(t_in, shape).astype(dt),
                 tuple(jnp.zeros(shape, dt) for _ in range(3))),
         })
+        extra_store = {}
+        if variant == "prop":
+            # propagated weight: pulled w1 carries the upstream (x-1)
+            # value after streaming
+            w1_up = ctx.density("w1")
+            w_eff = jnp.where(ctx.nt_is("Propagate"),
+                              w - ctx.setting("PropagateX") * (1.0 - w1_up),
+                              w)
+            w_eff = jnp.clip(w_eff, 0.0, 1.0)
+            extra_store["wm"] = jnp.stack([w_eff, w_eff])
+        else:
+            w_eff = w
         rho = jnp.sum(f, axis=0)
         u = tuple(jnp.tensordot(jnp.asarray(E[:, a], dt), f, axes=1) / rho
                   for a in range(3))
         om = ctx.setting("omega")
         feq = lbm.equilibrium(E, W, rho, u)
         coll_mask = ctx.nt_in_group("COLLISION")
-        ctx.add_global("Drag", (1.0 - w) * jnp.abs(u[0]), where=coll_mask)
-        u2 = tuple(c * w for c in u)
+        ctx.add_global("Drag", (1.0 - w_eff) * jnp.abs(u[0]),
+                       where=coll_mask)
+        if variant == "art":
+            # w=0 reverses the momentum (bounce-back-like artificial
+            # solid, reference _art omT = w*2-1, Dynamics.c:361-366)
+            scale = 2.0 * w_eff - 1.0
+        else:
+            scale = w_eff
+        u2 = tuple(c * scale for c in u)
         fc = f + om * (feq - f) + (lbm.equilibrium(E, W, rho, u2) - feq)
         temp = jnp.sum(fT, axis=0)
-        alfa = ctx.setting("FluidAlfa") * w \
-            + ctx.setting("SolidAlfa") * (1.0 - w)
+        alfa = ctx.setting("FluidAlfa") * w_eff \
+            + ctx.setting("SolidAlfa") * (1.0 - w_eff)
         om_t = 1.0 / (4.0 * alfa + 0.5)
         tc = fT + om_t[None] * (_t_eq(temp, u2) - fT)
         coll = coll_mask[None]
         f = jnp.where(coll, fc, f)
         fT = jnp.where(coll, tc, fT)
         ctx.add_global("HeatFlux", temp * u2[0], where=ctx.nt_is("Outlet"))
-        ctx.add_global("Material", 1.0 - w,
+        ctx.add_global("Material", 1.0 - w_eff,
                        where=ctx.nt_in_group("DESIGNSPACE"))
-        return ctx.store({"f": f, "T": fT})
+        if variant == "prop":
+            ctx.add_global("MaterialPenalty", w_eff * (1.0 - w_eff),
+                           where=ctx.nt_in_group("DESIGNSPACE"))
+        return ctx.store({"f": f, "T": fT, **extra_store})
 
     def init(ctx: NodeCtx) -> jnp.ndarray:
         shape = ctx.flags.shape
@@ -88,8 +133,10 @@ def _make(name: str):
         w = 1.0 - jnp.broadcast_to(ctx.setting("Porocity"),
                                    shape).astype(dt)
         w = jnp.where(ctx.nt_is("Solid"), jnp.zeros_like(w), w)
-        return family.standard_init(ctx, E, W,
-                                    extra={"T": fT, "w": w[None]})
+        extra = {"T": fT, "w": w[None]}
+        if variant == "prop":
+            extra["wm"] = jnp.stack([w, w])
+        return family.standard_init(ctx, E, W, extra=extra)
 
     def build():
         q = family.make_getters(E, force_of=family.gravity_of)
@@ -102,5 +149,5 @@ def _make(name: str):
 
 
 build = _make("d3q19_heat_adj")
-build_art = _make("d3q19_heat_adj_art")
-build_prop = _make("d3q19_heat_adj_prop")
+build_art = _make("d3q19_heat_adj_art", variant="art")
+build_prop = _make("d3q19_heat_adj_prop", variant="prop")

@@ -87,7 +87,7 @@ def run(ctx: NodeCtx) -> jnp.ndarray:
     F = f.reshape((3, 3, 3) + shape)
     Fp, rho, (ux, uy, uz) = cumulant.collide_d3q27(
         F, om, ctx.setting("omega_bulk"), force=_force(ctx),
-        correlated=True)
+        correlated=True, galilean=ctx.setting("GalileanCorrection"))
     coll = ctx.nt_in_group("COLLISION")
     f = jnp.where(coll[None], Fp.reshape((27,) + shape), f)
     ctx.add_global("Flux", ux, where=coll)

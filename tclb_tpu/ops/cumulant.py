@@ -81,7 +81,8 @@ def _decentralize(k: jnp.ndarray, u, axis: int) -> jnp.ndarray:
 
 
 def collide_d3q27(F: jnp.ndarray, omega, omega_bulk=1.0,
-                  force=(0.0, 0.0, 0.0), correlated: bool = True):
+                  force=(0.0, 0.0, 0.0), correlated: bool = True,
+                  galilean=None):
     """Cumulant (``correlated=True``) or cascaded central-moment
     (``correlated=False``, the factorized-equilibrium d3q27 MRT) collision.
 
@@ -89,6 +90,14 @@ def collide_d3q27(F: jnp.ndarray, omega, omega_bulk=1.0,
     order of :func:`velocity_set`).  ``force`` is an acceleration applied as
     a velocity shift in the back-transform (exact-difference forcing, like
     the reference's velocity-shift forcing in d2q9/d3q27 kernels).
+
+    ``galilean`` (0..1) applies Geier's Galilean-invariance correction to
+    the diagonal second-order relaxation: velocity-gradient estimates from
+    the diagonal cumulants, ``dxu = -omega/2 (2c200 - c020 - c002)
+    - omega_b/2 (c200 + c020 + c002 - 1)`` etc., enter the deviatoric/trace
+    combinations as ``-3(1 - omega/2)(ux^2 dxu - uy^2 dyv)`` corrections
+    (reference src/d3q27_cumulant/Dynamics.c.Rt:299-319, the
+    ``GalileanCorrection`` setting that round-1 declared but never read).
     Returns (F', rho, (ux, uy, uz))."""
     m = _raw_moments(F, 3)
     rho = m[0, 0, 0]
@@ -107,15 +116,35 @@ def collide_d3q27(F: jnp.ndarray, omega, omega_bulk=1.0,
 
     # relax: trace with omega_bulk toward rho (cs2 = 1/3 per axis),
     # deviatoric + off-diagonal with omega (reference cumulant relaxation,
-    # src/d3q27_cumulant/Dynamics.c.Rt)
-    tr = kxx + kyy + kzz
-    tr_p = tr + omega_bulk * (rho - tr)
-    def dev(a, b, c):
-        d = a - (a + b + c) / 3.0
-        return (1.0 - omega) * d
-    kxx_p = dev(kxx, kyy, kzz) + tr_p / 3.0
-    kyy_p = dev(kyy, kxx, kzz) + tr_p / 3.0
-    kzz_p = tr_p - kxx_p - kyy_p
+    # src/d3q27_cumulant/Dynamics.c.Rt); expressed through the reference's
+    # a/b/cc combinations so the Galilean correction drops in verbatim
+    cxx, cyy, czz = kxx * inv, kyy * inv, kzz * inv
+    a_c = (1.0 - omega) * (cxx - cyy)
+    b_c = (1.0 - omega) * (cxx - czz)
+    cc_c = omega_bulk + (1.0 - omega_bulk) * (cxx + cyy + czz)
+    if galilean is not None:
+        # velocity-gradient estimates + correction terms
+        # (reference Dynamics.c.Rt:299-319); u includes the half force
+        uxh = ux + 0.5 * force[0]
+        uyh = uy + 0.5 * force[1]
+        uzh = uz + 0.5 * force[2]
+        dxu = -0.5 * omega * (2.0 * cxx - cyy - czz) \
+            - 0.5 * omega_bulk * (cxx + cyy + czz - 1.0)
+        dyv = dxu + 1.5 * omega * (cxx - cyy)
+        dzw = dxu + 1.5 * omega * (cxx - czz)
+        gc1 = 3.0 * (1.0 - 0.5 * omega) * (uxh * uxh * dxu
+                                           - uyh * uyh * dyv)
+        gc2 = 3.0 * (1.0 - 0.5 * omega) * (uxh * uxh * dxu
+                                           - uzh * uzh * dzw)
+        gc3 = 3.0 * (1.0 - 0.5 * omega_bulk) * (uxh * uxh * dxu
+                                                + uyh * uyh * dyv
+                                                + uzh * uzh * dzw)
+        a_c = a_c - gc1 * galilean
+        b_c = b_c - gc2 * galilean
+        cc_c = cc_c - gc3 * galilean
+    kxx_p = rho * (a_c + b_c + cc_c) / 3.0
+    kyy_p = rho * (cc_c - 2.0 * a_c + b_c) / 3.0
+    kzz_p = rho * (cc_c - 2.0 * b_c + a_c) / 3.0
     one_m = 1.0 - omega
     kxy_p, kxz_p, kyz_p = one_m * kxy, one_m * kxz, one_m * kyz
 

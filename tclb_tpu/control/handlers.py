@@ -182,6 +182,8 @@ class acGeometry(Handler):
         s = self.solver
         s.geometry.load(self.node)
         s.lattice.set_flags(s.geometry.result())
+        if self.node.get("export") == "vti":
+            s.write_geometry_vti()
         return 0
 
 
@@ -585,6 +587,15 @@ class cbSaveBinary(Handler):
 
     def do_it(self) -> int:
         s = self.solver
+        comp = self.node.get("comp")
+        if comp:
+            # per-component dump (reference saveComp,
+            # src/Solver.cpp.Rt:480-510: one density -> one .comp file)
+            fn = self.node.get("filename") \
+                or s.out_path(f"Save_{comp}", "npy")
+            np.save(fn if fn.endswith(".npy") else fn + ".npy",
+                    np.asarray(s.lattice.get_density(comp)))
+            return 0
         fn = self.node.get("filename") or s.out_path("Save", "npz")
         s.lattice.save(fn[:-4] if fn.endswith(".npz") else fn)
         return 0
@@ -602,6 +613,14 @@ class acLoadBinary(Handler):
         fn = self.node.get("filename")
         if not fn:
             raise ValueError("LoadBinary needs filename=")
+        comp = self.node.get("comp")
+        if comp:
+            # per-component restore (reference loadComp,
+            # src/Solver.cpp.Rt:512-545); mirror SaveBinary's suffixing
+            if not fn.endswith(".npy"):
+                fn = fn + ".npy"
+            self.solver.lattice.set_density(comp, np.load(fn))
+            return 0
         self.solver.lattice.load(fn)
         return 0
 

@@ -203,6 +203,24 @@ class Solver:
             out[q.name] = np.asarray(self.lattice.get_quantity(q.name))
         return out
 
+    def write_geometry_vti(self) -> str:
+        """Write the painted geometry as VTI: raw flags, one 0/1 layer per
+        node-type GROUP, and the settings-zone ids (the reference writes
+        the geometry's node-type layers through vtkWriteLattice,
+        src/vtkLattice.cpp.Rt:33-46)."""
+        from tclb_tpu.utils.vtk import write_vti
+        m = self.model
+        flags = np.asarray(self.lattice.state.flags)
+        arrays = {"Flag": flags}
+        for group, mask in m.group_masks.items():
+            if group in ("ALL", "SETTINGZONE") or mask == 0:
+                continue
+            arrays[group] = ((flags & mask) != 0).astype(np.uint8)
+        arrays["Zone"] = (flags >> m.zone_shift).astype(np.uint16)
+        path = self.out_path("geometry", "vti", with_iter=False)
+        write_vti(path, arrays)
+        return path
+
     def write_vtk(self, what: Optional[set[str]] = None) -> str:
         from tclb_tpu.utils.vtk import write_pvti, write_vti
         arrays = self.quantity_arrays(what)

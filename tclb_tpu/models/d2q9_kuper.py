@@ -103,8 +103,12 @@ def _force(ctx: NodeCtx, f: jnp.ndarray):
     phi0 = ctx.load("phi")
     fx = jnp.zeros_like(phi0)
     fy = jnp.zeros_like(phi0)
+    # the reference samples phi at the NEGATIVE directions (ph = phi(-e_i),
+    # src/d2q9_kuper/Dynamics.c.Rt:19) while weighting with +e_i — with the
+    # -2/3 multiplier this sets the force sign; sampling at +e_i (the
+    # round-1 bug) inverted the interaction and blew up large domains
     for i in range(1, 9):
-        phii = ctx.load("phi", int(E[i, 0]), int(E[i, 1]))
+        phii = ctx.load("phi", -int(E[i, 0]), -int(E[i, 1]))
         r = a * phii * phii + (1.0 - 2.0 * a) * phii * phi0
         g = float(GS[i])
         fx = fx + g * r * float(E[i, 0])

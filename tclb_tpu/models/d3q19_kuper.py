@@ -68,7 +68,8 @@ def run(ctx: NodeCtx) -> jnp.ndarray:
     fy = jnp.zeros_like(phi0)
     fz = jnp.zeros_like(phi0)
     for i in range(1, 19):
-        phii = ctx.load("phi", int(E[i, 0]), int(E[i, 1]), int(E[i, 2]))
+        # phi sampled at -e_i like the reference (see d2q9_kuper._force)
+        phii = ctx.load("phi", -int(E[i, 0]), -int(E[i, 1]), -int(E[i, 2]))
         r = a * phii * phii + (1.0 - 2.0 * a) * phii * phi0
         g = float(GS[i])
         fx = fx + g * r * float(E[i, 0])

@@ -288,6 +288,8 @@ class Geometry:
                 if direction in ("LowerLeft", "LowerRight"):
                     ys = 1.0 - ys
                 self._paint((xs - ys) < 1e-10, reg)
+            elif tag == "Sweep":
+                self._draw_sweep(n, reg)
             elif tag == "Text":
                 self._draw_text(n, reg)
             elif tag == "PythonInline":
@@ -378,6 +380,41 @@ class Geometry:
                     if k not in ("mask", "mode", "name")})
                 self.draw(holder)
 
+    def _draw_sweep(self, n, reg) -> None:
+        """<Sweep order= step=|steps= r=><Point x= y= z= r=/>...</Sweep>:
+        paint a tube of (varying) radius swept along a clamped uniform
+        B-spline through the Points (reference loadSweep,
+        src/Geometry.cpp.Rt:579-634; spline of src/spline.h:9-43)."""
+        order = int(n.get("order", "1"))
+        dl = 1e-3
+        if n.get("step") is not None:
+            dl = float(n.get("step"))
+        if n.get("steps") is not None:
+            dl = 1.0 / self.units.alt(n.get("steps"))
+        def_r = self.units.alt(n.get("r", "1"))
+        pts = []
+        for par in n:
+            if par.tag == "Point":
+                pts.append((self.units.alt(par.get("x", "0")),
+                            self.units.alt(par.get("y", "0")),
+                            self.units.alt(par.get("z", "0")),
+                            self.units.alt(par.get("r"))
+                            if par.get("r") is not None else def_r))
+        if not pts:
+            return
+        if order > len(pts) - 1:
+            order = len(pts) - 1
+        ctrl = np.asarray(pts, dtype=np.float64)     # (n, 4): x,y,z,r
+        # inclusive of l=1 so the tube always reaches the last Point
+        ls = np.append(np.arange(0.0, 1.0, dl), 1.0)
+        samples = np.stack([_bspline(l, ctrl, order) for l in ls])
+        mask = np.zeros((reg.nz, reg.ny, reg.nx), dtype=bool)
+        z, y, x = self._grid(reg)
+        for x0, y0, z0, r in samples:
+            d2 = (x - x0) ** 2 + (y - y0) ** 2 + (z - z0) ** 2
+            mask |= d2 < r * r
+        self._paint(mask, reg)
+
     def result(self) -> np.ndarray:
         """Painted flags, shaped for the model's dimensionality."""
         if self.ndim == 2:
@@ -440,3 +477,29 @@ def sphere_sdf(center, radius):
                         for k in range(len(center))))
         return r - radius
     return sdf
+
+
+def _bspline_knot(i: int, n: int, k: int) -> float:
+    """Clamped uniform knot vector (reference knot_bs, src/spline.h:9-14)."""
+    if i < k + 1:
+        return 0.0
+    if i < n:
+        return (i - k) / (n - k)
+    return 1.0
+
+
+def _bspline(x: float, ctrl: np.ndarray, k: int) -> np.ndarray:
+    """De Boor evaluation on a clamped uniform B-spline, vectorized over
+    the control-point columns (reference bspline_mod, src/spline.h:16-34)."""
+    p = ctrl.copy()
+    n = len(p)
+    i = int(np.floor(x * (n - k))) + k
+    k = min(k, n - 1)
+    i = min(max(i, k), n - 1)
+    for j in range(k, 0, -1):
+        for l in range(j):
+            lo = _bspline_knot(i - l, n, k)
+            hi = _bspline_knot(i - l + j, n, k)
+            a = (x - lo) / (hi - lo) if hi > lo else 0.0
+            p[i - l] = a * p[i - l] + (1.0 - a) * p[i - l - 1]
+    return p[i]

@@ -89,24 +89,22 @@ CHANNEL3D = """<?xml version="1.0"?>
 
 DROP = """<?xml version="1.0"?>
 <CLBConfig version="2.0" output="{out}/">
-    <Geometry nx="24" ny="24">
+    <Geometry nx="64" ny="64">
         <MRT><Box/></MRT>
         <None name="zdrop">
-            <Sphere dx="7" nx="10" dy="7" ny="10"/>
+            <Sphere dx="20" nx="24" dy="20" ny="24"/>
         </None>
     </Geometry>
     <Model>
-        <Params nu="0.18"/>
-        <!-- the reference drop.xml vapor-bubble ratio (225x at 512^2 over
-             500k iterations) needs room the reduced golden does not have;
-             a dense drop at the 24^2 scale of tests/test_models.py's
-             stable kuper case pins the same code paths deterministically -->
+        <Params omega="1"/>
+        <!-- the REAL drop.xml parameters (225x density ratio), reduced
+             from 512^2/500k to 64^2/300 -->
         <Params Density="3.2600529440452366"
-                Density-zdrop="4.76"
+                Density-zdrop="0.014500641645077492"
                 Temperature="0.56" FAcc="1" Magic="0.01"
                 MagicA="-0.152" MagicF="-0.6666666666666"/>
     </Model>
-    <Solve Iterations="100"/>
+    <Solve Iterations="300"/>
 </CLBConfig>
 """
 

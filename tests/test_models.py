@@ -155,44 +155,38 @@ def test_heat_advects_temperature():
 
 
 def test_kuper_phase_separation():
-    """Sub-critical temperature: a uniform density near-critical separates /
-    stays stable, pressure stays finite (Laplace-law smoke test)."""
+    """Reference drop.xml regime: a vapor bubble (rho=0.0145) inside
+    liquid (rho=3.26) at T=0.56 persists with a sharp interface — the
+    vdW pseudopotential holds the 225x density ratio (with the round-1
+    sign-flipped force this configuration exploded within 20 steps)."""
     m = get_model("d2q9_kuper")
-    shape = (24, 24)
-    # reference example/drop.xml: T=0.56 (subcritical), rho_c = 3.26
+    shape = (48, 48)
     lat = Lattice(m, shape, dtype=jnp.float64,
-                  settings={"nu": 0.18, "Temperature": 0.56,
-                            "Density": 3.26, "Magic": 0.01,
-                            "FAcc": 1.0})
+                  settings={"omega": 1.0, "Temperature": 0.56,
+                            "Density": 3.2600529440452366, "Magic": 0.01,
+                            "FAcc": 1.0, "MagicA": -0.152,
+                            "MagicF": -2.0 / 3.0})
+    # vapor bubble via a settings zone (the drop.xml <None name="zdrop">
+    # mechanism) so Init computes f and phi consistently in one pass
     flags = np.full(shape, m.flag_for("MRT"), dtype=np.uint16)
+    yy, xx = np.mgrid[0:48, 0:48]
+    bubble = ((yy - 24) ** 2 + (xx - 24) ** 2) < 100
+    flags[bubble] = m.flag_for("MRT", zone=1)
     lat.set_flags(flags)
+    lat.set_setting("Density", 0.014500641645077492, zone=1)
     lat.init()
-    # seed a denser drop in the center
-    rho = np.full(shape, 3.26)
-    yy, xx = np.mgrid[0:24, 0:24]
-    rho += 1.5 * (((yy - 12) ** 2 + (xx - 12) ** 2) < 25)
-    from tclb_tpu.ops import lbm as _lbm
-    from tclb_tpu.models.d2q9 import E as E9
-    W9 = _lbm.weights(E9)
-    feq = _lbm.equilibrium(E9, W9, jnp.asarray(rho),
-                           (jnp.zeros(shape), jnp.zeros(shape)))
-    for i in range(9):
-        lat.set_density(f"f[{i}]" if "f[0]" in m.storage_index else f"f{i}",
-                        np.asarray(feq[i]))
-    # refresh phi after the manual density edit
-    lat.init()
-    for i in range(9):
-        lat.set_density(f"f[{i}]" if "f[0]" in m.storage_index else f"f{i}",
-                        np.asarray(feq[i]))
     mass0 = float(np.asarray(lat.get_quantity("Rho")).sum())
-    lat.iterate(100)
+    lat.iterate(400)
     rho2 = np.asarray(lat.get_quantity("Rho"))
     assert np.isfinite(rho2).all()
-    # mass conserved exactly; liquid/vapor phases separated
+    # mass conserved exactly; the bubble survives with both phases intact
     assert float(rho2.sum()) == pytest.approx(mass0, rel=1e-12)
-    assert rho2.max() - rho2.min() > 2.0
+    assert rho2[24, 24] < 0.2          # vapor core
+    assert rho2[4, 4] > 3.0            # liquid bulk
     p = np.asarray(lat.get_quantity("P"))
     assert np.isfinite(p).all()
+    # Laplace law direction: pressure inside the bubble differs from bulk
+    assert abs(p[24, 24] - p[4, 4]) > 0
 
 
 def test_sw_gravity_wave():

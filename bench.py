@@ -71,13 +71,18 @@ def main():
     # kernel is ~20x faster than the XLA path, so it needs a longer run to
     # amortize the same fixed dispatch overhead.
     mlups_pallas = None
+    mlups_fused = None
     from tclb_tpu.ops import pallas_d2q9
     if pallas_d2q9.supports(m, (ny, nx), jnp.float32):
         it_p = pallas_d2q9.make_pallas_iterate(m, (ny, nx))
         mlups_pallas, _ = timed(it_p, jax.tree.map(jnp.copy, lat.state),
                                 lat.params, iters * 5)
+        # temporally-fused variant: two steps per band pass
+        it_f = pallas_d2q9.make_pallas_iterate(m, (ny, nx), fuse=2)
+        mlups_fused, _ = timed(it_f, jax.tree.map(jnp.copy, lat.state),
+                               lat.params, iters * 5)
 
-    mlups = max(mlups_xla, mlups_pallas or 0.0)
+    mlups = max(mlups_xla, mlups_pallas or 0.0, mlups_fused or 0.0)
     # HBM roofline: bytes per node update (reference traffic model,
     # src/main.cpp.Rt:126: 1 read + 1 write per density + flag read)
     bytes_per_update = 2 * m.n_storage * 4 + 2
@@ -88,10 +93,12 @@ def main():
                    dev.device_kind, 819.0)
     roofline_mlups = hbm_gbs * 1e9 / bytes_per_update / 1e6
     ratio = mlups / roofline_mlups
-    # LBM is bandwidth-bound: beating the streaming roofline is physically
-    # impossible; a ratio > 1 means the timing itself is broken and the
-    # number must not be reported
-    assert 0.0 < ratio <= 1.0, \
+    # LBM is bandwidth-bound under the classical 1R+1W-per-step traffic
+    # model; the temporally-fused kernel legitimately halves traffic per
+    # step, so its physical ceiling is 2x that roofline.  Anything beyond
+    # means the timing itself is broken and must not be reported.
+    cap = 2.0 if mlups == (mlups_fused or 0.0) else 1.0
+    assert 0.0 < ratio <= cap, \
         f"measured {mlups:.0f} MLUPS = {ratio:.2f}x the HBM roofline on " \
         f"{dev.device_kind}: timing is not credible, refusing to report"
     print(json.dumps({
@@ -101,6 +108,8 @@ def main():
         "vs_baseline": round(ratio, 4),
         "xla_mlups": round(mlups_xla, 1),
         "pallas_mlups": round(mlups_pallas, 1) if mlups_pallas else None,
+        "pallas_fused2_mlups": round(mlups_fused, 1) if mlups_fused
+        else None,
     }))
 
 

@@ -15,7 +15,7 @@ explicit function inputs.
 from tclb_tpu.adjoint.run import (nested_checkpoint_scan, objective_weights,
                                   make_objective_run, make_unsteady_gradient,
                                   make_steady_gradient, fd_test)
-from tclb_tpu.adjoint.design import (Design, InternalTopology, OptimalControl,
+from tclb_tpu.adjoint.design import (ControlSecond, Design, InternalTopology, OptimalControl,
                                      Fourier, BSpline, RepeatControl,
                                      CompositeDesign, threshold_topology)
 from tclb_tpu.adjoint.optimize import optimize

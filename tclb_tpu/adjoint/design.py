@@ -189,6 +189,28 @@ class RepeatControl(Reparam):
         super().__init__(inner, B)
 
 
+class ControlSecond(Reparam):
+    """Half-resolution control: theta holds every second sample; odd
+    samples are the average of their neighbours (reference
+    OptimalControlSecond, src/Handlers.cpp.Rt:304-430: PAR_SET places
+    tab[i] at even indices and (tab[i]+tab[i+1])/2 between, PAR_GRAD is
+    the transpose — which is exactly what differentiating this basis
+    gives)."""
+
+    def __init__(self, inner: OptimalControl, horizon: int):
+        P = (horizon + 1) // 2
+        B = np.zeros((horizon, P))
+        for i in range(P):
+            B[2 * i, i] = 1.0
+            if 2 * i + 1 < horizon:
+                if i + 1 < P:
+                    B[2 * i + 1, i] = 0.5
+                    B[2 * i + 1, i + 1] = 0.5
+                else:
+                    B[2 * i + 1, i] = 1.0
+        super().__init__(inner, B)
+
+
 class CompositeDesign(Design):
     """Concatenation of several designs into one theta tuple (the reference
     concatenates all design handlers' parameters into one NLopt vector,

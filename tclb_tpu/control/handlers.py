@@ -727,6 +727,46 @@ class acSyntheticTurbulence(Handler):
         return 0
 
 
+class cbCatalyst(Handler):
+    """<Catalyst what="U,Rho" [slice_axis= slice_index=] [vmin= vmax=]>:
+    in-situ frame rendering — the TPU-native equivalent of both the
+    ParaView Catalyst co-processor (reference cbCatalyst,
+    src/Handlers.cpp.Rt:898-1006) and the GLUT GUI's live Color() view
+    (src/gpu_anim.h; see utils/render.py for the redesign rationale).
+    Vector quantities render their magnitude; 3D lattices render the
+    middle slice of ``slice_axis`` (default z) unless slice_index= is
+    given."""
+
+    kind = "callback"
+
+    def do_it(self) -> int:
+        from tclb_tpu.utils.render import render_frame
+        s = self.solver
+        what = (self.node.get("what") or "U").split(",")
+        axis = int(self.node.get("slice_axis", "0"))
+        vmin = self.node.get("vmin")
+        vmax = self.node.get("vmax")
+        for q in what:
+            q = q.strip()
+            a = np.asarray(s.lattice.get_quantity(q))
+            if a.ndim == len(s.shape) + 1:      # vector -> magnitude
+                a = np.sqrt((a ** 2).sum(axis=0))
+            if a.ndim == 3:
+                idx = int(self.node.get("slice_index",
+                                        str(a.shape[axis] // 2)))
+                a = np.take(a, idx, axis=axis)
+            render_frame(s.out_path(f"frame_{q}", "png"), a,
+                         vmin=s.units.alt(vmin) if vmin else None,
+                         vmax=s.units.alt(vmax) if vmax else None)
+        return 0
+
+    def init(self) -> int:
+        super().init()
+        if not self.every_iter:
+            return self.do_it()
+        return 0
+
+
 class cbAveraging(Handler):
     """<Average>: reset the running averages (average=True densities) and
     restart the sample counter (reference cbAveraging,
@@ -749,6 +789,7 @@ _HANDLERS = {
     "CLBConfig": MainContainer,
     "SyntheticTurbulence": acSyntheticTurbulence,
     "Average": cbAveraging,
+    "Catalyst": cbCatalyst,
     "Solve": acSolve,
     "Repeat": acRepeat,
     "Geometry": acGeometry,

@@ -20,7 +20,7 @@ from __future__ import annotations
 import jax.numpy as jnp
 import numpy as np
 
-from tclb_tpu.adjoint import (BSpline, CompositeDesign, Fourier,
+from tclb_tpu.adjoint import (BSpline, CompositeDesign, ControlSecond, Fourier,
                               InternalTopology, OptimalControl,
                               RepeatControl, fd_test, make_objective_run,
                               make_steady_gradient, make_unsteady_gradient,
@@ -135,6 +135,16 @@ class dRepeatControl(dOptimalControl):
         period = int(round(self.solver.units.alt(
             self.node.get("Period", "1"))))
         self.solver.designs.append(RepeatControl(inner, T, period))
+
+
+class dOptimalControlSecond(dOptimalControl):
+    """<OptimalControlSecond what=...>: optimal control at half temporal
+    resolution with linear interpolation between the optimized samples
+    (reference OptimalControlSecond, src/Handlers.cpp.Rt:304-430)."""
+
+    def _register(self, inner) -> None:
+        T = self.solver.lattice.params.time_series.shape[1]
+        self.solver.designs.append(ControlSecond(inner, T))
 
 
 class acAdjoint(GenericAction):
@@ -329,6 +339,7 @@ register_handler("Optimize", acOptimize)
 register_handler("OptSolve", acOptSolve)
 register_handler("InternalTopology", dInternalTopology)
 register_handler("OptimalControl", dOptimalControl)
+register_handler("OptimalControlSecond", dOptimalControlSecond)
 register_handler("Fourier", dFourier)
 register_handler("BSpline", dBSpline)
 register_handler("RepeatControl", dRepeatControl)

@@ -106,16 +106,26 @@ def _sparse_matvec(mat: np.ndarray, planes: list) -> list:
 
 
 def make_pallas_iterate(model: Model, shape, dtype=jnp.float32,
-                        interpret: Optional[bool] = None) -> Callable:
+                        interpret: Optional[bool] = None,
+                        fuse: int = 1) -> Callable:
     """Build ``iterate(state, params, niter) -> state`` running the fused
     Pallas collide-stream kernel.  Caller must check :func:`supports` first.
-    """
+
+    ``fuse=2`` runs TWO lattice steps per kernel band pass (halving the
+    HBM traffic per step); an odd trailing step falls back to the single-
+    step kernel."""
     from tclb_tpu.models import d2q9 as mod
 
     if not supports(model, shape, dtype):
         raise ValueError(f"pallas path unsupported for {model.name} {shape}")
     ny, nx = (int(s) for s in shape)
     by = _band_rows(model, ny, nx)
+    # the fused kernel holds two full band stacks of intermediates in
+    # VMEM; cap its band lower so the compiler's scoped allocation fits
+    by2 = by
+    while by2 > 8 and (ny % by2 or by2 > 32):
+        by2 -= 8
+    assert ny % by2 == 0   # _band_rows guarantees multiple-of-8 divisors
     if interpret is None:
         interpret = jax.default_backend() != "tpu"
 
@@ -135,6 +145,47 @@ def make_pallas_iterate(model: Model, shape, dtype=jnp.float32,
     def _is(flags, name):
         mask, val = nt[name]
         return (flags & jnp.int32(mask)) == jnp.int32(val)
+
+    def _lbm_step(f, flags, vel, den, bc0, bc1, sett):
+        """One collide step on an arbitrary row band: boundary dispatch in
+        the same case order as models.d2q9.run, then the MRT collision
+        (mirrors models.d2q9._collision_mrt, sans globals)."""
+        def apply(mask, new, cur):
+            return jnp.where(mask[None], new, cur)
+
+        f = apply(_is(flags, "Wall") | _is(flags, "Solid"),
+                  jnp.stack([f[int(OPP[k])] for k in range(9)]), f)
+        f = apply(_is(flags, "EVelocity"),
+                  mod._zou_he_x(f, vel, "velocity", "E"), f)
+        f = apply(_is(flags, "WPressure"),
+                  mod._zou_he_x(f, den, "pressure", "W"), f)
+        f = apply(_is(flags, "WVelocity"),
+                  mod._zou_he_x(f, vel, "velocity", "W"), f)
+        f = apply(_is(flags, "EPressure"),
+                  mod._zou_he_x(f, den, "pressure", "E"), f)
+        f = apply(_is(flags, "TopSymmetry"), mod._symmetry(f, top=True), f)
+        f = apply(_is(flags, "BottomSymmetry"),
+                  mod._symmetry(f, top=False), f)
+
+        rho = sum(f[k] for k in range(9))
+        ux = sum(float(E[k, 0]) * f[k] for k in range(9) if E[k, 0]) / rho
+        uy = sum(float(E[k, 1]) * f[k] for k in range(9) if E[k, 1]) / rho
+        s3, s4 = sett[i_s3], sett[i_s4]
+        s56, s78 = sett[i_s56], sett[i_s78]
+        zero = jnp.zeros_like(rho)
+        omega_m = [zero, zero, zero, s3 + zero, s4 + zero,
+                   s56 + zero, s56 + zero, s78 + zero, s78 + zero]
+        feq = equilibrium(E, W, rho, (ux, uy))
+        fneq = [f[k] - feq[k] for k in range(9)]
+        m_neq = [m * o for m, o in zip(_sparse_matvec(M, fneq), omega_m)]
+        ux2 = ux + sett[i_gx] + bc0
+        uy2 = uy + sett[i_gy] + bc1
+        feq2 = equilibrium(E, W, rho, (ux2, uy2))
+        m_post = [a + b for a, b in
+                  zip(m_neq, _sparse_matvec(M, [feq2[k] for k in range(9)]))]
+        coll = _sparse_matvec(Minv, m_post)
+        mrt = _is(flags, "MRT")
+        return jnp.stack([jnp.where(mrt, coll[k], f[k]) for k in range(9)])
 
     def kernel(sett, f_hbm, flags_ref, vel_ref, den_ref, out_ref,
                mid2, tops2, bots2, sems):
@@ -203,58 +254,119 @@ def make_pallas_iterate(model: Model, shape, dtype=jnp.float32,
                 sl = mid(k)
             pulled.append(pltpu.roll(sl, dx % nx, axis=1) if dx else sl)
         f = jnp.stack(pulled)
-        flags = flags_ref[:]
-        vel = vel_ref[:]
-        den = den_ref[:]
-
-        # boundary dispatch — same case order as models.d2q9.run so that
-        # overlapping masks resolve identically
-        def apply(mask, new):
-            return jnp.where(mask[None], new, f)
-
-        f = apply(_is(flags, "Wall") | _is(flags, "Solid"),
-                  jnp.stack([f[int(OPP[k])] for k in range(9)]))
-        f = apply(_is(flags, "EVelocity"),
-                  mod._zou_he_x(f, vel, "velocity", "E"))
-        f = apply(_is(flags, "WPressure"),
-                  mod._zou_he_x(f, den, "pressure", "W"))
-        f = apply(_is(flags, "WVelocity"),
-                  mod._zou_he_x(f, vel, "velocity", "W"))
-        f = apply(_is(flags, "EPressure"),
-                  mod._zou_he_x(f, den, "pressure", "E"))
-        f = apply(_is(flags, "TopSymmetry"), mod._symmetry(f, top=True))
-        f = apply(_is(flags, "BottomSymmetry"), mod._symmetry(f, top=False))
-
-        # MRT collision (mirrors models.d2q9._collision_mrt, sans globals)
         bc0 = mid(bc_idx[0])
         bc1 = mid(bc_idx[1])
-        rho = sum(f[k] for k in range(9))
-        ux = sum(float(E[k, 0]) * f[k] for k in range(9) if E[k, 0]) / rho
-        uy = sum(float(E[k, 1]) * f[k] for k in range(9) if E[k, 1]) / rho
-        s3, s4 = sett[i_s3], sett[i_s4]
-        s56, s78 = sett[i_s56], sett[i_s78]
-        zero = jnp.zeros_like(rho)
-        omega_m = [zero, zero, zero, s3 + zero, s4 + zero,
-                   s56 + zero, s56 + zero, s78 + zero, s78 + zero]
-        feq = equilibrium(E, W, rho, (ux, uy))
-        fneq = [f[k] - feq[k] for k in range(9)]
-        m_neq = [m * o for m, o in zip(_sparse_matvec(M, fneq), omega_m)]
-        ux2 = ux + sett[i_gx] + bc0
-        uy2 = uy + sett[i_gy] + bc1
-        feq2 = equilibrium(E, W, rho, (ux2, uy2))
-        m_post = [a + b for a, b in
-                  zip(m_neq, _sparse_matvec(M, [feq2[k] for k in range(9)]))]
-        coll = _sparse_matvec(Minv, m_post)
-        mrt = _is(flags, "MRT")
+        fnew = _lbm_step(f, flags_ref[:], vel_ref[:], den_ref[:],
+                         bc0, bc1, sett)
         for k in range(9):
-            out_ref[k] = jnp.where(mrt, coll[k], f[k])
+            out_ref[k] = fnew[k]
         out_ref[bc_idx[0]] = bc0
         out_ref[bc_idx[1]] = bc1
 
-    grid = (ny // by,)
+    def kernel2(sett, f_hbm, aux_hbm, out_ref,
+                midf, topf, botf, mida, topa, bota, sems):
+        """Temporally-fused kernel: TWO collide-stream steps per band pass
+        (the esoteric-twist-style traffic saving flagged in SURVEY §7's
+        hard parts — each density is read/written once per TWO steps).
+        Step 1 runs on an extended band of by+2 rows so step 2's pull has
+        valid neighbours; the 8-row aligned halo blocks already cover the
+        2-row reach.  ``aux_hbm`` stacks (flags-as-f32, Velocity, Density)
+        so the statics ride the same 3-block DMA scheme (flag values
+        < 2^16 are exact in f32)."""
+        i = pl.program_id(0)
+        base = pl.multiple_of(i * jnp.int32(by2), 8)
+        top8 = pl.multiple_of(
+            jax.lax.rem(base - jnp.int32(8) + jnp.int32(ny),
+                        jnp.int32(ny)), 8)
+        bot8 = pl.multiple_of(
+            jax.lax.rem(base + jnp.int32(by2), jnp.int32(ny)), 8)
+        dmas = (
+            pltpu.make_async_copy(f_hbm.at[:, pl.ds(base, by2), :],
+                                  midf, sems.at[0]),
+            pltpu.make_async_copy(f_hbm.at[:, pl.ds(top8, 8), :],
+                                  topf, sems.at[1]),
+            pltpu.make_async_copy(f_hbm.at[:, pl.ds(bot8, 8), :],
+                                  botf, sems.at[2]),
+            pltpu.make_async_copy(aux_hbm.at[:, pl.ds(base, by2), :],
+                                  mida, sems.at[3]),
+            pltpu.make_async_copy(aux_hbm.at[:, pl.ds(top8, 8), :],
+                                  topa, sems.at[4]),
+            pltpu.make_async_copy(aux_hbm.at[:, pl.ds(bot8, 8), :],
+                                  bota, sems.at[5]),
+        )
+        for d in dmas:
+            d.start()
+        for d in dmas:
+            d.wait()
+
+        def ext(buf_top, buf_mid, buf_bot, k, lo, hi):
+            """Rows [lo, hi) of the band-extended plane k (lo >= -8)."""
+            parts = []
+            if lo < 0:
+                parts.append(buf_top[k, 8 + lo:8 + min(hi, 0), :])
+            if hi > 0 and lo < by2:
+                parts.append(buf_mid[k, max(lo, 0):min(hi, by2), :])
+            if hi > by2:
+                parts.append(buf_bot[k, max(lo - by2, 0):hi - by2, :])
+            return parts[0] if len(parts) == 1 \
+                else jnp.concatenate(parts, axis=0)
+
+        # ---- step 1 on rows [-1, by+1) ---------------------------------- #
+        pulled = []
+        for k in range(9):
+            dx, dy = int(E[k, 0]), int(E[k, 1])
+            sl = ext(topf, midf, botf, k, -1 - dy, by2 + 1 - dy)
+            pulled.append(pltpu.roll(sl, dx % nx, axis=1) if dx else sl)
+        f = jnp.stack(pulled)
+        flags_e = ext(topa, mida, bota, 0, -1, by2 + 1).astype(jnp.int32)
+        vel_e = ext(topa, mida, bota, 1, -1, by2 + 1)
+        den_e = ext(topa, mida, bota, 2, -1, by2 + 1)
+        bc0_e = ext(topf, midf, botf, bc_idx[0], -1, by2 + 1)
+        bc1_e = ext(topf, midf, botf, bc_idx[1], -1, by2 + 1)
+        f1 = _lbm_step(f, flags_e, vel_e, den_e, bc0_e, bc1_e, sett)
+
+        # ---- step 2 on rows [0, by) ------------------------------------- #
+        pulled = []
+        for k in range(9):
+            dx, dy = int(E[k, 0]), int(E[k, 1])
+            sl = f1[k, 1 - dy:1 - dy + by2, :]
+            pulled.append(pltpu.roll(sl, dx % nx, axis=1) if dx else sl)
+        f = jnp.stack(pulled)
+        f2 = _lbm_step(f, flags_e[1:by2 + 1], vel_e[1:by2 + 1],
+                       den_e[1:by2 + 1], bc0_e[1:by2 + 1], bc1_e[1:by2 + 1],
+                       sett)
+        for k in range(9):
+            out_ref[k] = f2[k]
+        out_ref[bc_idx[0]] = midf[bc_idx[0]]
+        out_ref[bc_idx[1]] = midf[bc_idx[1]]
+
+    grid2 = (ny // by2,)
+    call2 = pl.pallas_call(
+        kernel2,
+        grid=grid2,
+        in_specs=[
+            pl.BlockSpec(memory_space=pltpu.SMEM),
+            pl.BlockSpec(memory_space=pl.ANY),
+            pl.BlockSpec(memory_space=pl.ANY),
+        ],
+        out_specs=pl.BlockSpec((n_storage, by2, nx), lambda i: (0, i, 0),
+                               memory_space=pltpu.VMEM),
+        out_shape=jax.ShapeDtypeStruct((n_storage, ny, nx), dtype),
+        scratch_shapes=[
+            pltpu.VMEM((n_storage, by2, nx), dtype),
+            pltpu.VMEM((n_storage, 8, nx), dtype),
+            pltpu.VMEM((n_storage, 8, nx), dtype),
+            pltpu.VMEM((3, by2, nx), dtype),
+            pltpu.VMEM((3, 8, nx), dtype),
+            pltpu.VMEM((3, 8, nx), dtype),
+            pltpu.SemaphoreType.DMA((6,)),
+        ],
+        interpret=interpret,
+    )
+
     call = pl.pallas_call(
         kernel,
-        grid=grid,
+        grid=(ny // by,),
         in_specs=[
             pl.BlockSpec(memory_space=pltpu.SMEM),
             pl.BlockSpec(memory_space=pl.ANY),
@@ -280,19 +392,30 @@ def make_pallas_iterate(model: Model, shape, dtype=jnp.float32,
     i_vel, i_den = si["Velocity"], si["Density"]
     zshift = model.zone_shift
 
-    @partial(jax.jit, static_argnames=("niter",), donate_argnums=0)
-    def _iterate_jit(state: LatticeState, params: SimParams, niter: int
-                     ) -> LatticeState:
+    @partial(jax.jit, static_argnames=("niter", "fuse"), donate_argnums=0)
+    def _iterate_jit(state: LatticeState, params: SimParams, niter: int,
+                     fuse: int = 1) -> LatticeState:
         flags_i32 = state.flags.astype(jnp.int32)
         zones = flags_i32 >> zshift
         vel = params.zone_table[i_vel].astype(dtype)[zones]
         den = params.zone_table[i_den].astype(dtype)[zones]
         sett = params.settings.astype(dtype)
+        fields = state.fields
+
+        if fuse == 2:
+            aux = jnp.stack([flags_i32.astype(dtype), vel, den])
+
+            def body2(fields, _):
+                return call2(sett, fields, aux), None
+
+            fields, _ = jax.lax.scan(body2, fields, None,
+                                     length=niter // 2)
+        rest = niter % 2 if fuse == 2 else niter
 
         def body(fields, _):
             return call(sett, fields, flags_i32, vel, den), None
 
-        fields, _ = jax.lax.scan(body, state.fields, None, length=niter)
+        fields, _ = jax.lax.scan(body, fields, None, length=rest)
         return LatticeState(
             fields=fields,
             flags=state.flags,
@@ -310,6 +433,6 @@ def make_pallas_iterate(model: Model, shape, dtype=jnp.float32,
             raise ValueError(
                 "pallas iterate does not support Control time series; "
                 "use the XLA path for time-dependent zonal settings")
-        return _iterate_jit(state, params, niter)
+        return _iterate_jit(state, params, niter, fuse=fuse)
 
     return iterate

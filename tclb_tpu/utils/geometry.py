@@ -409,10 +409,22 @@ class Geometry:
         ls = np.append(np.arange(0.0, 1.0, dl), 1.0)
         samples = np.stack([_bspline(l, ctrl, order) for l in ls])
         mask = np.zeros((reg.nz, reg.ny, reg.nx), dtype=bool)
-        z, y, x = self._grid(reg)
         for x0, y0, z0, r in samples:
-            d2 = (x - x0) ** 2 + (y - y0) ** 2 + (z - z0) ** 2
-            mask |= d2 < r * r
+            # clip to the sample's bounding box: the work scales with the
+            # tube volume, not samples x grid size
+            lz = max(int(np.floor(z0 - r)) - reg.dz, 0)
+            hz = min(int(np.ceil(z0 + r)) - reg.dz + 1, reg.nz)
+            ly = max(int(np.floor(y0 - r)) - reg.dy, 0)
+            hy = min(int(np.ceil(y0 + r)) - reg.dy + 1, reg.ny)
+            lx = max(int(np.floor(x0 - r)) - reg.dx, 0)
+            hx = min(int(np.ceil(x0 + r)) - reg.dx + 1, reg.nx)
+            if lz >= hz or ly >= hy or lx >= hx:
+                continue
+            zz, yy, xx = np.meshgrid(
+                np.arange(lz, hz) + reg.dz, np.arange(ly, hy) + reg.dy,
+                np.arange(lx, hx) + reg.dx, indexing="ij")
+            d2 = (xx - x0) ** 2 + (yy - y0) ** 2 + (zz - z0) ** 2
+            mask[lz:hz, ly:hy, lx:hx] |= d2 < r * r
         self._paint(mask, reg)
 
     def result(self) -> np.ndarray:

@@ -1,0 +1,69 @@
+"""In-situ frame rendering — the TPU-native stand-in for the reference's
+two visualization side stacks:
+
+* ParaView Catalyst co-processing (reference src/Catalyst.cpp.Rt:33-80,
+  cbCatalyst handler src/Handlers.cpp.Rt:898-1006): per-interval in-situ
+  images of selected quantities without writing full VTI dumps;
+* the GLUT GUI (reference src/gpu_anim.h + per-model ``Color()``,
+  src/LatticeContainer.inc.cpp.Rt:414-461): live coloring of the field.
+
+A TPU pod has no display and no ParaView server; the honest re-design is
+an offline frame stream: each callback renders a quantity slice through a
+colormap to a PNG (pure stdlib zlib encoder — no imaging dependency), so
+a run directory accumulates ``<case>_<quantity>_<iter>.png`` frames that
+play back as the reference's GUI animation would.
+"""
+
+from __future__ import annotations
+
+import struct
+import zlib
+
+import numpy as np
+
+# compact viridis-like colormap (8 anchor colors, interpolated)
+_ANCHORS = np.array([
+    (68, 1, 84), (70, 50, 127), (54, 92, 141), (39, 127, 142),
+    (31, 161, 135), (74, 194, 109), (159, 218, 58), (253, 231, 37),
+], dtype=np.float64)
+
+
+def colormap(x: np.ndarray) -> np.ndarray:
+    """Map [0,1] floats to (…, 3) uint8 RGB through the anchor table."""
+    x = np.clip(np.nan_to_num(x, nan=0.0), 0.0, 1.0)
+    pos = x * (len(_ANCHORS) - 1)
+    i = np.clip(pos.astype(np.int64), 0, len(_ANCHORS) - 2)
+    frac = (pos - i)[..., None]
+    rgb = _ANCHORS[i] * (1.0 - frac) + _ANCHORS[i + 1] * frac
+    return rgb.astype(np.uint8)
+
+
+def write_png(path: str, rgb: np.ndarray) -> str:
+    """Minimal PNG encoder (8-bit RGB, zlib stdlib only)."""
+    h, w, _ = rgb.shape
+    raw = b"".join(b"\x00" + rgb[row].tobytes() for row in range(h))
+
+    def chunk(tag: bytes, data: bytes) -> bytes:
+        return (struct.pack(">I", len(data)) + tag + data
+                + struct.pack(">I", zlib.crc32(tag + data) & 0xFFFFFFFF))
+
+    ihdr = struct.pack(">IIBBBBB", w, h, 8, 2, 0, 0, 0)
+    png = (b"\x89PNG\r\n\x1a\n"
+           + chunk(b"IHDR", ihdr)
+           + chunk(b"IDAT", zlib.compress(raw, 6))
+           + chunk(b"IEND", b""))
+    with open(path, "wb") as f:
+        f.write(png)
+    return path
+
+
+def render_frame(path: str, plane: np.ndarray,
+                 vmin=None, vmax=None) -> str:
+    """Render a 2D scalar plane to a PNG (row 0 at the bottom, like the
+    reference GUI's lattice orientation)."""
+    plane = np.asarray(plane, dtype=np.float64)
+    lo = float(np.nanmin(plane)) if vmin is None else float(vmin)
+    hi = float(np.nanmax(plane)) if vmax is None else float(vmax)
+    span = hi - lo if hi > lo else 1.0
+    rgb = colormap((plane - lo) / span)
+    return write_png(path, rgb[::-1])

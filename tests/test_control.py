@@ -255,3 +255,55 @@ def test_component_save_load(tmp_path):
     solver2 = run_config_string(xml2, get_model("d2q9"))
     np.testing.assert_array_equal(
         np.asarray(solver2.lattice.get_density("f[1]")), saved)
+
+
+def test_catalyst_in_situ_frames(tmp_path):
+    """<Catalyst> renders per-interval PNG frames of selected quantities
+    (the Catalyst/GUI side-stack equivalent, utils/render.py)."""
+    xml = f"""<CLBConfig output="{tmp_path}/">
+      <Geometry nx="32" ny="16">
+        <MRT><Box/></MRT>
+        <WVelocity name="Inlet"><Box nx="1"/></WVelocity>
+        <EPressure name="Outlet"><Box dx="-1"/></EPressure>
+        <Wall mask="ALL"><Channel/></Wall>
+      </Geometry>
+      <Model><Params Velocity="0.03" nu="0.05"/></Model>
+      <Catalyst Iterations="20" what="U,Rho"/>
+      <Solve Iterations="40"/>
+    </CLBConfig>"""
+    run_config_string(xml, get_model("d2q9"))
+    frames = sorted(tmp_path.glob("*frame_U*.png"))
+    assert len(frames) >= 2
+    data = frames[-1].read_bytes()
+    assert data[:8] == b"\x89PNG\r\n\x1a\n"
+    assert list(tmp_path.glob("*frame_Rho*.png"))
+
+
+def test_optimal_control_second_design(tmp_path):
+    """<OptimalControlSecond> registers a half-resolution control design
+    whose series interpolates the optimized samples (reference
+    OptimalControlSecond, src/Handlers.cpp.Rt:304-430)."""
+    import jax.numpy as jnp
+    from tclb_tpu.control.solver import _run_root
+    xml = f"""<CLBConfig output="{tmp_path}/">
+      <Geometry nx="16" ny="8">
+        <MRT><Box/></MRT>
+        <WVelocity name="inlet"><Box nx="1"/></WVelocity>
+        <Wall mask="ALL"><Channel/></Wall>
+      </Geometry>
+      <Model><Params Velocity="0.02" nu="0.1"/></Model>
+      <OptimalControlSecond what="Velocity-inlet" Length="8"
+         lower="0" upper="0.1"/>
+    </CLBConfig>"""
+    root = ET.fromstring(xml)
+    s = _run_root(root, get_model("d2q9"), None, jnp.float64,
+                  str(tmp_path) + "/", "ocs")
+    assert len(s.designs) == 1
+    d = s.designs[0]
+    theta = np.asarray(d.get(s.lattice.state, s.lattice.params))
+    assert theta.shape == (4,)    # half of the 8-step horizon
+    _, params = d.put(np.array([0.0, 0.02, 0.04, 0.06]),
+                      s.lattice.state, s.lattice.params)
+    series = np.asarray(params.time_series)[0]
+    np.testing.assert_allclose(
+        series, [0.0, 0.01, 0.02, 0.03, 0.04, 0.05, 0.06, 0.06], atol=1e-12)

@@ -50,3 +50,55 @@ def test_streaming_vectors():
     # d2q9 set: one rest + 4 axis + 4 diagonal, momentum-free
     assert (ei.sum(axis=0) == 0).all()
     assert sorted((np.abs(e).sum() for e in ei)) == [0, 1, 1, 1, 1, 2, 2, 2, 2]
+
+
+def test_packing_overflow_raises():
+    """More node types than fit the 16-bit flag must fail loudly
+    (reference conf.R packs groups into the flag_t; overflow there is a
+    build error — here a registry error)."""
+    from tclb_tpu.core.registry import ModelDef
+    import pytest as _pytest
+    d = ModelDef("overflow", ndim=2)
+    d.add_density("f0")
+    # 6 groups x 15 members = 4 bits each = 24 bits > 16
+    for g in range(6):
+        for i in range(15):
+            d.add_node_type(f"T{g}_{i}", f"G{g}")
+    with _pytest.raises(ValueError, match="bits"):
+        d.finalize()
+
+
+def test_packing_group_isolation_and_zone_bits():
+    """Group masks are disjoint, values stay within their mask, and the
+    zone field occupies exactly the remaining high bits."""
+    m = get_model("d2q9")
+    masks = [v for k, v in m.group_masks.items()
+             if k not in ("ALL", "SETTINGZONE", "NONE")]
+    for i, a in enumerate(masks):
+        for b in masks[i + 1:]:
+            assert a & b == 0
+    for t in m.node_types.values():
+        assert t.value & ~t.mask == 0
+    used = 0
+    for v in masks:
+        used |= v
+    assert used | m.group_masks["SETTINGZONE"] == 0xFFFF
+    assert used & m.group_masks["SETTINGZONE"] == 0
+    # flag_for composes type bits + zone bits reversibly
+    f = m.flag_for("WVelocity", "MRT", zone=3)
+    assert (f >> m.zone_shift) == 3
+    assert f & m.node_types["WVelocity"].mask \
+        == m.node_types["WVelocity"].value
+
+
+def test_zone_capacity_limit():
+    """Zone ids beyond the remaining bits must be rejected by the
+    geometry painter (reference SettingZones allocation)."""
+    from tclb_tpu.utils.geometry import Geometry
+    m = get_model("d2q9")
+    g = Geometry(m, (4, 4))
+    import pytest as _pytest
+    for i in range(m.zone_max - 1):
+        g.set_zone(f"z{i}")
+    with _pytest.raises(ValueError, match="zone"):
+        g.set_zone("one_too_many")

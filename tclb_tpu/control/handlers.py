@@ -380,7 +380,9 @@ class cbVTK(Handler):
         return set(w.split(",")) if w else None
 
     def do_it(self) -> int:
-        self.solver.write_vtk(self._what())
+        compress = (self.node.get("compress", "") or "").lower() \
+            in ("1", "true", "yes")
+        self.solver.write_vtk(self._what(), compress=compress)
         return 0
 
     def init(self) -> int:

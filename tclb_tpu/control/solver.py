@@ -221,7 +221,8 @@ class Solver:
         write_vti(path, arrays)
         return path
 
-    def write_vtk(self, what: Optional[set[str]] = None) -> str:
+    def write_vtk(self, what: Optional[set[str]] = None,
+                  compress: bool = False) -> str:
         from tclb_tpu.utils.vtk import write_pvti, write_vti
         arrays = self.quantity_arrays(what)
         flags = np.asarray(self.lattice.state.flags)
@@ -229,7 +230,8 @@ class Solver:
         # selected group, src/vtkLattice.cpp.Rt:33-46)
         if what is None or "flag" in (what or set()) or not what:
             arrays["Flag"] = flags
-        piece = write_vti(self.out_path("VTK", "vti"), arrays)
+        piece = write_vti(self.out_path("VTK", "vti"), arrays,
+                          compress=compress)
         write_pvti(self.out_path("VTK", "pvti"), piece, arrays)
         return piece
 

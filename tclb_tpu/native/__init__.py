@@ -1,0 +1,137 @@
+"""Native host-side kernels: on-demand g++ build + ctypes bindings.
+
+The reference's host layer is C++ (SURVEY.md §2.1 native-code census);
+here the two genuinely hot host loops — STL voxelization
+(reference src/Geometry.cpp.Rt:462-577) and VTI appended-data encoding
+(reference src/vtkOutput.cpp) — are native C++ (src/tclb_native.cpp),
+compiled once per checkout into ``_build/`` and loaded via ctypes.
+
+Everything degrades gracefully: no compiler, a failed build, or
+``TCLB_NATIVE=0`` fall back to the pure-Python implementations
+(tclb_tpu/utils/stl.py, zlib stdlib), which remain the test oracle.
+"""
+
+from __future__ import annotations
+
+import ctypes
+import hashlib
+import os
+import subprocess
+import zlib
+
+import numpy as np
+
+_DIR = os.path.dirname(os.path.abspath(__file__))
+_SRC = os.path.join(_DIR, "src", "tclb_native.cpp")
+_lib: ctypes.CDLL | None = None
+_tried = False
+
+
+def _build_lib() -> str | None:
+    """Compile (or reuse) the shared lib; returns its path or None.
+
+    Any OSError — missing .cpp in a stripped install, read-only
+    site-packages, no compiler — means "no native lib", never a crash."""
+    try:
+        with open(_SRC, "rb") as f:
+            tag = hashlib.sha256(f.read()).hexdigest()[:16]
+        out = os.path.join(_DIR, "_build", f"libtclb_native-{tag}.so")
+        if os.path.exists(out):
+            return out
+        os.makedirs(os.path.dirname(out), exist_ok=True)
+        tmp = f"{out}.tmp.{os.getpid()}"  # per-pid: parallel builders
+        cmd = ["g++", "-O3", "-std=c++17", "-fPIC", "-shared", _SRC,
+               "-o", tmp, "-lz"]
+        subprocess.run(cmd, check=True, capture_output=True, timeout=120)
+        os.replace(tmp, out)  # atomic publish
+        return out
+    except (OSError, subprocess.SubprocessError):
+        return None
+
+
+def get_lib() -> ctypes.CDLL | None:
+    """The loaded native library, building it on first call (or None)."""
+    global _lib, _tried
+    if _tried:
+        return _lib
+    _tried = True
+    if os.environ.get("TCLB_NATIVE", "1") == "0":
+        return None
+    path = _build_lib()
+    if path is None:
+        return None
+    try:
+        lib = ctypes.CDLL(path)
+    except OSError:
+        return None
+    lib.tclb_voxelize.restype = ctypes.c_int
+    lib.tclb_voxelize.argtypes = [
+        ctypes.POINTER(ctypes.c_double), ctypes.c_int64,
+        ctypes.c_int64, ctypes.c_int64, ctypes.c_int64,
+        ctypes.c_int, ctypes.POINTER(ctypes.c_uint8)]
+    lib.tclb_zlib_blocks.restype = ctypes.c_int64
+    lib.tclb_zlib_blocks.argtypes = [
+        ctypes.POINTER(ctypes.c_uint8), ctypes.c_int64,
+        ctypes.c_int64, ctypes.c_int,
+        ctypes.POINTER(ctypes.c_uint8), ctypes.c_int64]
+    _lib = lib
+    return _lib
+
+
+def available() -> bool:
+    return get_lib() is not None
+
+
+_SIDES = {"in": 0, "out": 1, "surface": 2}
+
+
+def voxelize(tri: np.ndarray, shape_xyz: tuple[int, int, int],
+             side: str = "in") -> np.ndarray | None:
+    """Native ray-parity voxelization; None if the native lib is absent.
+
+    Same contract as tclb_tpu.utils.stl.voxelize: bool array [z, y, x].
+    """
+    lib = get_lib()
+    if lib is None:
+        return None
+    tri = np.ascontiguousarray(tri, dtype=np.float64)
+    nx, ny, nz = shape_xyz
+    out = np.zeros((nz, ny, nx), dtype=np.uint8)
+    rc = lib.tclb_voxelize(
+        tri.ctypes.data_as(ctypes.POINTER(ctypes.c_double)), tri.shape[0],
+        nx, ny, nz, _SIDES[side],
+        out.ctypes.data_as(ctypes.POINTER(ctypes.c_uint8)))
+    if rc != 0:
+        return None
+    return out.astype(bool)
+
+
+def zlib_blocks(data: bytes, block: int = 1 << 15,
+                level: int = 6) -> bytes:
+    """vtkZLibDataCompressor appended block: UInt32 header + zlib streams.
+
+    Uses the native encoder when available, else a byte-identical Python
+    fallback (zlib.compress produces the same stream — both are zlib at the
+    same level).
+    """
+    lib = get_lib()
+    n = len(data)
+    nblocks = 1 if n == 0 else (n + block - 1) // block
+    if lib is not None:
+        cap = 4 * (3 + nblocks) + nblocks * (block + block // 1000 + 64)
+        out = np.empty(cap, dtype=np.uint8)
+        src = np.frombuffer(data, dtype=np.uint8) if n else \
+            np.empty(0, dtype=np.uint8)
+        total = lib.tclb_zlib_blocks(
+            src.ctypes.data_as(ctypes.POINTER(ctypes.c_uint8)), n,
+            block, level,
+            out.ctypes.data_as(ctypes.POINTER(ctypes.c_uint8)), cap)
+        if total > 0:
+            return out[:total].tobytes()
+    # Python fallback, same layout
+    last = 0 if n == 0 else n - (nblocks - 1) * block
+    chunks = [zlib.compress(data[b * block:(b + 1) * block], level)
+              for b in range(nblocks)]
+    head = np.array([nblocks, block, 0 if last == block else last]
+                    + [len(c) for c in chunks], dtype=np.uint32)
+    return head.tobytes() + b"".join(chunks)

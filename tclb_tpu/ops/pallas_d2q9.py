@@ -118,6 +118,9 @@ def make_pallas_iterate(model: Model, shape, dtype=jnp.float32,
 
     if not supports(model, shape, dtype):
         raise ValueError(f"pallas path unsupported for {model.name} {shape}")
+    if fuse not in (1, 2):
+        raise ValueError(f"fuse={fuse}: only 1 (single-step) and 2 "
+                         "(temporally-fused pair) kernels exist")
     ny, nx = (int(s) for s in shape)
     by = _band_rows(model, ny, nx)
     # the fused kernel holds two full band stacks of intermediates in

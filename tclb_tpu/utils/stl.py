@@ -64,27 +64,38 @@ def voxelize(tri: np.ndarray, shape_xyz: tuple[int, int, int],
 
     ``side``: 'in' marks interior voxels, 'out' exterior, 'surface' marks
     voxels whose center lies within half a cell of the mesh surface along x.
-    """
+
+    Dispatches to the native C++ voxelizer (tclb_tpu/native) when it is
+    available — same algorithm, ~100x faster on large meshes — falling back
+    to the pure-Python implementation below (the test oracle)."""
+    from tclb_tpu import native
+    out = native.voxelize(tri, shape_xyz, side)
+    if out is not None:
+        return out
+    return voxelize_py(tri, shape_xyz, side)
+
+
+def voxelize_py(tri: np.ndarray, shape_xyz: tuple[int, int, int],
+                side: str = "in") -> np.ndarray:
+    """Pure-Python/numpy reference implementation of :func:`voxelize`."""
     nx, ny, nz = shape_xyz
     inside = np.zeros((nz, ny, nx), dtype=bool)
     near = np.zeros((nz, ny, nx), dtype=bool) if side == "surface" else None
 
     p0, p1, p2 = tri[:, 0], tri[:, 1], tri[:, 2]
-    # precompute edge vectors in (y, z) plane for barycentric solve per ray
+    # rays go along x at fixed (y, z): select triangles spanning each z plane
+    zmin = tri[..., 2].min(axis=1)
+    zmax = tri[..., 2].max(axis=1)
     for iz in range(nz):
         z = float(iz)
-        # triangles whose z-range covers this plane... rays go along x at
-        # fixed (y, z), so select triangles spanning z
-        zmin = tri[..., 2].min(axis=1)
-        zmax = tri[..., 2].max(axis=1)
         sel = np.nonzero((zmin <= z) & (zmax >= z))[0]
         if len(sel) == 0:
             continue
         a, b, c = p0[sel], p1[sel], p2[sel]
+        ymin = np.minimum(np.minimum(a[:, 1], b[:, 1]), c[:, 1])
+        ymax = np.maximum(np.maximum(a[:, 1], b[:, 1]), c[:, 1])
         for iy in range(ny):
             y = float(iy)
-            ymin = np.minimum(np.minimum(a[:, 1], b[:, 1]), c[:, 1])
-            ymax = np.maximum(np.maximum(a[:, 1], b[:, 1]), c[:, 1])
             s2 = np.nonzero((ymin <= y) & (ymax >= y))[0]
             if len(s2) == 0:
                 continue

@@ -31,12 +31,16 @@ def _vtk_type(a: np.ndarray) -> str:
 
 
 def write_vti(path: str, arrays: dict[str, np.ndarray],
-              spacing: float = 1.0, origin=(0.0, 0.0, 0.0)) -> str:
+              spacing: float = 1.0, origin=(0.0, 0.0, 0.0),
+              compress: bool = False) -> str:
     """Write point-data arrays on a uniform grid to ``path``.vti.
 
     Every array is (nz, ny, nx) scalar or (3, nz, ny, nx) vector — 2D inputs
     get a unit z axis.  Appended raw-binary encoding (reference vtkOutput's
-    appended data blocks, src/vtkOutput.cpp).
+    appended data blocks, src/vtkOutput.cpp); ``compress=True`` switches the
+    blocks to vtkZLibDataCompressor layout (native C++ encoder in
+    tclb_tpu/native when available) — every VTK reader understands it and
+    large fields shrink ~3x.
     """
     norm: dict[str, np.ndarray] = {}
     shape = None
@@ -57,10 +61,11 @@ def write_vti(path: str, arrays: dict[str, np.ndarray],
     extent = f"0 {nx} 0 {ny} 0 {nz}"
 
     # cell data: VTK extent counts points; our lattice nodes are cells
+    comp_attr = ' compressor="vtkZLibDataCompressor"' if compress else ""
     head = [
         '<?xml version="1.0"?>',
         '<VTKFile type="ImageData" version="0.1" '
-        'byte_order="LittleEndian" header_type="UInt32">',
+        f'byte_order="LittleEndian" header_type="UInt32"{comp_attr}>',
         f'<ImageData WholeExtent="{extent}" Origin="{origin[0]} {origin[1]} '
         f'{origin[2]}" Spacing="{spacing} {spacing} {spacing}">',
         f'<Piece Extent="{extent}">',
@@ -79,8 +84,12 @@ def write_vti(path: str, arrays: dict[str, np.ndarray],
             f'<DataArray type="{_vtk_type(a)}" Name="{name}" '
             f'NumberOfComponents="{ncomp}" format="appended" '
             f'offset="{offset}"/>')
-        blocks.append(struct.pack("<I", len(raw)) + raw)
-        offset += 4 + len(raw)
+        if compress:
+            from tclb_tpu.native import zlib_blocks
+            blocks.append(zlib_blocks(raw))
+        else:
+            blocks.append(struct.pack("<I", len(raw)) + raw)
+        offset += len(blocks[-1])
     head += ["</CellData>", "</Piece>", "</ImageData>",
              '<AppendedData encoding="raw">']
     if not path.endswith(".vti"):

@@ -79,18 +79,23 @@ def base_def(name: str, E: np.ndarray, description: str = "",
     return d
 
 
-def apply_boundaries(ctx: NodeCtx, f: jnp.ndarray, E: np.ndarray,
-                     W: np.ndarray, OPP: np.ndarray,
-                     extra: Optional[dict] = None) -> jnp.ndarray:
-    """Mask-dispatch every boundary node type the model declares:
-    Wall/Solid bounce-back, <F>Velocity / <F>Pressure faces via
+def boundary_cases(model, E: np.ndarray, W: np.ndarray, OPP: np.ndarray,
+                   vel, den, extra: Optional[dict] = None) -> dict:
+    """The ordered case dict for every boundary node type the model
+    declares: Wall/Solid bounce-back, <F>Velocity / <F>Pressure faces via
     non-equilibrium bounce-back, <F>Symmetry mirrors (the reference's
-    per-node boundary switch, e.g. src/d2q9/Dynamics.c.Rt:121-150)."""
-    si = ctx.model.setting_index
-    vel = ctx.setting("Velocity") if "Velocity" in si else 0.0
-    den = ctx.setting("Density") if "Density" in si else 1.0
-    cases: dict = {("Wall", "Solid"): lambda f: f[jnp.asarray(OPP)]}
-    known = ctx.model.node_types
+    per-node boundary switch, e.g. src/d2q9/Dynamics.c.Rt:121-150).
+
+    ``vel``/``den`` are the (zonal) Velocity/Density values — planes or
+    scalars.  Factored out of :func:`apply_boundaries` so the Pallas
+    kernels (ops/pallas_d3q.py) dispatch the IDENTICAL boundary math."""
+    # permutations as static stacks (not fancy indexing): identical XLA,
+    # and the only form Mosaic accepts inside the Pallas kernels
+    def _perm(f, p):
+        return jnp.stack([f[int(p[k])] for k in range(len(p))])
+
+    cases: dict = {("Wall", "Solid"): lambda f: _perm(f, OPP)}
+    known = model.node_types
     for face, (axis, side) in FACES.items():
         if axis >= E.shape[1]:
             continue
@@ -110,14 +115,25 @@ def apply_boundaries(ctx: NodeCtx, f: jnp.ndarray, E: np.ndarray,
         sname = f"{face}Symmetry"
         if sname in known:
             perm = mirror_perm(E, axis)
-            cases[sname] = lambda f, p=perm: f[jnp.asarray(p)]
+            cases[sname] = lambda f, p=perm: _perm(f, p)
     # legacy d2q9 names for y-mirrors
     for nm, axis in (("TopSymmetry", 1), ("BottomSymmetry", 1)):
         if nm in known and axis < E.shape[1]:
             perm = mirror_perm(E, axis)
-            cases[nm] = lambda f, p=perm: f[jnp.asarray(p)]
+            cases[nm] = lambda f, p=perm: _perm(f, p)
     if extra:
         cases.update(extra)
+    return cases
+
+
+def apply_boundaries(ctx: NodeCtx, f: jnp.ndarray, E: np.ndarray,
+                     W: np.ndarray, OPP: np.ndarray,
+                     extra: Optional[dict] = None) -> jnp.ndarray:
+    """Mask-dispatch the :func:`boundary_cases` of the model."""
+    si = ctx.model.setting_index
+    vel = ctx.setting("Velocity") if "Velocity" in si else 0.0
+    den = ctx.setting("Density") if "Density" in si else 1.0
+    cases = boundary_cases(ctx.model, E, W, OPP, vel, den, extra)
     return ctx.boundary_case(f, cases)
 
 

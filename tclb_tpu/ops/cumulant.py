@@ -26,6 +26,7 @@ per-node branches and no code generation.
 from __future__ import annotations
 
 import numpy as np
+import jax
 import jax.numpy as jnp
 
 # velocity per tensor index: index 0,1,2 -> c = -1,0,+1
@@ -45,25 +46,47 @@ def velocity_set(ndim: int) -> np.ndarray:
                      for cx in C for cy in C for cz in C], dtype=np.int32)
 
 
+def _contract_axis(F: jnp.ndarray, mat: np.ndarray, axis: int) -> jnp.ndarray:
+    """out[..., p, ...] = sum_i mat[p, i] * F[..., i, ...] along ``axis``.
+
+    Unrolled over the static 3x3 matrix (entries are 0/±1/±0.5) instead of
+    an einsum: the same scale-and-add chain XLA would emit, but expressed
+    in primitives (static slice, mul, add, stack) that Mosaic also accepts,
+    so :func:`collide_d3q27` can run unchanged inside a Pallas kernel."""
+    parts = [jax.lax.index_in_dim(F, i, axis, keepdims=False)
+             for i in range(3)]
+    outs = []
+    for p in range(3):
+        acc = None
+        for i in range(3):
+            c = float(mat[p, i])
+            if c == 0.0:
+                continue
+            t = parts[i] if c == 1.0 else \
+                (-parts[i] if c == -1.0 else c * parts[i])
+            acc = t if acc is None else acc + t
+        outs.append(acc if acc is not None else jnp.zeros_like(parts[0]))
+    return jnp.stack(outs, axis=axis)
+
+
 def _raw_moments(F: jnp.ndarray, ndim: int) -> jnp.ndarray:
     """m[p,q(,r)] = sum_ijk C_i^p C_j^q C_k^r F[i,j,k]."""
-    t = jnp.asarray(T, F.dtype)
-    if ndim == 2:
-        return jnp.einsum("pi,qj,ij...->pq...", t, t, F)
-    return jnp.einsum("pi,qj,rk,ijk...->pqr...", t, t, t, F)
+    for ax in range(ndim):
+        F = _contract_axis(F, T, ax)
+    return F
 
 
 def _from_raw_moments(m: jnp.ndarray, ndim: int) -> jnp.ndarray:
-    ti = jnp.asarray(T_INV, m.dtype)
-    if ndim == 2:
-        return jnp.einsum("ip,jq,pq...->ij...", ti, ti, m)
-    return jnp.einsum("ip,jq,kr,pqr...->ijk...", ti, ti, ti, m)
+    for ax in range(ndim):
+        m = _contract_axis(m, T_INV, ax)
+    return m
 
 
 def _centralize(m: jnp.ndarray, u, axis: int) -> jnp.ndarray:
     """Shift raw->central moments along one tensor axis:
     k_0 = m_0; k_1 = m_1 - u m_0; k_2 = m_2 - 2u m_1 + u^2 m_0."""
-    m0, m1, m2 = (jnp.take(m, p, axis=axis) for p in range(3))
+    m0, m1, m2 = (jax.lax.index_in_dim(m, p, axis, keepdims=False)
+                  for p in range(3))
     k0 = m0
     k1 = m1 - u * m0
     k2 = m2 - 2.0 * u * m1 + u * u * m0
@@ -73,11 +96,28 @@ def _centralize(m: jnp.ndarray, u, axis: int) -> jnp.ndarray:
 def _decentralize(k: jnp.ndarray, u, axis: int) -> jnp.ndarray:
     """Inverse shift: m_0 = k_0; m_1 = k_1 + u k_0;
     m_2 = k_2 + 2u k_1 + u^2 k_0."""
-    k0, k1, k2 = (jnp.take(k, p, axis=axis) for p in range(3))
+    k0, k1, k2 = (jax.lax.index_in_dim(k, p, axis, keepdims=False)
+                  for p in range(3))
     m0 = k0
     m1 = k1 + u * k0
     m2 = k2 + 2.0 * u * k1 + u * u * k0
     return jnp.stack([m0, m1, m2], axis=axis)
+
+
+def _moment_tensor(entries: dict, like: jnp.ndarray, ndim: int) -> jnp.ndarray:
+    """Assemble a (3,)*ndim moment tensor from sparse {index: plane}
+    entries (missing indices are zero planes) via nested stacks — the
+    Mosaic-safe equivalent of zeros().at[idx].set(...)."""
+    z = jnp.zeros_like(like)
+    if ndim == 2:
+        return jnp.stack(
+            [jnp.stack([entries.get((p, q), z) for q in range(3)])
+             for p in range(3)])
+    return jnp.stack(
+        [jnp.stack(
+            [jnp.stack([entries.get((p, q, r), z) for r in range(3)])
+             for q in range(3)])
+         for p in range(3)])
 
 
 def collide_d3q27(F: jnp.ndarray, omega, omega_bulk=1.0,
@@ -149,7 +189,6 @@ def collide_d3q27(F: jnp.ndarray, omega, omega_bulk=1.0,
     kxy_p, kxz_p, kyz_p = one_m * kxy, one_m * kxz, one_m * kyz
 
     z = jnp.zeros_like(rho)
-    cs2 = rho / 3.0
     if not correlated:
         # cascaded/factorized equilibrium: higher moments from the
         # UNcorrelated Gaussian (diag cs2) — classic central-moment MRT
@@ -176,22 +215,15 @@ def collide_d3q27(F: jnp.ndarray, omega, omega_bulk=1.0,
                 + 8.0 * kxy_p * kxz_p * kyz_p) * inv * inv
 
     # assemble post-collision central-moment tensor: zero-mean Gaussian =>
-    # moments with any odd axis power vanish (odd entries = 0)
-    kp = jnp.zeros_like(k)
-    kp = kp.at[0, 0, 0].set(rho)
-    kp = kp.at[2, 0, 0].set(kxx_p)
-    kp = kp.at[0, 2, 0].set(kyy_p)
-    kp = kp.at[0, 0, 2].set(kzz_p)
-    kp = kp.at[1, 1, 0].set(kxy_p)
-    kp = kp.at[1, 0, 1].set(kxz_p)
-    kp = kp.at[0, 1, 1].set(kyz_p)
-    kp = kp.at[2, 2, 0].set(g220)
-    kp = kp.at[2, 0, 2].set(g202)
-    kp = kp.at[0, 2, 2].set(g022)
-    kp = kp.at[2, 1, 1].set(g211)
-    kp = kp.at[1, 2, 1].set(g121)
-    kp = kp.at[1, 1, 2].set(g112)
-    kp = kp.at[2, 2, 2].set(g222)
+    # moments with any odd axis power vanish (missing entries = 0)
+    kp = _moment_tensor({
+        (0, 0, 0): rho,
+        (2, 0, 0): kxx_p, (0, 2, 0): kyy_p, (0, 0, 2): kzz_p,
+        (1, 1, 0): kxy_p, (1, 0, 1): kxz_p, (0, 1, 1): kyz_p,
+        (2, 2, 0): g220, (2, 0, 2): g202, (0, 2, 2): g022,
+        (2, 1, 1): g211, (1, 2, 1): g121, (1, 1, 2): g112,
+        (2, 2, 2): g222,
+    }, rho, 3)
 
     ux2 = ux + force[0]
     uy2 = uy + force[1]
@@ -228,12 +260,10 @@ def collide_d2q9(F: jnp.ndarray, omega, omega_bulk=1.0,
     else:
         g22 = kxx_p * kyy_p * inv
 
-    kp = jnp.zeros_like(k)
-    kp = kp.at[0, 0].set(rho)
-    kp = kp.at[2, 0].set(kxx_p)
-    kp = kp.at[0, 2].set(kyy_p)
-    kp = kp.at[1, 1].set(kxy_p)
-    kp = kp.at[2, 2].set(g22)
+    kp = _moment_tensor({
+        (0, 0): rho, (2, 0): kxx_p, (0, 2): kyy_p,
+        (1, 1): kxy_p, (2, 2): g22,
+    }, rho, 2)
 
     mp = _decentralize(kp, ux + force[0], 0)
     mp = _decentralize(mp, uy + force[1], 1)

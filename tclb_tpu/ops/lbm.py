@@ -188,14 +188,16 @@ def nebb_boundary(E: np.ndarray, W: np.ndarray, OPP: np.ndarray,
     src/d3q27_cumulant/Dynamics.c.Rt:210-222): each adds ``rho v_t`` to
     the corresponding tangential momentum target.
     """
-    dt = f.dtype
+    # Unrolled over populations with float-scalar coefficients (no
+    # constant coefficient VECTORS are materialized): identical algebra,
+    # and the form Mosaic accepts when this runs inside a Pallas kernel
+    # (ops/pallas_d3q.py) — Pallas rejects captured non-scalar constants.
+    q = len(E)
     en = E[:, axis].astype(np.int64)
-    tang = jnp.asarray((en == 0), dt)
-    outof = jnp.asarray((en == -side), dt)    # known, entering the wall
-    nd = f.ndim - 1
-    sh = (len(E),) + (1,) * nd
-    s_t = jnp.sum(tang.reshape(sh) * f, axis=0)
-    s_o = jnp.sum(outof.reshape(sh) * f, axis=0)
+    tang_k = [k for k in range(q) if en[k] == 0]
+    out_k = [k for k in range(q) if en[k] == -side]  # known, entering wall
+    s_t = sum(f[k] for k in tang_k)
+    s_o = sum(f[k] for k in out_k)
     if kind == "velocity":
         # value is the signed +axis velocity component at the wall
         un = value
@@ -204,8 +206,8 @@ def nebb_boundary(E: np.ndarray, W: np.ndarray, OPP: np.ndarray,
         rho = value
         un = side * (1.0 - (s_t + 2.0 * s_o) / rho)
     # non-equilibrium bounce-back: f_i = f_opp(i) + 6 w_i rho e_i.u
-    eu = jnp.asarray(en, dt).reshape(sh) * un
-    corr = 6.0 * jnp.asarray(W, dt).reshape(sh) * rho * eu
+    corr = [6.0 * float(W[k]) * float(en[k]) * rho * un
+            if en[k] else None for k in range(q)]
     # tangential closure: redistribute the excess tangential momentum of
     # the wall-parallel populations onto the unknowns, weight-proportional:
     # corr_i += 6 w_i e_t J_t with J_t = -3 q_t + rho v_t — exactly the
@@ -221,7 +223,7 @@ def nebb_boundary(E: np.ndarray, W: np.ndarray, OPP: np.ndarray,
         et = E[:, t_ax].astype(np.int64)
         if not et.any():
             continue
-        q_t = jnp.sum((tang * jnp.asarray(et, dt)).reshape(sh) * f, axis=0)
+        q_t = sum(float(et[k]) * f[k] for k in tang_k if et[k])
         j_t = -3.0 * q_t
         if vt and t_ax in vt:
             # full imposition: the j_t -> total-momentum slope of the 6 w
@@ -230,10 +232,15 @@ def nebb_boundary(E: np.ndarray, W: np.ndarray, OPP: np.ndarray,
             # 83-101 — which imposes a third of the requested tangential
             # velocity; deliberate deviation, documented.)
             j_t = j_t + 3.0 * rho * vt[t_ax]
-        corr = corr + 6.0 * jnp.asarray(W, dt).reshape(sh) \
-            * jnp.asarray(et, dt).reshape(sh) * j_t
-    f_bb = f[jnp.asarray(OPP)]
-    return jnp.where(jnp.asarray(en == side).reshape(sh), f_bb + corr, f)
+        for k in range(q):
+            if en[k] == side and et[k]:
+                add = 6.0 * float(W[k]) * float(et[k]) * j_t
+                corr[k] = add if corr[k] is None else corr[k] + add
+    return jnp.stack([
+        f[int(OPP[k])] + (corr[k] if corr[k] is not None
+                          else jnp.zeros_like(rho))
+        if en[k] == side else f[k]
+        for k in range(q)])
 
 
 def smagorinsky_omega(E: np.ndarray, f: jnp.ndarray, feq: jnp.ndarray,

@@ -1,0 +1,330 @@
+"""Pallas fused collide-stream kernel for the 3D d3q27 model family
+(d3q27_BGK, d3q27_BGK_galcor, d3q27_cumulant).
+
+The 3D counterpart of ops/pallas_d2q9.py — the TPU equivalent of the
+reference's tuned CUDA hot loop (reference
+src/LatticeContainer.inc.cpp.Rt:247-266 ``RunKernel``, the d3q27 cumulant
+kernel src/d3q27_cumulant/Dynamics.c.Rt): one kernel per z-slab band does
+pull-streaming, boundary handling and collision in a single pass, reading
+each density once from HBM and writing it once.
+
+Design (TPU-first):
+
+* the lattice (nz, ny, nx) is tiled into **z-slab bands** of ``BZ`` slabs;
+  each grid step DMAs its band plus one wrapped halo slab above and below
+  into VMEM.  The (ny, nx) plane is the natural (sublane, lane) tile and
+  stays whole — the baseline-scale 3D cases (e.g. the reference's
+  256x48x48 forced channel, example/3d_channel_test_periodic_force_driven
+  .xml) fit whole planes comfortably;
+* pull-streaming is slab-select in z (the halo slabs make ``z ± 1``
+  local), a static 1-row roll in y (sublane shift) and a lane-roll in x;
+* the boundary dispatch reuses ``family.boundary_cases`` — the IDENTICAL
+  closure the XLA path applies — masked over an int32 flag block, and the
+  collision reuses ``ops.cumulant.collide_d3q27`` / the BGK equilibrium
+  verbatim (those modules are written in Mosaic-safe primitives);
+* scalar Settings ride in SMEM; zonal Velocity/Density (+Turbulence) are
+  pre-gathered into per-node planes outside the kernel;
+* like the d2q9 kernel this is the "NoGlobals" specialization
+  (src/cuda.cu.Rt Globals-mode template): ``state.globals_`` is zeroed.
+  The cumulant model's running averages (avgP/avgU) ARE accumulated, and
+  SynthT coupling planes pass through untouched.
+
+``present`` (an iterable of node-type names) restricts which boundary
+cases are materialized: every case is full-plane compute-then-select, so
+skipping absent types is pure win; parity holds whenever the caller passes
+(a superset of) the types actually painted — :func:`present_types`
+computes that set from the host flag field.
+"""
+
+from __future__ import annotations
+
+from functools import partial
+from typing import Callable, Iterable, Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+from tclb_tpu.core.lattice import LatticeState, SimParams
+from tclb_tpu.core.registry import Model
+from tclb_tpu.models import family
+from tclb_tpu.ops import cumulant, lbm
+
+_SUPPORTED = ("d3q27_BGK", "d3q27_BGK_galcor", "d3q27_cumulant")
+_VMEM_BUDGET = 15 * 1024 * 1024
+
+E = cumulant.velocity_set(3)
+W = lbm.weights(E)
+OPP = lbm.opposite(E)
+
+
+def _slab_depth(model: Model, nz: int, ny: int, nx: int) -> Optional[int]:
+    """Largest band depth BZ dividing nz whose working set fits VMEM:
+    scratch (ns, BZ+2) slabs + output block + flag/zonal blocks + the
+    cumulant transform's live intermediates (~6 stacked 27-plane tensors)."""
+    ns = model.n_storage
+    naux = ns - 27
+    per = ny * nx * 4
+    best = None
+    for bz in range(1, nz + 1):
+        if nz % bz:
+            continue
+        # 2-slot f scratch (halo'd) + 2-slot aux scratch + pipelined
+        # out/flags/zonal blocks; collision intermediates live in what
+        # remains of the ~16 MB VMEM (Mosaic errors loudly if they don't)
+        need = (2 * 27 * (bz + 2) + 2 * naux * bz + 2 * ns * bz
+                + 2 * 4 * bz) * per
+        if need > _VMEM_BUDGET:
+            break
+        best = bz
+    return best
+
+
+def supports(model: Model, shape, dtype) -> bool:
+    """Whether the fused 3D kernel can run this configuration."""
+    if model.name not in _SUPPORTED:
+        return False
+    if len(shape) != 3 or dtype != jnp.float32:
+        return False
+    nz, ny, nx = (int(s) for s in shape)
+    if jax.default_backend() == "tpu" and (nx % 128 or ny % 8):
+        return False  # (ny, nx) is the (sublane, lane) tile
+    return _slab_depth(model, nz, ny, nx) is not None
+
+
+def present_types(model: Model, flags: np.ndarray) -> set[str]:
+    """Node-type names actually present in a host flag field."""
+    flags = np.asarray(flags)
+    out = set()
+    for name, t in model.node_types.items():
+        if ((flags & np.uint16(t.mask)) == np.uint16(t.value)).any():
+            out.add(name)
+    return out
+
+
+def make_pallas_iterate(model: Model, shape, dtype=jnp.float32,
+                        interpret: Optional[bool] = None,
+                        present: Optional[Iterable[str]] = None) -> Callable:
+    """Build ``iterate(state, params, niter) -> state`` running the fused
+    3D Pallas kernel.  Caller must check :func:`supports` first."""
+    if not supports(model, shape, dtype):
+        raise ValueError(f"pallas path unsupported for {model.name} {shape}")
+    nz, ny, nx = (int(s) for s in shape)
+    bz = _slab_depth(model, nz, ny, nx)
+    if interpret is None:
+        interpret = jax.default_backend() != "tpu"
+    is_cumulant = model.name == "d3q27_cumulant"
+    galcor = model.name.endswith("galcor")
+
+    ns = model.n_storage
+    f_idx = list(model.groups["f"])
+    assert f_idx == list(range(27)), "kernel assumes f planes lead the stack"
+    si = model.setting_index
+    sidx = model.storage_index
+    nt = {n: (int(t.mask), int(t.value)) for n, t in model.node_types.items()}
+    coll_mask = int(model.group_masks["COLLISION"])
+    present = set(nt) if present is None else set(present)
+
+    zonal_names = ["Velocity", "Density"] + \
+        (["Turbulence"] if is_cumulant else [])
+    if is_cumulant:
+        synth_idx = [sidx[n] for n in ("SynthTX", "SynthTY", "SynthTZ")]
+        avgp_idx = sidx["avgP"]
+        avgu_idx = [sidx[n] for n in ("avgUX", "avgUY", "avgUZ")]
+        aux_idx = synth_idx + [avgp_idx] + avgu_idx
+    else:
+        aux_idx = []
+    assert sorted(f_idx + aux_idx) == list(range(ns))
+
+    def _is(flags, name):
+        mask, val = nt[name]
+        return (flags & jnp.int32(mask)) == jnp.int32(val)
+
+    def _step(f, flags, zonal, synth, sett):
+        """Boundaries + collision on one band — op-for-op the model's
+        ``run`` (models/d3q27_bgk.py, models/d3q27_cumulant.py), minus
+        globals."""
+        vel, den = zonal[0], zonal[1]
+        extra = None
+        if is_cumulant:
+            turb = zonal[2]
+            turb_u = vel + turb * synth[0]
+            extra = {"WVelocityTurbulent": lambda f: lbm.nebb_boundary(
+                E, W, OPP, f, 0, +1, "velocity", turb_u,
+                vt={1: turb * synth[1], 2: turb * synth[2]})}
+        cases = family.boundary_cases(model, E, W, OPP, vel, den, extra)
+        out = f
+        for names, fn in cases.items():
+            names = [n for n in ((names,) if isinstance(names, str)
+                                 else names) if n in present]
+            if not names:
+                continue
+            mask = _is(flags, names[0])
+            for n in names[1:]:
+                mask = mask | _is(flags, n)
+            out = jnp.where(mask[None], fn(f), out)
+        f = out
+
+        coll = (flags & jnp.int32(coll_mask)) != jnp.int32(0)
+        if is_cumulant:
+            om = jnp.where(
+                _is(flags, "Buffer"),
+                1.0 / (3.0 * sett[si["nubuffer"]] + 0.5),
+                sett[si["omega"]]).astype(f.dtype)
+            force = tuple(sett[si[f"Force{a}"]] + sett[si[f"Gravitation{a}"]]
+                          for a in "XYZ")
+            F = f.reshape((3, 3, 3) + f.shape[1:])
+            Fp, rho, (ux, uy, uz) = cumulant.collide_d3q27(
+                F, om, sett[si["omega_bulk"]], force=force, correlated=True,
+                galilean=sett[si["GalileanCorrection"]])
+            f = jnp.where(coll[None], Fp.reshape(f.shape), f)
+            return f, ((rho - 1.0) / 3.0, (ux, uy, uz))
+        from tclb_tpu.models.d3q27_bgk import _equilibrium
+        rho = sum(f[k] for k in range(27))
+        u = tuple(sum(float(E[k, a]) * f[k] for k in range(27)
+                      if E[k, a]) / rho for a in range(3))
+        om = sett[si["omega"]]
+        feq = _equilibrium(rho, u, galcor)
+        fc = f + om * (feq - f)
+        g = tuple(sett[si[f"Gravitation{a}"]] for a in "XYZ")
+        u2 = tuple(u[a] + g[a] for a in range(3))
+        fc = fc + (_equilibrium(rho, u2, galcor) - feq)
+        return jnp.where(coll[None], fc, f), None
+
+    naux = len(aux_idx)
+
+    def kernel(sett, f_hbm, flags_ref, zonal_ref, out_ref, scrf, scra, sems):
+        # 2-slot double buffering: band i+1's DMAs are issued before band
+        # i's compute, overlapping HBM fetch with VPU work across grid
+        # steps (the reference gets the same overlap from its border/
+        # interior kernel split + async memcpy streams,
+        # src/Lattice.cu.Rt:424-456).  f planes get z±1 halo slabs; aux
+        # planes (SynthT/avg) are local-only and skip the halo.
+        i = pl.program_id(0)
+        n = pl.num_programs(0)
+
+        def band_dmas(slot, band):
+            base = band * jnp.int32(bz)
+            zm = jax.lax.rem(base - jnp.int32(1) + jnp.int32(nz),
+                             jnp.int32(nz))
+            zp = jax.lax.rem(base + jnp.int32(bz), jnp.int32(nz))
+            copies = [
+                pltpu.make_async_copy(f_hbm.at[pl.ds(0, 27), pl.ds(base, bz)],
+                                      scrf.at[slot, :, pl.ds(1, bz)],
+                                      sems.at[slot, 0]),
+                pltpu.make_async_copy(f_hbm.at[pl.ds(0, 27), pl.ds(zm, 1)],
+                                      scrf.at[slot, :, pl.ds(0, 1)],
+                                      sems.at[slot, 1]),
+                pltpu.make_async_copy(f_hbm.at[pl.ds(0, 27), pl.ds(zp, 1)],
+                                      scrf.at[slot, :, pl.ds(bz + 1, 1)],
+                                      sems.at[slot, 2]),
+            ]
+            if naux:
+                copies.append(pltpu.make_async_copy(
+                    f_hbm.at[pl.ds(27, naux), pl.ds(base, bz)],
+                    scra.at[slot], sems.at[slot, 3]))
+            return copies
+
+        slot = jax.lax.rem(i, jnp.int32(2))
+        nxt = jax.lax.rem(i + jnp.int32(1), jnp.int32(2))
+
+        @pl.when(i == 0)
+        def _():
+            for c in band_dmas(jnp.int32(0), i):
+                c.start()
+
+        @pl.when(i + 1 < n)
+        def _():
+            for c in band_dmas(nxt, i + jnp.int32(1)):
+                c.start()
+
+        for c in band_dmas(slot, i):
+            c.wait()
+
+        # pull-streaming: f_k(z,y,x) <- f_k(z-dz, y-dy, x-dx); halo slabs
+        # cover z +- 1, a static sublane roll covers y, a lane-roll x
+        # (matches core.lattice.pull_stream's periodic jnp.roll semantics)
+        pulled = []
+        for k in range(27):
+            dx, dy, dz = int(E[k, 0]), int(E[k, 1]), int(E[k, 2])
+            sl = scrf[slot, k, 1 - dz:1 - dz + bz]
+            if dy:
+                sl = jnp.roll(sl, dy, axis=1)
+            if dx:
+                sl = pltpu.roll(sl, dx % nx, axis=2)
+            pulled.append(sl)
+        f = jnp.stack(pulled)
+        flags = flags_ref[:]
+        zonal = zonal_ref[:]
+        synth = [scra[slot, aux_idx.index(j)] for j in synth_idx] \
+            if is_cumulant else None
+        fnew, extras = _step(f, flags, zonal, synth, sett)
+        for k in range(27):
+            out_ref[k] = fnew[k]
+        if is_cumulant:
+            # SynthT passthrough; running averages accumulate per step
+            # (reference average=T densities + Lattice::resetAverage)
+            for j in synth_idx:
+                out_ref[j] = scra[slot, aux_idx.index(j)]
+            p_inc, (ux, uy, uz) = extras
+            out_ref[avgp_idx] = scra[slot, aux_idx.index(avgp_idx)] + p_inc
+            for j, u in zip(avgu_idx, (ux, uy, uz)):
+                out_ref[j] = scra[slot, aux_idx.index(j)] + u
+
+    call = pl.pallas_call(
+        kernel,
+        grid=(nz // bz,),
+        in_specs=[
+            pl.BlockSpec(memory_space=pltpu.SMEM),
+            pl.BlockSpec(memory_space=pl.ANY),
+            pl.BlockSpec((bz, ny, nx), lambda i: (i, 0, 0),
+                         memory_space=pltpu.VMEM),
+            pl.BlockSpec((len(zonal_names), bz, ny, nx),
+                         lambda i: (0, i, 0, 0), memory_space=pltpu.VMEM),
+        ],
+        out_specs=pl.BlockSpec((ns, bz, ny, nx), lambda i: (0, i, 0, 0),
+                               memory_space=pltpu.VMEM),
+        out_shape=jax.ShapeDtypeStruct((ns, nz, ny, nx), dtype),
+        scratch_shapes=[
+            pltpu.VMEM((2, 27, bz + 2, ny, nx), dtype),
+            pltpu.VMEM((2, max(naux, 1), bz, ny, nx), dtype),
+            pltpu.SemaphoreType.DMA((2, 4)),
+        ],
+        interpret=interpret,
+    )
+
+    zshift = model.zone_shift
+    zonal_si = [si[n] for n in zonal_names]
+
+    @partial(jax.jit, static_argnames=("niter",), donate_argnums=0)
+    def _iterate_jit(state: LatticeState, params: SimParams,
+                     niter: int) -> LatticeState:
+        flags_i32 = state.flags.astype(jnp.int32)
+        zones = flags_i32 >> zshift
+        zonal = jnp.stack([params.zone_table[j].astype(dtype)[zones]
+                           for j in zonal_si])
+        sett = params.settings.astype(dtype)
+
+        def body(fields, _):
+            return call(sett, fields, flags_i32, zonal), None
+
+        fields, _ = jax.lax.scan(body, state.fields, None, length=niter)
+        return LatticeState(
+            fields=fields,
+            flags=state.flags,
+            globals_=jnp.zeros_like(state.globals_),
+            iteration=state.iteration + niter,
+        )
+
+    def iterate(state: LatticeState, params: SimParams, niter: int
+                ) -> LatticeState:
+        if params.time_series is not None:
+            raise ValueError(
+                "pallas iterate does not support Control time series; "
+                "use the XLA path for time-dependent zonal settings")
+        return _iterate_jit(state, params, niter)
+
+    return iterate

@@ -532,6 +532,12 @@ class Lattice:
         self.sampler = None
         self._iterate_sampled = None
         self.avg_start = 0    # iteration of the last <Average> reset
+        # fused Pallas fast path: built lazily at the first iterate() so the
+        # painted flags are known (the 3D kernel specializes on present node
+        # types); see _fast_path()
+        self._fast = None
+        self._fast_name = None
+        self._fast_tried = False
 
     # -- setup -------------------------------------------------------------- #
 
@@ -543,6 +549,7 @@ class Lattice:
             self.state, flags=jnp.asarray(flags, dtype=FLAG_DTYPE))
         if self._place is not None:
             self.state, self.params = self._place()
+        self._fast_tried = False   # present node types may have changed
 
     def set_setting(self, name: str, value: float, zone: Optional[int] = None
                     ) -> None:
@@ -596,6 +603,50 @@ class Lattice:
 
     # -- running ------------------------------------------------------------ #
 
+    def _build_fast(self):
+        """Try to build the fused Pallas fast path for this configuration
+        (the reference's tuned kernel IS its engine — Lattice::Iteration
+        launches it every step, src/Lattice.cu.Rt:414-457; this makes the
+        Pallas kernel play the same role).  Auto-selected on TPU only: in
+        interpret mode (CPU) the kernels are an emulation, far slower than
+        XLA.  ``TCLB_FASTPATH=0`` disables; ``TCLB_FASTPATH=force`` enables
+        off-TPU (tests use this to exercise the dispatch in interpret
+        mode)."""
+        import os
+        mode = os.environ.get("TCLB_FASTPATH", "auto")
+        if mode == "0" or self.mesh is not None:
+            return None, None
+        if jax.default_backend() != "tpu" and mode != "force":
+            return None, None
+        from tclb_tpu.ops import pallas_d2q9, pallas_d3q
+        if pallas_d2q9.supports(self.model, self.shape, self.dtype):
+            present = pallas_d2q9.present_types(
+                self.model, np.asarray(self.state.flags))
+            return (pallas_d2q9.make_pallas_iterate(
+                self.model, self.shape, self.dtype, fuse=2,
+                present=present),
+                "pallas_d2q9[fuse=2]")
+        if pallas_d3q.supports(self.model, self.shape, self.dtype):
+            present = pallas_d3q.present_types(
+                self.model, np.asarray(self.state.flags))
+            return (pallas_d3q.make_pallas_iterate(
+                self.model, self.shape, self.dtype, present=present),
+                "pallas_d3q27")
+        return None, None
+
+    def _fast_path(self):
+        if not self._fast_tried:
+            self._fast_tried = True
+            self._fast, self._fast_name = self._build_fast()
+            from tclb_tpu.utils import log
+            if self._fast is not None:
+                log.info(f"engine: {self._fast_name} fused fast path "
+                         "(+1 XLA step per call for globals)")
+            else:
+                log.debug(f"engine: XLA path ({self.model.name} "
+                          f"{self.shape})")
+        return self._fast
+
     def iterate(self, niter: int) -> None:
         if self.sampler is not None:
             it0 = int(self.state.iteration)
@@ -603,6 +654,19 @@ class Lattice:
                 self.state, self.params, niter,
                 jnp.asarray(self.avg_start, jnp.int32))
             self.sampler.append(it0, np.asarray(samples))
+            return
+        fast = self._fast_path()
+        if (fast is not None and niter > 1
+                and self.params.time_series is None):
+            # hybrid engine: the fused kernel runs niter-1 steps, then one
+            # XLA step refreshes globals — iterate()'s contract is
+            # "globals_ = the LAST step's integrals" (make_action_step
+            # zeroes per step), so this is exact, not an approximation.
+            # The reference accumulates globals inside the same hot kernel
+            # (src/cuda.cu.Rt:176-202); here the trailing step plays that
+            # role at 1/niter amortized cost.
+            self.state = fast(self.state, self.params, niter - 1)
+            self.state = self._iterate(self.state, self.params, 1)
         else:
             self.state = self._iterate(self.state, self.params, niter)
 
@@ -703,6 +767,7 @@ class Lattice:
 
     def load(self, path: str) -> None:
         d = np.load(path if path.endswith(".npz") else path + ".npz")
+        self._fast_tried = False   # restored flags may paint new types
         self.state = LatticeState(
             fields=jnp.asarray(d["fields"], dtype=self.dtype),
             flags=jnp.asarray(d["flags"], dtype=FLAG_DTYPE),

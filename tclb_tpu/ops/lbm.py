@@ -16,6 +16,19 @@ import jax.numpy as jnp
 CS2 = 1.0 / 3.0  # lattice speed of sound squared
 
 
+def present_types(model, flags: np.ndarray) -> set:
+    """Node-type names actually present in a host flag field — used by the
+    Pallas kernels to skip absent boundary cases (the reference gets the
+    same effect from compile-time specialization of the generated kernel
+    on the model's boundary set)."""
+    flags = np.asarray(flags)
+    out = set()
+    for name, t in model.node_types.items():
+        if ((flags & np.uint16(t.mask)) == np.uint16(t.value)).any():
+            out.add(name)
+    return out
+
+
 def opposite(E: np.ndarray) -> np.ndarray:
     """Index i -> index of -e_i (bounce-back pairing)."""
     opp = np.zeros(len(E), dtype=np.int32)

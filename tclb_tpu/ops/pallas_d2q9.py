@@ -50,7 +50,7 @@ from jax.experimental.pallas import tpu as pltpu
 
 from tclb_tpu.core.lattice import LatticeState, SimParams
 from tclb_tpu.core.registry import Model
-from tclb_tpu.ops.lbm import equilibrium
+from tclb_tpu.ops.lbm import equilibrium, present_types  # noqa: F401
 
 _VMEM_SCRATCH_BUDGET = 4 * 1024 * 1024  # bytes for the band scratch
 
@@ -78,42 +78,101 @@ def _band_rows(model: Model, ny: int, nx: int) -> Optional[int]:
     return best
 
 
+def _fused_band(by: int, ny: int) -> int:
+    """Band height of the temporally-fused kernel (its VMEM working set
+    holds two full intermediate stacks, so the band is capped lower)."""
+    by2 = by
+    while by2 > 8 and (ny % by2 or by2 > 32):
+        by2 -= 8
+    return by2
+
+
+def _pad_rows(model: Model, ny: int, nx: int) -> Optional[int]:
+    """Ghost-row padding lifting the ny % 8 (sublane tile) restriction.
+
+    The kernel's DMA offsets need row counts that are multiples of 8; a
+    lattice like the reference's karman.xml (1024x100) is padded with
+    >= 4 ghost rows.  The first two ghost rows mirror physical rows 0,1
+    and the last two mirror rows ny-2,ny-1 — refreshed before every
+    kernel call — so the kernel's internal wrap over the padded height
+    reproduces the EXACT periodic pull of the physical height (reach
+    <= 2 for the fused two-step kernel).  Middle ghost rows (pad > 4)
+    are never read by any physical row: they get static Wall flags and
+    evolve freely (any garbage is confined — physical rows pull only
+    from the refreshed mirror rows).
+
+    The padded height is CHOSEN for band efficiency, not minimality: an
+    8-row band pays 8+16 halo rows of DMA per 8 computed (3x read
+    amplification), so padding further to reach a richer divisor (100 ->
+    120 with 24-row fused bands) is a net traffic win.  Returns the pad
+    (0 for already-aligned heights), or None if no candidate fits."""
+    if ny % 8 == 0 and _band_rows(model, ny, nx) is not None:
+        return 0
+    lo = ny + 4 if ny % 8 else ny + 8   # aligned heights without a valid
+    best, best_score = None, None       # band still pad (rare: tiny VMEM)
+    for ny_pad in range(((lo + 7) // 8) * 8, 2 * ny + 64, 8):
+        by = _band_rows(model, ny_pad, nx)
+        if by is None:
+            continue
+        by2 = _fused_band(by, ny_pad)
+        score = ny_pad * (1.0 + (by2 + 16.0) / by2)
+        if best_score is None or score < best_score:
+            best, best_score = ny_pad - ny, score
+        if ny_pad >= ny + 64 and best is not None:
+            break   # diminishing returns; keep the search bounded
+    return best
+
+
 def supports(model: Model, shape, dtype) -> bool:
-    """Whether the fused kernel can run this configuration."""
-    if model.name not in ("d2q9", "d2q9_new"):
+    """Whether the fused kernel can run this configuration.
+
+    Only plain ``d2q9``: the kernel hardcodes d2q9's MRT physics and node
+    types; ``d2q9_new``'s raw-moment/LES/entropic collision is different
+    physics and must not silently run through this kernel."""
+    if model.name != "d2q9":
         return False
     if len(shape) != 2 or dtype != jnp.float32:
         return False
     ny, nx = shape
+    if ny < 8:
+        return False
     if jax.default_backend() == "tpu" and nx % 128:
         return False  # x is the lane dimension; keep it tile-aligned
-    return _band_rows(model, ny, nx) is not None
+    return _pad_rows(model, ny, nx) is not None
 
 
 def _sparse_matvec(mat: np.ndarray, planes: list) -> list:
-    """y = mat @ planes, unrolled over the (static, mostly-zero) matrix."""
+    """y = mat @ planes, unrolled over the (static, mostly-zero) matrix.
+    ``planes`` entries may be None (= identically-zero plane, skipped)."""
     out = []
     for row in mat:
         acc = None
         for c, p in zip(row, planes):
             c = float(c)
-            if c == 0.0:
+            if c == 0.0 or p is None:
                 continue
             t = p if c == 1.0 else (-p if c == -1.0 else c * p)
             acc = t if acc is None else acc + t
-        out.append(acc if acc is not None else jnp.zeros_like(planes[0]))
+        out.append(acc if acc is not None else jnp.zeros_like(
+            next(p for p in planes if p is not None)))
     return out
 
 
 def make_pallas_iterate(model: Model, shape, dtype=jnp.float32,
                         interpret: Optional[bool] = None,
-                        fuse: int = 1) -> Callable:
+                        fuse: int = 1,
+                        present: Optional[set] = None) -> Callable:
     """Build ``iterate(state, params, niter) -> state`` running the fused
     Pallas collide-stream kernel.  Caller must check :func:`supports` first.
 
     ``fuse=2`` runs TWO lattice steps per kernel band pass (halving the
     HBM traffic per step); an odd trailing step falls back to the single-
-    step kernel."""
+    step kernel.
+
+    ``present`` restricts which boundary node types are materialized
+    (every case is full-band compute-then-select, so skipping absent
+    types is pure win); parity holds whenever it is a superset of the
+    types actually painted — :func:`present_types` computes that set."""
     from tclb_tpu.models import d2q9 as mod
 
     if not supports(model, shape, dtype):
@@ -121,13 +180,13 @@ def make_pallas_iterate(model: Model, shape, dtype=jnp.float32,
     if fuse not in (1, 2):
         raise ValueError(f"fuse={fuse}: only 1 (single-step) and 2 "
                          "(temporally-fused pair) kernels exist")
-    ny, nx = (int(s) for s in shape)
+    ny_phys, nx = (int(s) for s in shape)
+    pad = _pad_rows(model, ny_phys, nx)
+    if pad is None:
+        raise ValueError(f"no valid band height for shape {shape}")
+    ny = ny_phys + pad
     by = _band_rows(model, ny, nx)
-    # the fused kernel holds two full band stacks of intermediates in
-    # VMEM; cap its band lower so the compiler's scoped allocation fits
-    by2 = by
-    while by2 > 8 and (ny % by2 or by2 > 32):
-        by2 -= 8
+    by2 = _fused_band(by, ny)
     assert ny % by2 == 0   # _band_rows guarantees multiple-of-8 divisors
     if interpret is None:
         interpret = jax.default_backend() != "tpu"
@@ -144,6 +203,7 @@ def make_pallas_iterate(model: Model, shape, dtype=jnp.float32,
     i_s3, i_s4, i_s56, i_s78 = si["S3"], si["S4"], si["S56"], si["S78"]
     i_gx, i_gy = si["GravitationX"], si["GravitationY"]
     nt = {n: (int(t.mask), int(t.value)) for n, t in model.node_types.items()}
+    present = set(nt) if present is None else set(present) | {"MRT"}
 
     def _is(flags, name):
         mask, val = nt[name]
@@ -152,41 +212,61 @@ def make_pallas_iterate(model: Model, shape, dtype=jnp.float32,
     def _lbm_step(f, flags, vel, den, bc0, bc1, sett):
         """One collide step on an arbitrary row band: boundary dispatch in
         the same case order as models.d2q9.run, then the MRT collision
-        (mirrors models.d2q9._collision_mrt, sans globals)."""
+        (mirrors models.d2q9._collision_mrt, sans globals).  Absent node
+        types (``present``) are skipped entirely — each case is a
+        full-band compute, so this mirrors the reference's compile-time
+        specialization of the kernel on the model's boundary set."""
         def apply(mask, new, cur):
             return jnp.where(mask[None], new, cur)
 
-        f = apply(_is(flags, "Wall") | _is(flags, "Solid"),
-                  jnp.stack([f[int(OPP[k])] for k in range(9)]), f)
-        f = apply(_is(flags, "EVelocity"),
-                  mod._zou_he_x(f, vel, "velocity", "E"), f)
-        f = apply(_is(flags, "WPressure"),
-                  mod._zou_he_x(f, den, "pressure", "W"), f)
-        f = apply(_is(flags, "WVelocity"),
-                  mod._zou_he_x(f, vel, "velocity", "W"), f)
-        f = apply(_is(flags, "EPressure"),
-                  mod._zou_he_x(f, den, "pressure", "E"), f)
-        f = apply(_is(flags, "TopSymmetry"), mod._symmetry(f, top=True), f)
-        f = apply(_is(flags, "BottomSymmetry"),
-                  mod._symmetry(f, top=False), f)
+        def mask_of(*names):
+            names = [n for n in names if n in present]
+            if not names:
+                return None
+            m = _is(flags, names[0])
+            for n in names[1:]:
+                m = m | _is(flags, n)
+            return m
+
+        ws = mask_of("Wall", "Solid")
+        if ws is not None:
+            f = apply(ws, jnp.stack([f[int(OPP[k])] for k in range(9)]), f)
+        for name, plane, kind, side in (
+                ("EVelocity", vel, "velocity", "E"),
+                ("WPressure", den, "pressure", "W"),
+                ("WVelocity", vel, "velocity", "W"),
+                ("EPressure", den, "pressure", "E")):
+            if name in present:
+                f = apply(_is(flags, name),
+                          mod._zou_he_x(f, plane, kind, side), f)
+        if "TopSymmetry" in present:
+            f = apply(_is(flags, "TopSymmetry"),
+                      mod._symmetry(f, top=True), f)
+        if "BottomSymmetry" in present:
+            f = apply(_is(flags, "BottomSymmetry"),
+                      mod._symmetry(f, top=False), f)
 
         rho = sum(f[k] for k in range(9))
         ux = sum(float(E[k, 0]) * f[k] for k in range(9) if E[k, 0]) / rho
         uy = sum(float(E[k, 1]) * f[k] for k in range(9) if E[k, 1]) / rho
         s3, s4 = sett[i_s3], sett[i_s4]
         s56, s78 = sett[i_s56], sett[i_s78]
-        zero = jnp.zeros_like(rho)
-        omega_m = [zero, zero, zero, s3 + zero, s4 + zero,
-                   s56 + zero, s56 + zero, s78 + zero, s78 + zero]
         feq = equilibrium(E, W, rho, (ux, uy))
         fneq = [f[k] - feq[k] for k in range(9)]
-        m_neq = [m * o for m, o in zip(_sparse_matvec(M, fneq), omega_m)]
+        # moment rates: rows 0-2 (density/momentum) relax at rate 0, so
+        # their moments need not be computed and their Minv columns drop
+        # out — exact, the conserved moments never enter the update
+        rates = [s3, s4, s56, s56, s78, s78]
+        mn = _sparse_matvec(M[3:], fneq)
+        m_neq = [None, None, None] + [m * o for m, o in zip(mn, rates)]
         ux2 = ux + sett[i_gx] + bc0
         uy2 = uy + sett[i_gy] + bc1
         feq2 = equilibrium(E, W, rho, (ux2, uy2))
-        m_post = [a + b for a, b in
-                  zip(m_neq, _sparse_matvec(M, [feq2[k] for k in range(9)]))]
-        coll = _sparse_matvec(Minv, m_post)
+        # Minv @ (m_neq + M @ feq2) == Minv @ m_neq + feq2 — one matvec
+        # saved vs the naive moment-space form (exact algebra, not an
+        # approximation)
+        relax = _sparse_matvec(Minv, m_neq)
+        coll = [r + q for r, q in zip(relax, feq2)]
         mrt = _is(flags, "MRT")
         return jnp.stack([jnp.where(mrt, coll[k], f[k]) for k in range(9)])
 
@@ -399,26 +479,49 @@ def make_pallas_iterate(model: Model, shape, dtype=jnp.float32,
     def _iterate_jit(state: LatticeState, params: SimParams, niter: int,
                      fuse: int = 1) -> LatticeState:
         flags_i32 = state.flags.astype(jnp.int32)
+        fields = state.fields
+        if pad:
+            # ghost layout: [mirror 0, mirror 1, walls..., mirror ny-2,
+            # mirror ny-1]; middle ghosts are Wall nodes (bounce-back in
+            # place — unconditionally stable, and never read by physical
+            # rows)
+            init_src = jnp.asarray(np.array(
+                [0, 1] + [0] * (pad - 4) + [ny_phys - 2, ny_phys - 1]))
+            gflags = flags_i32[init_src]
+            if pad > 4:
+                wall = jnp.int32(model.flag_for("Wall"))
+                gflags = gflags.at[2:pad - 2].set(wall)
+            flags_i32 = jnp.concatenate([flags_i32, gflags], axis=0)
+            fields = jnp.concatenate([fields, fields[:, init_src, :]],
+                                     axis=1)
         zones = flags_i32 >> zshift
         vel = params.zone_table[i_vel].astype(dtype)[zones]
         den = params.zone_table[i_den].astype(dtype)[zones]
         sett = params.settings.astype(dtype)
-        fields = state.fields
+
+        def refresh(fields):
+            if not pad:
+                return fields
+            f = fields.at[:, ny_phys:ny_phys + 2, :].set(fields[:, 0:2, :])
+            return f.at[:, ny - 2:, :].set(
+                fields[:, ny_phys - 2:ny_phys, :])
 
         if fuse == 2:
             aux = jnp.stack([flags_i32.astype(dtype), vel, den])
 
             def body2(fields, _):
-                return call2(sett, fields, aux), None
+                return call2(sett, refresh(fields), aux), None
 
             fields, _ = jax.lax.scan(body2, fields, None,
                                      length=niter // 2)
         rest = niter % 2 if fuse == 2 else niter
 
         def body(fields, _):
-            return call(sett, fields, flags_i32, vel, den), None
+            return call(sett, refresh(fields), flags_i32, vel, den), None
 
         fields, _ = jax.lax.scan(body, fields, None, length=rest)
+        if pad:
+            fields = fields[:, :ny_phys, :]
         return LatticeState(
             fields=fields,
             flags=state.flags,

@@ -95,14 +95,7 @@ def supports(model: Model, shape, dtype) -> bool:
     return _slab_depth(model, nz, ny, nx) is not None
 
 
-def present_types(model: Model, flags: np.ndarray) -> set[str]:
-    """Node-type names actually present in a host flag field."""
-    flags = np.asarray(flags)
-    out = set()
-    for name, t in model.node_types.items():
-        if ((flags & np.uint16(t.mask)) == np.uint16(t.value)).any():
-            out.add(name)
-    return out
+present_types = lbm.present_types   # shared helper (re-exported)
 
 
 def make_pallas_iterate(model: Model, shape, dtype=jnp.float32,

@@ -41,6 +41,36 @@ def test_supports():
     assert not pallas_d2q9.supports(m, (7, 128), jnp.float32)
     assert not pallas_d2q9.supports(get_model("d2q9_SRT"), (64, 128),
                                     jnp.float32)
+    # non-multiple-of-8 heights run via ghost-row padding (karman is
+    # 1024x100)
+    assert pallas_d2q9.supports(m, (100, 128), jnp.float32)
+    assert pallas_d2q9.supports(m, (42, 128), jnp.float32)
+
+
+@pytest.mark.parametrize("ny,fuse", [(100, 1), (100, 2), (42, 2)])
+def test_pallas_padded_height(ny, fuse):
+    """Lattice heights that violate the 8-row tile (the reference's
+    karman.xml is 1024x100) run through the ghost-row padding and must
+    match the XLA path exactly like aligned shapes do.  ny=42 pads by 6,
+    exercising the middle-ghost (pad > 4) refresh rows."""
+    nx = 128
+    m, lat = _make_lattice(ny, nx)
+    flags = _karman_flags(m, ny, nx)
+    lat.set_flags(flags)
+    lat.init()
+
+    niter = 20
+    it_pallas = pallas_d2q9.make_pallas_iterate(m, (ny, nx), fuse=fuse)
+    s_pallas = it_pallas(
+        jax.tree.map(jnp.copy, lat.state), lat.params, niter)
+    # explicit XLA step: lat.iterate would auto-select the Pallas
+    # path on TPU, making the comparison vacuous there
+    lat.state = lat._iterate(lat.state, lat.params, niter)
+    b = np.asarray(s_pallas.fields)
+    assert b.shape == (m.n_storage, ny, nx)
+    assert np.isfinite(b).all()
+    np.testing.assert_allclose(b, np.asarray(lat.state.fields),
+                               rtol=2e-5, atol=2e-6)
 
 
 @pytest.mark.parametrize("case", ["karman", "periodic_force", "symmetry"])
@@ -67,7 +97,9 @@ def test_pallas_matches_xla(case):
     it_pallas = pallas_d2q9.make_pallas_iterate(m, (ny, nx))
     s_pallas = it_pallas(
         jax.tree.map(jnp.copy, lat.state), lat.params, niter)
-    lat.iterate(niter)
+    # explicit XLA step: lat.iterate would auto-select the Pallas
+    # path on TPU, making the comparison vacuous there
+    lat.state = lat._iterate(lat.state, lat.params, niter)
 
     a = np.asarray(lat.state.fields)
     b = np.asarray(s_pallas.fields)
@@ -93,7 +125,7 @@ def test_pallas_zonal_settings():
     it_pallas = pallas_d2q9.make_pallas_iterate(m, (ny, nx))
     s_pallas = it_pallas(
         jax.tree.map(jnp.copy, lat.state), lat.params, 10)
-    lat.iterate(10)
+    lat.state = lat._iterate(lat.state, lat.params, 10)
     np.testing.assert_allclose(np.asarray(s_pallas.fields),
                                np.asarray(lat.state.fields),
                                rtol=2e-5, atol=2e-6)

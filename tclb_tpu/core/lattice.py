@@ -614,11 +614,21 @@ class Lattice:
         mode)."""
         import os
         mode = os.environ.get("TCLB_FASTPATH", "auto")
-        if mode == "0" or self.mesh is not None:
+        if mode == "0":
             return None, None
         if jax.default_backend() != "tpu" and mode != "force":
             return None, None
         from tclb_tpu.ops import pallas_d2q9, pallas_d3q
+        if self.mesh is not None:
+            from tclb_tpu.ops.lbm import present_types
+            from tclb_tpu.parallel.halo import make_sharded_pallas_iterate
+            it = make_sharded_pallas_iterate(
+                self.model, self.mesh, self.shape, self.dtype,
+                present=present_types(self.model,
+                                      np.asarray(self.state.flags)))
+            if it is not None:
+                return it, f"pallas_sharded[{dict(self.mesh.shape)}]"
+            return None, None
         if pallas_d2q9.supports(self.model, self.shape, self.dtype):
             present = pallas_d2q9.present_types(
                 self.model, np.asarray(self.state.flags))

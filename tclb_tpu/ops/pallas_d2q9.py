@@ -161,7 +161,8 @@ def _sparse_matvec(mat: np.ndarray, planes: list) -> list:
 def make_pallas_iterate(model: Model, shape, dtype=jnp.float32,
                         interpret: Optional[bool] = None,
                         fuse: int = 1,
-                        present: Optional[set] = None) -> Callable:
+                        present: Optional[set] = None,
+                        ext_halo: bool = False):
     """Build ``iterate(state, params, niter) -> state`` running the fused
     Pallas collide-stream kernel.  Caller must check :func:`supports` first.
 
@@ -172,7 +173,16 @@ def make_pallas_iterate(model: Model, shape, dtype=jnp.float32,
     ``present`` restricts which boundary node types are materialized
     (every case is full-band compute-then-select, so skipping absent
     types is pure win); parity holds whenever it is a superset of the
-    types actually painted — :func:`present_types` computes that set."""
+    types actually painted — :func:`present_types` computes that set.
+
+    ``ext_halo=True`` builds the SHARDED building block instead: the
+    domain is one device's block of a y-sharded lattice, the input field
+    stack carries 8 exchanged halo rows at each end ((ns, ny+16, nx)),
+    and the kernels read halos from those rows instead of wrapping
+    periodically.  Returns ``(call1, call2, by, by2)`` raw band calls for
+    :mod:`tclb_tpu.parallel.halo` to compose with ``ppermute`` (the
+    reference's equivalent composition is RunBorder/MPIStream/RunInterior,
+    src/Lattice.cu.Rt:424-456)."""
     from tclb_tpu.models import d2q9 as mod
 
     if not supports(model, shape, dtype):
@@ -181,9 +191,14 @@ def make_pallas_iterate(model: Model, shape, dtype=jnp.float32,
         raise ValueError(f"fuse={fuse}: only 1 (single-step) and 2 "
                          "(temporally-fused pair) kernels exist")
     ny_phys, nx = (int(s) for s in shape)
-    pad = _pad_rows(model, ny_phys, nx)
-    if pad is None:
-        raise ValueError(f"no valid band height for shape {shape}")
+    if ext_halo:
+        if ny_phys % 8:
+            raise ValueError("ext_halo blocks need ny % 8 == 0")
+        pad = 0
+    else:
+        pad = _pad_rows(model, ny_phys, nx)
+        if pad is None:
+            raise ValueError(f"no valid band height for shape {shape}")
     ny = ny_phys + pad
     by = _band_rows(model, ny, nx)
     by2 = _fused_band(by, ny)
@@ -287,13 +302,22 @@ def make_pallas_iterate(model: Model, shape, dtype=jnp.float32,
 
         def band_dmas(slot, band):
             base = pl.multiple_of(band * jnp.int32(by), 8)
-            top8 = pl.multiple_of(
-                jax.lax.rem(base - jnp.int32(8) + jnp.int32(ny),
-                            jnp.int32(ny)), 8)
-            bot8 = pl.multiple_of(
-                jax.lax.rem(base + jnp.int32(by), jnp.int32(ny)), 8)
+            if ext_halo:
+                # input rows are [halo(8) | local ny | halo(8)]: the band
+                # lives at base+8, its halos at base and base+8+by —
+                # no wrap, the exchanged rows ARE the neighbors
+                mid8 = pl.multiple_of(base + jnp.int32(8), 8)
+                top8 = base
+                bot8 = pl.multiple_of(base + jnp.int32(8 + by), 8)
+            else:
+                mid8 = base
+                top8 = pl.multiple_of(
+                    jax.lax.rem(base - jnp.int32(8) + jnp.int32(ny),
+                                jnp.int32(ny)), 8)
+                bot8 = pl.multiple_of(
+                    jax.lax.rem(base + jnp.int32(by), jnp.int32(ny)), 8)
             return (
-                pltpu.make_async_copy(f_hbm.at[:, pl.ds(base, by), :],
+                pltpu.make_async_copy(f_hbm.at[:, pl.ds(mid8, by), :],
                                       mid2.at[slot], sems.at[slot, 0]),
                 pltpu.make_async_copy(f_hbm.at[:, pl.ds(top8, 8), :],
                                       tops2.at[slot], sems.at[slot, 1]),
@@ -358,19 +382,25 @@ def make_pallas_iterate(model: Model, shape, dtype=jnp.float32,
         < 2^16 are exact in f32)."""
         i = pl.program_id(0)
         base = pl.multiple_of(i * jnp.int32(by2), 8)
-        top8 = pl.multiple_of(
-            jax.lax.rem(base - jnp.int32(8) + jnp.int32(ny),
-                        jnp.int32(ny)), 8)
-        bot8 = pl.multiple_of(
-            jax.lax.rem(base + jnp.int32(by2), jnp.int32(ny)), 8)
+        if ext_halo:
+            mid8 = pl.multiple_of(base + jnp.int32(8), 8)
+            top8 = base
+            bot8 = pl.multiple_of(base + jnp.int32(8 + by2), 8)
+        else:
+            mid8 = base
+            top8 = pl.multiple_of(
+                jax.lax.rem(base - jnp.int32(8) + jnp.int32(ny),
+                            jnp.int32(ny)), 8)
+            bot8 = pl.multiple_of(
+                jax.lax.rem(base + jnp.int32(by2), jnp.int32(ny)), 8)
         dmas = (
-            pltpu.make_async_copy(f_hbm.at[:, pl.ds(base, by2), :],
+            pltpu.make_async_copy(f_hbm.at[:, pl.ds(mid8, by2), :],
                                   midf, sems.at[0]),
             pltpu.make_async_copy(f_hbm.at[:, pl.ds(top8, 8), :],
                                   topf, sems.at[1]),
             pltpu.make_async_copy(f_hbm.at[:, pl.ds(bot8, 8), :],
                                   botf, sems.at[2]),
-            pltpu.make_async_copy(aux_hbm.at[:, pl.ds(base, by2), :],
+            pltpu.make_async_copy(aux_hbm.at[:, pl.ds(mid8, by2), :],
                                   mida, sems.at[3]),
             pltpu.make_async_copy(aux_hbm.at[:, pl.ds(top8, 8), :],
                                   topa, sems.at[4]),
@@ -471,6 +501,9 @@ def make_pallas_iterate(model: Model, shape, dtype=jnp.float32,
         ],
         interpret=interpret,
     )
+
+    if ext_halo:
+        return call, call2, by, by2
 
     i_vel, i_den = si["Velocity"], si["Density"]
     zshift = model.zone_shift

@@ -100,9 +100,16 @@ present_types = lbm.present_types   # shared helper (re-exported)
 
 def make_pallas_iterate(model: Model, shape, dtype=jnp.float32,
                         interpret: Optional[bool] = None,
-                        present: Optional[Iterable[str]] = None) -> Callable:
+                        present: Optional[Iterable[str]] = None,
+                        ext_halo: bool = False):
     """Build ``iterate(state, params, niter) -> state`` running the fused
-    3D Pallas kernel.  Caller must check :func:`supports` first."""
+    3D Pallas kernel.  Caller must check :func:`supports` first.
+
+    ``ext_halo=True`` builds the sharded building block: ``shape`` is one
+    device's z-block, the input stack carries ONE exchanged halo slab at
+    each end ((ns, nz+2, ny, nx)) and the kernel reads those instead of
+    wrapping; returns ``(call, bz)`` for parallel/halo.py to compose with
+    ``ppermute``."""
     if not supports(model, shape, dtype):
         raise ValueError(f"pallas path unsupported for {model.name} {shape}")
     nz, ny, nx = (int(s) for s in shape)
@@ -201,11 +208,20 @@ def make_pallas_iterate(model: Model, shape, dtype=jnp.float32,
 
         def band_dmas(slot, band):
             base = band * jnp.int32(bz)
-            zm = jax.lax.rem(base - jnp.int32(1) + jnp.int32(nz),
-                             jnp.int32(nz))
-            zp = jax.lax.rem(base + jnp.int32(bz), jnp.int32(nz))
+            if ext_halo:
+                # input slabs are [halo(1) | local nz | halo(1)]: the band
+                # lives at base+1, halos at base and base+1+bz — no wrap,
+                # the exchanged slabs ARE the neighbors
+                mid1 = base + jnp.int32(1)
+                zm = base
+                zp = base + jnp.int32(1 + bz)
+            else:
+                mid1 = base
+                zm = jax.lax.rem(base - jnp.int32(1) + jnp.int32(nz),
+                                 jnp.int32(nz))
+                zp = jax.lax.rem(base + jnp.int32(bz), jnp.int32(nz))
             copies = [
-                pltpu.make_async_copy(f_hbm.at[pl.ds(0, 27), pl.ds(base, bz)],
+                pltpu.make_async_copy(f_hbm.at[pl.ds(0, 27), pl.ds(mid1, bz)],
                                       scrf.at[slot, :, pl.ds(1, bz)],
                                       sems.at[slot, 0]),
                 pltpu.make_async_copy(f_hbm.at[pl.ds(0, 27), pl.ds(zm, 1)],
@@ -217,7 +233,7 @@ def make_pallas_iterate(model: Model, shape, dtype=jnp.float32,
             ]
             if naux:
                 copies.append(pltpu.make_async_copy(
-                    f_hbm.at[pl.ds(27, naux), pl.ds(base, bz)],
+                    f_hbm.at[pl.ds(27, naux), pl.ds(mid1, bz)],
                     scra.at[slot], sems.at[slot, 3]))
             return copies
 
@@ -288,6 +304,11 @@ def make_pallas_iterate(model: Model, shape, dtype=jnp.float32,
         ],
         interpret=interpret,
     )
+
+    if ext_halo:
+        # zonal_names rides along so callers stack the zonal planes in
+        # exactly the order this kernel's zonal_ref expects
+        return call, bz, zonal_names
 
     zshift = model.zone_shift
     zonal_si = [si[n] for n in zonal_names]

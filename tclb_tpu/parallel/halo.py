@@ -173,6 +173,121 @@ def _globals_allreduce(model: Model, g: jnp.ndarray, names) -> jnp.ndarray:
     return jnp.where(jnp.asarray(is_sum), g_sum, g_max)
 
 
+def make_sharded_pallas_iterate(model: Model, mesh: Mesh, shape,
+                                dtype=jnp.float32,
+                                present: Optional[set] = None,
+                                interpret: Optional[bool] = None
+                                ) -> Optional[Callable]:
+    """Fused Pallas fast path over the device mesh, or None if this
+    configuration can't run it.
+
+    The band axis of the kernels (y in 2D, z in 3D) is the sharded axis;
+    x (and y in 3D) must be unsplit.  Each step exchanges an 8-row (2D,
+    Mosaic tile granularity) or 1-slab (3D) halo via ``ppermute`` and
+    runs the per-shard band kernel on the extended block — the TPU
+    composition of the reference's RunBorder / MPIStream_A / RunInterior
+    / MPIStream_B overlap pipeline (src/Lattice.cu.Rt:424-456), with
+    XLA's latency-hiding scheduler providing the overlap.
+
+    Like the single-device fast path this is the "NoGlobals"
+    specialization: ``globals_`` is zeroed; the Lattice hybrid's trailing
+    XLA step (which psums) supplies them."""
+    from tclb_tpu.ops import pallas_d2q9, pallas_d3q
+    try:
+        _validate_mesh(model, mesh)
+    except ValueError:
+        return None
+    if mesh.shape["x"] != 1 or (model.ndim == 3 and mesh.shape["y"] != 1):
+        return None   # kernels keep the lane plane whole
+    axis = "y" if model.ndim == 2 else "z"
+    n = mesh.shape[axis]
+    if shape[0] % n:
+        return None
+    local = (shape[0] // n,) + tuple(shape[1:])
+    fwd = [(i, (i + 1) % n) for i in range(n)]
+    bwd = [(i, (i - 1) % n) for i in range(n)]
+
+    if model.ndim == 2:
+        if local[0] % 8 or not pallas_d2q9.supports(model, local, dtype):
+            return None
+        call1, call2, by, by2 = pallas_d2q9.make_pallas_iterate(
+            model, local, dtype, interpret=interpret, fuse=2,
+            present=present, ext_halo=True)
+        si = model.setting_index
+        i_vel, i_den = si["Velocity"], si["Density"]
+        width = 8
+    else:
+        if not pallas_d3q.supports(model, local, dtype):
+            return None
+        call3, bz, zonal_names = pallas_d3q.make_pallas_iterate(
+            model, local, dtype, interpret=interpret, present=present,
+            ext_halo=True)
+        si = model.setting_index
+        zonal_si = [si[nm] for nm in zonal_names]
+        width = 1
+    zshift = model.zone_shift
+
+    def exch(arr):
+        """Prepend/append ``width`` halo rows/slabs from the torus
+        neighbors along the sharded axis (identity wrap when n == 1) —
+        the shared halo-exchange primitive, axis 1 = the band axis."""
+        return _exchange_axis(arr, axis, 1, width, n)
+
+    state_specs = LatticeState(
+        fields=field_spec(mesh), flags=flag_spec(mesh),
+        globals_=P(), iteration=P())
+
+    @lru_cache(maxsize=None)
+    def _for_niter(niter: int):
+        def local_iterate(state: LatticeState, params: SimParams
+                          ) -> LatticeState:
+            flags_i32 = state.flags.astype(jnp.int32)
+            zones = flags_i32 >> zshift
+            sett = params.settings.astype(dtype)
+            fields = state.fields
+            if model.ndim == 2:
+                vel = params.zone_table[i_vel].astype(dtype)[zones]
+                den = params.zone_table[i_den].astype(dtype)[zones]
+                aux_ext = exch(jnp.stack(
+                    [flags_i32.astype(dtype), vel, den]))
+
+                def body2(f, _):
+                    return call2(sett, exch(f), aux_ext), None
+
+                fields, _ = lax.scan(body2, fields, None,
+                                     length=niter // 2)
+                if niter % 2:
+                    fields = call1(sett, exch(fields), flags_i32, vel,
+                                   den)
+            else:
+                zonal = jnp.stack([params.zone_table[j].astype(dtype)[zones]
+                                   for j in zonal_si])
+
+                def body3(f, _):
+                    return call3(sett, exch(f), flags_i32, zonal), None
+
+                fields, _ = lax.scan(body3, fields, None, length=niter)
+            return LatticeState(
+                fields=fields,
+                flags=state.flags,
+                globals_=jnp.zeros_like(state.globals_),
+                iteration=state.iteration + niter,
+            )
+
+        f = _shard_map(local_iterate, mesh=mesh,
+                       in_specs=(state_specs, P()),
+                       out_specs=state_specs, check_vma=False)
+        return jax.jit(f, donate_argnums=0)
+
+    def iterate(state, params, niter):
+        if params.time_series is not None:
+            raise ValueError(
+                "pallas iterate does not support Control time series")
+        return _for_niter(int(niter))(state, params)
+
+    return iterate
+
+
 def make_sharded_iterate(model: Model, mesh: Mesh,
                          action: str = "Iteration",
                          unroll: int = 1) -> Callable:

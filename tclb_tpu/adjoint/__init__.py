@@ -14,6 +14,7 @@ explicit function inputs.
 
 from tclb_tpu.adjoint.run import (nested_checkpoint_scan, objective_weights,
                                   make_objective_run, make_unsteady_gradient,
+                                  make_spilled_gradient,
                                   make_steady_gradient, fd_test)
 from tclb_tpu.adjoint.design import (ControlSecond, Design, InternalTopology, OptimalControl,
                                      Fourier, BSpline, RepeatControl,
@@ -22,7 +23,8 @@ from tclb_tpu.adjoint.optimize import optimize
 
 __all__ = [
     "nested_checkpoint_scan", "objective_weights", "make_objective_run",
-    "make_unsteady_gradient", "make_steady_gradient", "fd_test",
+    "make_unsteady_gradient", "make_spilled_gradient",
+    "make_steady_gradient", "fd_test",
     "Design", "InternalTopology", "OptimalControl", "Fourier", "BSpline",
     "RepeatControl", "CompositeDesign", "threshold_topology", "optimize",
 ]

@@ -34,25 +34,93 @@ def _clamp(theta, lo, hi):
                            hi if hi is not None else np.inf), theta)
 
 
+def _project_material(theta, lo, hi, direction: str, m0: float,
+                      mask=None):
+    """Project theta onto the material half-space ``sum(theta[mask]) >=
+    m0`` (direction 'more') or ``<= m0`` ('less') intersected with the
+    [lo, hi] box: bisection on a uniform shift t of the masked entries
+    with re-clipping — the Euclidean projection for this constraint pair.
+    Plays the role of the reference's NLopt inequality constraints
+    FMaterialMore/FMaterialLess (src/Handlers.cpp.Rt:1790-1812) for the
+    projected-descent methods.  ``mask`` selects the entries that ARE
+    material (the design nodes); without it every entry counts — the
+    reference's parameter vector contains only design nodes, ours may
+    carry masked-out background values that must not absorb the
+    projection."""
+    flat_j, unravel = ravel_pytree(theta)
+    # bisection entirely in numpy: one device->host transfer instead of a
+    # blocking float() sync per probe (~180 of them)
+    flat = np.asarray(flat_j, dtype=np.float64)
+    lo_ = -np.inf if lo is None else float(lo)
+    hi_ = np.inf if hi is None else float(hi)
+    msk = np.ones_like(flat) if mask is None else \
+        np.asarray(mask, dtype=np.float64).ravel()
+    total = float(flat @ msk)
+    if (direction == "more" and total >= m0) or \
+            (direction == "less" and total <= m0):
+        return theta
+
+    def s(t):
+        return float(np.clip(flat + t * msk, lo_, hi_) @ msk)
+
+    t_lo, t_hi = -1.0, 1.0
+    for _ in range(60):
+        if s(t_lo) <= m0:
+            break
+        t_lo *= 2.0
+    for _ in range(60):
+        if s(t_hi) >= m0:
+            break
+        t_hi *= 2.0
+    for _ in range(60):
+        tm = 0.5 * (t_lo + t_hi)
+        if s(tm) < m0:
+            t_lo = tm
+        else:
+            t_hi = tm
+    t = t_hi if direction == "more" else t_lo
+    shifted = np.clip(flat + t * msk, lo_, hi_)
+    out = np.where(msk > 0, shifted, flat)
+    return unravel(jnp.asarray(out, dtype=flat_j.dtype))
+
+
 def optimize(grad_fn: Callable, theta0: Any, method: str = "MMA",
              max_eval: int = 20, step: float = 1.0,
              bounds: tuple = (None, None),
-             callback: Optional[Callable] = None) -> tuple[Any, float]:
+             callback: Optional[Callable] = None,
+             material: Optional[tuple] = None
+             ) -> tuple[Any, float]:
     """Minimize ``objective`` over theta.  ``grad_fn(theta) ->
     (objective, grad_pytree)``; returns (theta_opt, best_objective).
 
     ``callback(k, obj, theta)`` fires per accepted evaluation (the
-    reference's per-NLopt-iteration log/VTK hooks)."""
+    reference's per-NLopt-iteration log/VTK hooks).
+
+    ``material=('more'|'less', m0)`` or ``('more'|'less', m0, mask)``
+    constrains the total material ``sum(theta*mask)`` to stay above/below
+    ``m0`` (reference <Optimize Material="more|less">,
+    FMaterialMore/FMaterialLess inequality constraints,
+    src/Handlers.cpp.Rt:1776-1812,1870-1886): projection for the descent
+    methods, SLSQP inequality constraints for the quasi-Newton path.
+    Pass the mask whenever theta carries masked-out background entries
+    (e.g. InternalTopology's full design plane) — without it those
+    entries count as material and absorb the projection."""
     method = method.upper()
     lo, hi = bounds if isinstance(bounds, tuple) and len(bounds) == 2 \
         else (None, None)
+
+    def feasible(theta):
+        if material is None:
+            return theta
+        return _project_material(theta, lo, hi, *material)
+
     if method in ("DESCENT", "STEEPEST"):
-        theta = theta0
+        theta = feasible(theta0)
         obj = np.inf
         for k in range(max_eval):
             obj, g = grad_fn(theta)
-            theta = _clamp(jax.tree_util.tree_map(
-                lambda t, d: t - step * d, theta, g), lo, hi)
+            theta = feasible(_clamp(jax.tree_util.tree_map(
+                lambda t, d: t - step * d, theta, g), lo, hi))
             if callback:
                 callback(k, float(obj), theta)
         return theta, float(obj)
@@ -60,11 +128,12 @@ def optimize(grad_fn: Callable, theta0: Any, method: str = "MMA",
         import optax
         opt = optax.adam(step)
         opt_state = opt.init(theta0)
-        theta, obj = theta0, np.inf
+        theta, obj = feasible(theta0), np.inf
         for k in range(max_eval):
             obj, g = grad_fn(theta)
             upd, opt_state = opt.update(g, opt_state)
-            theta = _clamp(optax.apply_updates(theta, upd), lo, hi)
+            theta = feasible(_clamp(optax.apply_updates(theta, upd),
+                                    lo, hi))
             if callback:
                 callback(k, float(obj), theta)
         return theta, float(obj)
@@ -88,8 +157,20 @@ def optimize(grad_fn: Callable, theta0: Any, method: str = "MMA",
         b = None
         if lo is not None or hi is not None:
             b = [(lo, hi)] * flat0.size
-        res = minimize(f_and_g, flat0, jac=True, method="L-BFGS-B",
-                       bounds=b, options={"maxfun": max_eval})
+        if material is not None:
+            direction, m0 = material[0], material[1]
+            mvec = np.ones(flat0.size) if len(material) < 3 else \
+                np.asarray(material[2], dtype=np.float64).ravel()
+            sign = 1.0 if direction == "more" else -1.0
+            cons = [{"type": "ineq",
+                     "fun": lambda x: sign * (float(x @ mvec) - m0),
+                     "jac": lambda x: sign * mvec}]
+            res = minimize(f_and_g, flat0, jac=True, method="SLSQP",
+                           bounds=b, constraints=cons,
+                           options={"maxiter": max_eval})
+        else:
+            res = minimize(f_and_g, flat0, jac=True, method="L-BFGS-B",
+                           bounds=b, options={"maxfun": max_eval})
         theta = unravel(jnp.asarray(res.x, dtype=flat0.dtype))
         return theta, float(res.fun)
     raise ValueError(f"unknown optimization method {method!r}")

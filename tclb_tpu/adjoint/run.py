@@ -22,6 +22,7 @@ Parity targets:
 from __future__ import annotations
 
 import warnings
+from functools import partial
 from typing import Any, Callable, Optional
 
 import jax
@@ -128,6 +129,112 @@ def make_unsteady_gradient(model: Model, design, niter: int,
         return obj, g, final
 
     return jax.jit(grad_fn)
+
+
+def make_spilled_gradient(model: Model, design, niter: int, segment: int,
+                          action: str = "Iteration",
+                          streaming: Optional[Streaming] = None,
+                          levels: int = 1,
+                          spill_dir: Optional[str] = None) -> Callable:
+    """Unsteady gradient with HOST (or disk) snapshot spill for horizons
+    whose in-HBM remat tree does not fit.
+
+    The reference spills snapshot levels >= nSnaps to disk
+    (``_Snap_PP_LL.dat``, src/Lattice.cu.Rt:735-765) so the reverse sweep
+    of an arbitrarily long horizon needs only O(segment) device memory.
+    Same structure here: the forward pass runs segment-by-segment, parking
+    each segment's entry fields on the host (numpy) or on disk
+    (``spill_dir``); the reverse sweep walks the segments backward,
+    re-running each with ``jax.vjp`` (in-segment remat via ``levels``) and
+    chaining the fields cotangent across the segment boundary.  Device
+    memory is O(one segment's remat tree); host/disk holds
+    ``ceil(niter/segment)`` field stacks.
+
+    Exactly equals :func:`make_unsteady_gradient` (same time-integrated
+    InObj objective; ``design.put`` re-applied per segment is identity on
+    the carried design planes, so no contribution is double-counted —
+    the put overwrite zeroes the state cotangent on the design region).
+
+    Returns ``grad_fn(theta, state, params) -> (objective, grads,
+    final_state)``.
+    """
+    import os
+    if segment <= 0:
+        raise ValueError("segment must be positive")
+    lengths = [segment] * (niter // segment)
+    if niter % segment:
+        lengths.append(niter % segment)
+
+    def _seg_run(theta, fields, state_t, params, length):
+        state = state_t.replace(fields=fields)
+        state, params2 = design.put(theta, state, params)
+        run = make_objective_run(model, length, action, streaming, levels)
+        obj, final = run(state, params2)
+        return obj, final
+
+    @partial(jax.jit, static_argnames=("length",))
+    def seg_fwd(theta, fields, state_t, params, length):
+        obj, final = _seg_run(theta, fields, state_t, params, length)
+        return obj, final
+
+    @partial(jax.jit, static_argnames=("length",))
+    def seg_bwd(theta, fields, state_t, params, length, cot_fields):
+        def loss(th, fs):
+            obj, final = _seg_run(th, fs, state_t, params, length)
+            return obj, final.fields
+        (obj, _), vjp = jax.vjp(loss, theta, fields)
+        g_th, g_fs = vjp((jnp.ones_like(obj), cot_fields))
+        return obj, g_th, g_fs
+
+    def _park(k, fields):
+        if spill_dir is None:
+            return np.asarray(fields)
+        os.makedirs(spill_dir, exist_ok=True)
+        path = os.path.join(spill_dir, f"snap_{k:05d}.npy")
+        np.save(path, np.asarray(fields))
+        return path
+
+    def _fetch(parked):
+        if isinstance(parked, str):
+            return jnp.asarray(np.load(parked))
+        return jnp.asarray(parked)
+
+    def grad_fn(theta, state: LatticeState, params: SimParams):
+        # forward: park each segment's entry fields off-device
+        parked = []
+        fields = state.fields
+        it = state.iteration
+        iters = []
+        final = None
+        for k, length in enumerate(lengths):
+            parked.append(_park(k, fields))
+            iters.append(it)
+            _, final = seg_fwd(theta, fields, state.replace(iteration=it),
+                               params, length)
+            fields, it = final.fields, final.iteration
+        # final carries the LAST step's globals_ — same contract as
+        # make_unsteady_gradient's final_state
+        final_state = final if final is not None else state
+
+        # reverse: chain the fields cotangent across segment boundaries
+        cot = jnp.zeros_like(fields)
+        g_total = None
+        obj_total = 0.0
+        for k in reversed(range(len(lengths))):
+            fk = _fetch(parked[k])
+            obj_k, g_th, cot = seg_bwd(
+                theta, fk, state.replace(iteration=iters[k]), params,
+                lengths[k], cot)
+            obj_total += float(obj_k)
+            g_total = g_th if g_total is None else jax.tree_util.tree_map(
+                jnp.add, g_total, g_th)
+        if spill_dir is not None:
+            for p in parked:
+                if isinstance(p, str) and os.path.exists(p):
+                    os.remove(p)
+        return obj_total, g_total, final_state
+
+    return grad_fn
 
 
 def make_steady_gradient(model: Model, design, n_adjoint: int = 100,

@@ -280,9 +280,32 @@ class acOptimize(GenericAction):
             log.info(f"Optimize[{method}] eval {k}: objective={obj:.8g}")
 
         theta0 = design.get(s.lattice.state, s.lattice.params)
+        # Material="more|less": keep total design material above/below its
+        # starting value (reference nlopt_add_inequality_constraint with
+        # FMaterialMore/FMaterialLess, src/Handlers.cpp.Rt:1870-1886)
+        material = None
+        mat = self.node.get("Material")
+        if mat is not None:
+            if mat not in ("more", "less"):
+                raise ValueError('Material attribute in Optimize should '
+                                 'be "more" or "less"')
+            th0 = np.asarray(theta0)
+            if hasattr(design, "_mask"):
+                # the reference's parameter vector holds ONLY design
+                # nodes; our theta is the full plane, so the constraint
+                # counts (and the projection moves) design nodes only
+                mm = np.asarray(design._mask(s.lattice.state))
+                mask = np.broadcast_to(mm[None], th0.shape).astype(
+                    np.float64).ravel()
+            else:
+                mask = np.ones(th0.size)
+            m0 = float(th0.ravel() @ mask)
+            material = (mat, m0, mask)
+            log.info(f"Optimize material constraint: {mat} than {m0:.6g}")
         theta, obj = optimize(grad_fn, theta0, method=method,
                               max_eval=max_eval, step=step,
-                              bounds=_design_bounds(design), callback=cb)
+                              bounds=_design_bounds(design), callback=cb,
+                              material=material)
         s.lattice.state, s.lattice.params = design.put(
             theta, s.lattice.state, s.lattice.params)
         s.objective = obj

@@ -39,8 +39,11 @@ def _def() -> ModelDef:
     d.add_setting("InletTemperature", default=1.0)
     d.add_setting("InitTemperature", default=1.0)
     d.add_setting("FluidAlfa", default=1.0, comment="thermal diffusivity")
-    d.add_setting("HeaterTemperature", default=100.0,
-                  comment="pinned temperature of Heater nodes")
+    d.add_setting("HeaterTemperature", default=100.0, zonal=True,
+                  comment="pinned temperature of Heater nodes (zonal: "
+                          "Heaters in different settings zones can pin "
+                          "different temperatures; the reference hardcodes "
+                          "d=100, src/d2q9_heat/Dynamics.c.Rt:257)")
     d.add_global("OutFlux")
     d.add_node_type("Heater", "ADDITIONALS")
     return d
@@ -55,7 +58,7 @@ def _t_eq(T, ux, uy):
     return jnp.stack(out)
 
 
-def run(ctx: NodeCtx) -> jnp.ndarray:
+def run(ctx: NodeCtx, solid_adiabatic: bool = True) -> jnp.ndarray:
     f = ctx.group("f")
     fT = ctx.group("T")
     dt = f.dtype
@@ -71,9 +74,14 @@ def run(ctx: NodeCtx) -> jnp.ndarray:
         "EPressure": lambda f: _zou_he_x(f, den, "pressure", "E"),
     })
     # temperature boundaries: bounce-back at walls (adiabatic), fixed
-    # inlet temperature at velocity inlets
+    # inlet temperature at velocity inlets.  The conjugate model
+    # (d2q9_solid) passes solid_adiabatic=False: its Solid nodes CONDUCT
+    # (temperature streams through and collides with SolidAlfa there) —
+    # bouncing fT back would insulate the interface and break conjugate
+    # flux continuity.
+    t_wall = ("Wall", "Solid") if solid_adiabatic else ("Wall",)
     fT = ctx.boundary_case(fT, {
-        ("Wall", "Solid"): lambda t: t[jnp.asarray(OPP)],
+        t_wall: lambda t: t[jnp.asarray(OPP)],
         ("WVelocity", "EPressure"): lambda t: _t_eq(
             jnp.broadcast_to(t_in, t.shape[1:]).astype(dt),
             jnp.zeros(t.shape[1:], dt), jnp.zeros(t.shape[1:], dt)),

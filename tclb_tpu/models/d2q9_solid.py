@@ -30,7 +30,9 @@ def _def():
 
 
 def run(ctx: NodeCtx) -> jnp.ndarray:
-    out = d2q9_heat.run(ctx)   # write-set dict {"f": ..., "T": ...}
+    # solid_adiabatic=False: temperature conducts THROUGH Solid regions
+    # (that is the whole point of the conjugate model)
+    out = d2q9_heat.run(ctx, solid_adiabatic=False)
     # temperature additionally diffuses through Solid regions
     fT = out["T"]
     temp = jnp.sum(fT, axis=0)

@@ -116,12 +116,16 @@ def zlib_blocks(data: bytes, block: int = 1 << 15,
     """
     lib = get_lib()
     n = len(data)
-    nblocks = 1 if n == 0 else (n + block - 1) // block
+    if n == 0:
+        # header [0, block, 0]: zero blocks.  [1, block, 0] would declare
+        # one FULL uncompressed block per VTK convention while the stream
+        # decompresses to nothing — a strict reader would mis-size.
+        return np.array([0, block, 0], dtype=np.uint32).tobytes()
+    nblocks = (n + block - 1) // block
     if lib is not None:
         cap = 4 * (3 + nblocks) + nblocks * (block + block // 1000 + 64)
         out = np.empty(cap, dtype=np.uint8)
-        src = np.frombuffer(data, dtype=np.uint8) if n else \
-            np.empty(0, dtype=np.uint8)
+        src = np.frombuffer(data, dtype=np.uint8)
         total = lib.tclb_zlib_blocks(
             src.ctypes.data_as(ctypes.POINTER(ctypes.c_uint8)), n,
             block, level,
@@ -129,7 +133,7 @@ def zlib_blocks(data: bytes, block: int = 1 << 15,
         if total > 0:
             return out[:total].tobytes()
     # Python fallback, same layout
-    last = 0 if n == 0 else n - (nblocks - 1) * block
+    last = n - (nblocks - 1) * block
     chunks = [zlib.compress(data[b * block:(b + 1) * block], level)
               for b in range(nblocks)]
     head = np.array([nblocks, block, 0 if last == block else last]

@@ -137,7 +137,11 @@ def make_pallas_iterate(model: Model, shape, dtype=jnp.float32,
         aux_idx = synth_idx + [avgp_idx] + avgu_idx
     else:
         aux_idx = []
-    assert sorted(f_idx + aux_idx) == list(range(ns))
+    # aux planes are DMA'd in storage order and read back by position:
+    # the kernel's scra indexing assumes aux_idx IS ascending 27..ns-1,
+    # not merely covering it (a model registering avg/SynthT densities in
+    # a different order would silently read wrong planes)
+    assert f_idx + aux_idx == list(range(ns))
 
     def _is(flags, name):
         mask, val = nt[name]

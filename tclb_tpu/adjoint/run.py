@@ -217,21 +217,25 @@ def make_spilled_gradient(model: Model, design, niter: int, segment: int,
         final_state = final if final is not None else state
 
         # reverse: chain the fields cotangent across segment boundaries
-        cot = jnp.zeros_like(fields)
-        g_total = None
-        obj_total = 0.0
-        for k in reversed(range(len(lengths))):
-            fk = _fetch(parked[k])
-            obj_k, g_th, cot = seg_bwd(
-                theta, fk, state.replace(iteration=iters[k]), params,
-                lengths[k], cot)
-            obj_total += float(obj_k)
-            g_total = g_th if g_total is None else jax.tree_util.tree_map(
-                jnp.add, g_total, g_th)
-        if spill_dir is not None:
-            for p in parked:
-                if isinstance(p, str) and os.path.exists(p):
-                    os.remove(p)
+        try:
+            cot = jnp.zeros_like(fields)
+            g_total = None
+            obj_total = 0.0
+            for k in reversed(range(len(lengths))):
+                fk = _fetch(parked[k])
+                obj_k, g_th, cot = seg_bwd(
+                    theta, fk, state.replace(iteration=iters[k]), params,
+                    lengths[k], cot)
+                obj_total += float(obj_k)
+                g_total = g_th if g_total is None else \
+                    jax.tree_util.tree_map(jnp.add, g_total, g_th)
+        finally:
+            # spilled snapshots can be GBs each — never leak them, even
+            # when the reverse sweep dies (OOM/interrupt)
+            if spill_dir is not None:
+                for p in parked:
+                    if isinstance(p, str) and os.path.exists(p):
+                        os.remove(p)
         return obj_total, g_total, final_state
 
     return grad_fn

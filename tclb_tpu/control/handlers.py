@@ -815,6 +815,10 @@ _HANDLERS = {
     "CallPython": acCallPython,
     "Units": acNop,
     "Container": GenericContainer,
+    # the reference declares these two with empty Init bodies
+    # (src/Handlers.cpp.Rt:2454/2470) — same here: accepted, no-op
+    "FieldParameter": acNop,
+    "ControlParameter": acNop,
 }
 
 

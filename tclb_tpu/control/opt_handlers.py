@@ -289,17 +289,29 @@ class acOptimize(GenericAction):
             if mat not in ("more", "less"):
                 raise ValueError('Material attribute in Optimize should '
                                  'be "more" or "less"')
-            th0 = np.asarray(theta0)
-            if hasattr(design, "_mask"):
-                # the reference's parameter vector holds ONLY design
-                # nodes; our theta is the full plane, so the constraint
-                # counts (and the projection moves) design nodes only
-                mm = np.asarray(design._mask(s.lattice.state))
-                mask = np.broadcast_to(mm[None], th0.shape).astype(
-                    np.float64).ravel()
-            else:
-                mask = np.ones(th0.size)
-            m0 = float(th0.ravel() @ mask)
+            from jax.flatten_util import ravel_pytree
+
+            def _child_mask(d, th):
+                """Per-design material mask: the reference's parameter
+                vector holds ONLY design nodes; an InternalTopology theta
+                is the full plane, so the constraint counts (and the
+                projection moves) design nodes only — other designs'
+                entries are all real parameters."""
+                a = np.asarray(th)
+                if hasattr(d, "_mask"):
+                    mm = np.asarray(d._mask(s.lattice.state))
+                    return np.broadcast_to(mm[None], a.shape).astype(
+                        np.float64).ravel()
+                return np.ones(a.size)
+
+            children = design.designs if hasattr(design, "designs") \
+                else (design,)
+            thetas = theta0 if isinstance(theta0, tuple) else (theta0,)
+            mask = np.concatenate([_child_mask(d, th)
+                                   for d, th in zip(children, thetas)])
+            flat0 = np.asarray(ravel_pytree(theta0)[0], dtype=np.float64)
+            assert flat0.size == mask.size
+            m0 = float(flat0 @ mask)
             material = (mat, m0, mask)
             log.info(f"Optimize material constraint: {mat} than {m0:.6g}")
         theta, obj = optimize(grad_fn, theta0, method=method,

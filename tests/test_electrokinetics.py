@@ -6,9 +6,12 @@ src/d2q9_npe_guo/python/test_eof.py.
 
 import jax.numpy as jnp
 import numpy as np
+import pytest
 
 from tclb_tpu.core.lattice import Lattice
 from tclb_tpu.models import get_model
+
+pytestmark = pytest.mark.slow  # full-coverage job; the default lap runs the fast smoke suite
 
 
 def test_pb_debye_huckel():

@@ -87,7 +87,6 @@ GHIA_RE100_U = np.array([
     0.84123])
 
 
-@pytest.mark.slow
 def test_ghia_lid_cavity_re100():
     """d2q9_inc lid-driven cavity vs the published Ghia et al. (1982)
     Re=100 centerline profile.

@@ -89,7 +89,7 @@ def test_engine_dispatch_3d(monkeypatch):
 
     lat_f = build()
     lat_f.iterate(5)
-    assert lat_f._fast_name == "pallas_d3q27"
+    assert lat_f._fast_name == "pallas_d3q[d3q27_BGK]"
 
     monkeypatch.setenv("TCLB_FASTPATH", "0")
     lat_x = build()
@@ -199,6 +199,49 @@ def test_sharded_fallback_when_x_split(monkeypatch):
     lat.iterate(4)
     assert lat._fast_name is None
     assert np.isfinite(np.asarray(lat.state.fields)).all()
+
+
+def test_xml_log_stop_on_fast_path(monkeypatch, tmp_path):
+    """<Log>/<Stop> configs run on the fast path with globals matching the
+    XLA path (round-2 VERDICT item #3's done criterion): the hybrid's
+    trailing XLA step feeds every handler event real integrals."""
+    import csv
+    from tclb_tpu.control import run_config_string
+
+    xml = """<CLBConfig output="{out}/">
+    <Geometry nx="128" ny="32">
+        <MRT><Box/></MRT>
+        <WVelocity name="in"><Inlet/></WVelocity>
+        <EPressure name="out"><Outlet/></EPressure>
+        <Inlet nx="1" dx="2"><Box/></Inlet>
+        <Outlet nx="1" dx="-3"><Box/></Outlet>
+        <Wall mask="ALL"><Channel/></Wall>
+    </Geometry>
+    <Model><Params Velocity="0.03" nu="0.05"/></Model>
+    <Log Iterations="8"/>
+    <Stop InletFluxChange="1e-9" Times="3" Iterations="8"/>
+    <Solve Iterations="64"/>
+    </CLBConfig>"""
+
+    def rows(tag):
+        monkeypatch.setenv("TCLB_FASTPATH", tag)
+        out = tmp_path / tag
+        run_config_string(xml.format(out=out), get_model("d2q9"),
+                          dtype=jnp.float32, output=f"{out}/",
+                          conf_name="case")
+        with open(out / "case_Log.csv") as f:
+            return list(csv.DictReader(f))
+
+    r_xla = rows("0")
+    r_fast = rows("force")
+    assert len(r_fast) == len(r_xla)
+    for a, b in zip(r_xla, r_fast):
+        for col in ("InletFlux", "OutletFlux", "PressureLoss"):
+            va, vb = float(a[col]), float(b[col])
+            assert abs(va - vb) <= 1e-6 + 1e-4 * abs(va), \
+                f"iter {a['Iteration']}: {col} xla={va} fast={vb}"
+    # the monitors are nonzero (the Log rows carry real integrals)
+    assert any(abs(float(r["InletFlux"])) > 0 for r in r_fast)
 
 
 def test_single_step_uses_xla(monkeypatch):

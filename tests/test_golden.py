@@ -148,6 +148,25 @@ def _run_case(name, tmp_path):
     fields = np.asarray(solver.lattice.state.fields)
     row["FieldsL1"] = float(np.abs(fields).sum())
     row["FieldsSum"] = float(fields.sum())
+    if name == "heat_adj":
+        # the BASELINE heat_adj config exists to pin the GRADIENT (the
+        # reference runs <FDTest>, src/Handlers.cpp.Rt:1944): golden
+        # columns for the adjoint objective and its gradient
+        from tclb_tpu.adjoint import InternalTopology, make_unsteady_gradient
+        m = solver.model
+        lat = solver.lattice
+        lat.set_setting("HeatFluxInObj", 1.0)
+        lat.set_setting("MaterialInObj", 0.1)
+        design = InternalTopology(m)
+        grad_fn = make_unsteady_gradient(m, design, 20, levels=2)
+        theta0 = design.get(lat.state, lat.params)
+        obj, g, _ = grad_fn(theta0, lat.state, lat.params)
+        g = np.asarray(g)
+        row["AdjObjective"] = float(obj)
+        row["AdjGradL1"] = float(np.abs(g).sum())
+        # two point probes inside the design strip
+        row["AdjGradP1"] = float(g[0, 8, 12])
+        row["AdjGradP2"] = float(g[0, 10, 20])
     return row
 
 

@@ -8,9 +8,12 @@ set by Kappa/Beta, conserving mass.
 
 import jax.numpy as jnp
 import numpy as np
+import pytest
 
 from tclb_tpu.core.lattice import Lattice
 from tclb_tpu.models import get_model
+
+pytestmark = pytest.mark.slow  # full-coverage job; the default lap runs the fast smoke suite
 
 RL, RV = 1.0, 0.1
 

@@ -11,6 +11,8 @@ import pytest
 from tclb_tpu.core.lattice import Lattice
 from tclb_tpu.models import get_model, list_models
 
+pytestmark = pytest.mark.slow  # full-coverage job; the default lap runs the fast smoke suite
+
 HYDRO_2D = ["d2q9", "d2q9_SRT", "d2q9_cumulant", "d2q9_inc", "d2q9_les"]
 HYDRO_3D = ["d3q19", "d3q19_les", "d3q27", "d3q27_BGK", "d3q27_BGK_galcor",
             "d3q27_cumulant"]

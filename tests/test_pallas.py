@@ -16,6 +16,8 @@ from tclb_tpu.core.lattice import Lattice
 from tclb_tpu.models import get_model
 from tclb_tpu.ops import pallas_d2q9
 
+pytestmark = pytest.mark.slow  # full-coverage job; the default lap runs the fast smoke suite
+
 
 def _make_lattice(ny=64, nx=128, **settings):
     m = get_model("d2q9")

@@ -11,6 +11,8 @@ from tclb_tpu.core.lattice import Lattice
 from tclb_tpu.models import get_model
 from tclb_tpu.ops import pallas_d3q
 
+pytestmark = pytest.mark.slow  # full-coverage job; the default lap runs the fast smoke suite
+
 # (nz, ny, nx) — small for CPU interpret mode; on a real TPU backend the
 # lane dimension must be tile-aligned (nx % 128) or supports() rejects it
 # and the parity tests would test nothing
@@ -45,7 +47,9 @@ def test_supports():
     assert pallas_d3q.supports(m, SHAPE, jnp.float32)
     assert not pallas_d3q.supports(m, SHAPE, jnp.float64)
     assert not pallas_d3q.supports(m, (16, 64), jnp.float32)
-    assert not pallas_d3q.supports(get_model("d3q19"), SHAPE, jnp.float32)
+    assert pallas_d3q.supports(get_model("d3q19"), SHAPE, jnp.float32)
+    assert not pallas_d3q.supports(get_model("d3q19_heat"), SHAPE,
+                                   jnp.float32)
     assert pallas_d3q.supports(get_model("d3q27_cumulant"), SHAPE,
                                jnp.float32)
 
@@ -68,6 +72,40 @@ def test_bgk_forced_channel(name):
     lat.init()
     it = pallas_d3q.make_pallas_iterate(
         m, SHAPE, present=pallas_d3q.present_types(m, flags))
+    _compare(lat, it)
+
+
+@pytest.mark.parametrize("name,extra", [
+    ("d3q19", {"S_high": 1.0}),
+    ("d3q19", {"S_high": 1.3}),
+    ("d3q19_les", {"Smag": 0.17}),
+])
+def test_d3q19_forced_channel(name, extra):
+    """19-velocity family through the generalized z-slab kernel: MRT with
+    free high-moment rates and the Smagorinsky LES variant."""
+    m = get_model(name)
+    lat = Lattice(m, SHAPE, dtype=jnp.float32,
+                  settings={"nu": 0.05, "GravitationX": 1e-5, **extra})
+    flags = _channel_flags(m, SHAPE)
+    lat.set_flags(flags)
+    lat.init()
+    it = pallas_d3q.make_pallas_iterate(
+        m, SHAPE, present=pallas_d3q.present_types(m, flags))
+    _compare(lat, it)
+
+
+def test_d3q19_faces():
+    m = get_model("d3q19")
+    lat = Lattice(m, SHAPE, dtype=jnp.float32,
+                  settings={"nu": 0.05, "Velocity": 0.02})
+    flags = np.full(SHAPE, m.flag_for("MRT"), dtype=np.uint16)
+    flags[:, 0, :] = m.flag_for("Wall")
+    flags[:, -1, :] = m.flag_for("Wall")
+    flags[:, :, 0] = m.flag_for("WVelocity", "MRT")
+    flags[:, :, -1] = m.flag_for("EPressure", "MRT")
+    lat.set_flags(flags)
+    lat.init()
+    it = pallas_d3q.make_pallas_iterate(m, SHAPE)
     _compare(lat, it)
 
 

@@ -7,12 +7,15 @@ phase-field scheme guarantees by construction.
 """
 
 import numpy as np
+import pytest
 import jax.numpy as jnp
 
 from tclb_tpu.core.lattice import Lattice
 from tclb_tpu.models import get_model
 from tclb_tpu.models.d2q9 import E
 from tclb_tpu.ops import lbm
+
+pytestmark = pytest.mark.slow  # full-coverage job; the default lap runs the fast smoke suite
 
 W9 = lbm.weights(E)
 

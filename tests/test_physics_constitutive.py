@@ -151,6 +151,34 @@ def test_cumulant_matches_bgk_at_omega_one():
     assert err < 5e-5
 
 
+@pytest.mark.slow   # 6000 f64 XLA steps of a 3D model — physics-job fare
+def test_cumulant_channel_matches_analytic_poiseuille():
+    """d3q27_cumulant force-driven channel vs the analytic parabolic
+    profile — a quantitative external pin on the cumulant collision
+    (round-2 VERDICT Weak #9: the higher-order Isserlis closure had only
+    self-recorded goldens; the laminar channel's exact solution
+    u(y) = F (y-y0)(y1-y) / (2 nu) is closure-independent ground truth)."""
+    nz, ny, nx = 4, 24, 32
+    nu, force = 0.1, 1e-6
+    m = get_model("d3q27_cumulant")
+    lat = Lattice(m, (nz, ny, nx), dtype=jnp.float64,
+                  settings={"nu": nu, "ForceX": force})
+    flags = np.full((nz, ny, nx), m.flag_for("MRT"), dtype=np.uint16)
+    flags[:, 0, :] = m.flag_for("Wall")
+    flags[:, -1, :] = m.flag_for("Wall")
+    lat.set_flags(flags)
+    lat.init()
+    lat.iterate(6000)
+    ux = np.asarray(lat.get_quantity("U"))[0].mean(axis=(0, 2))
+    y = np.arange(ny, dtype=float)
+    y0, y1 = 0.5, ny - 1.5      # half-way bounce-back wall locations
+    analytic = force / (2.0 * nu) * (y - y0) * (y1 - y)
+    sel = slice(2, ny - 2)
+    err = np.abs(ux[sel] - analytic[sel]).max() / analytic.max()
+    assert err < 0.02, \
+        f"cumulant channel vs analytic Poiseuille: rel err {err:.4f}"
+
+
 def test_solid_conjugate_flux_continuity():
     """d2q9_solid: steady 1D conduction through a fluid|solid bilayer.
 

@@ -11,6 +11,8 @@ import pytest
 from tclb_tpu.core.lattice import Lattice
 from tclb_tpu.models import get_model
 
+pytestmark = pytest.mark.slow  # full-coverage job; the default lap runs the fast smoke suite
+
 
 def test_lbl_phase_separation():
     """A near-critical CS fluid with a density perturbation must separate

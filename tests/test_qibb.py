@@ -14,6 +14,8 @@ from tclb_tpu.core.lattice import Lattice
 from tclb_tpu.models import get_model
 from tclb_tpu.utils.geometry import cuts_from_sdf, sphere_sdf
 
+pytestmark = pytest.mark.slow  # full-coverage job; the default lap runs the fast smoke suite
+
 
 def _qibb_channel(delta, ny=16, niter=6000):
     """Channel along x; solid below y_w0 = 1 - delta and above

@@ -7,11 +7,14 @@ time-decorrelated fluctuations; averages must be correct across a reset
 """
 
 import numpy as np
+import pytest
 import jax.numpy as jnp
 
 from tclb_tpu.core.lattice import Lattice
 from tclb_tpu.models import get_model
 from tclb_tpu.utils.turbulence import SyntheticTurbulence
+
+pytestmark = pytest.mark.slow  # full-coverage job; the default lap runs the fast smoke suite
 
 
 def test_spectrum_energy_and_divergence():

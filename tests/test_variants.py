@@ -5,9 +5,12 @@ d3q19_heat_adj_prop (propagating design weight)."""
 
 import jax.numpy as jnp
 import numpy as np
+import pytest
 
 from tclb_tpu.core.lattice import Lattice
 from tclb_tpu.models import get_model
+
+pytestmark = pytest.mark.slow  # full-coverage job; the default lap runs the fast smoke suite
 
 
 def _shear_layer(name_mode, n=48, niter=1000):

@@ -8,9 +8,12 @@ when Y = 0, (b) show a flattened plug and unyielded nodes when Y > 0.
 
 import jax.numpy as jnp
 import numpy as np
+import pytest
 
 from tclb_tpu.core.lattice import Lattice
 from tclb_tpu.models import get_model
+
+pytestmark = pytest.mark.slow  # full-coverage job; the default lap runs the fast smoke suite
 
 
 def _channel(ny, yield_stress, g, niter=4000):

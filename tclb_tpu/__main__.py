@@ -29,6 +29,14 @@ def _cmd_run(args) -> int:
               "<CLBConfig>", file=sys.stderr)
         return 2
 
+    if args.distributed:
+        # multi-host: one process per host over DCN, same config
+        # everywhere (the reference's mpirun surface,
+        # src/main.cpp.Rt:178-183); jax.distributed wires the hosts into
+        # one global device set, and the global-view arrays/mesh span it
+        from tclb_tpu.parallel.multihost import initialize_distributed
+        initialize_distributed(args.distributed)
+
     import jax
     import jax.numpy as jnp
     from tclb_tpu.control.solver import run_config
@@ -51,8 +59,17 @@ def _cmd_run(args) -> int:
     if dtype is jnp.float64:
         jax.config.update("jax_enable_x64", True)
 
-    solver = run_config(args.case, model, mesh=mesh, dtype=dtype,
-                        output=args.output)
+    if args.profile:
+        # XLA/TPU trace for TensorBoard (the reference's per-event CUDA
+        # timing scaffolding + kernel stats, SURVEY §5 tracing)
+        jax.profiler.start_trace(args.profile)
+    try:
+        solver = run_config(args.case, model, mesh=mesh, dtype=dtype,
+                            output=args.output)
+    finally:
+        if args.profile:
+            jax.profiler.stop_trace()
+            print(f"profile trace written to {args.profile}")
     print(f"done: {solver.iter} iterations")
     return 0
 
@@ -104,6 +121,11 @@ def main(argv=None) -> int:
     r.add_argument("--mesh", default=None,
                    help="device mesh, e.g. 2x4 (z-y-x major)")
     r.add_argument("--precision", choices=("f32", "f64"), default="f32")
+    r.add_argument("--profile", default=None, metavar="DIR",
+                   help="write a TensorBoard trace of the run to DIR")
+    r.add_argument("--distributed", default=None, metavar="SPEC",
+                   help="multi-host init: 'auto' (TPU pod metadata) or "
+                   "coordinator:port,num_processes,process_id")
     r.set_defaults(fn=_cmd_run)
 
     ls = sub.add_parser("models", help="list the model catalogue")

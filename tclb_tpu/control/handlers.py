@@ -236,6 +236,13 @@ class acParams(Handler):
             if par in m.setting_index:
                 val = s.units.alt(raw)
                 s.lattice.set_setting(par, val, zone=zone)
+            else:
+                # the reference silently skips unknown names
+                # (src/Handlers.cpp.Rt:2512-2525 has no else branch) —
+                # a warning is kinder: a typo'd Params otherwise runs a
+                # silently different case
+                log.warning(f"Params: model {m.name} has no setting "
+                            f"{par!r} — ignored")
         return 0
 
 

@@ -50,6 +50,7 @@ _REGISTRY: dict[str, str] = {
     "d2q9_plate": "tclb_tpu.models.d2q9_plate",
     "d2q9_optimalMixing": "tclb_tpu.models.d2q9_optimal_mixing",
     "d2q9_solid": "tclb_tpu.models.d2q9_solid",
+    "d2q9_heat_conjugate": "tclb_tpu.models.d2q9_heat_conjugate",
     "d3q19_adj": "tclb_tpu.models.d3q19_adj",
     "d3q19_heat": "tclb_tpu.models.d3q19_heat",
     "d3q19_heat_adj": "tclb_tpu.models.d3q19_heat_adj",

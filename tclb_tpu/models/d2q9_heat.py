@@ -75,7 +75,8 @@ def run(ctx: NodeCtx, solid_adiabatic: bool = True) -> jnp.ndarray:
     })
     # temperature boundaries: bounce-back at walls (adiabatic), fixed
     # inlet temperature at velocity inlets.  The conjugate model
-    # (d2q9_solid) passes solid_adiabatic=False: its Solid nodes CONDUCT
+    # (d2q9_heat_conjugate) passes solid_adiabatic=False: its Solid
+    # nodes CONDUCT
     # (temperature streams through and collides with SolidAlfa there) —
     # bouncing fT back would insulate the interface and break conjugate
     # flux continuity.

@@ -1,0 +1,51 @@
+"""d2q9_heat_conjugate — conjugate solid/fluid heat transfer (EXTENSION).
+
+NOT a reference model: this framework extra extends ``d2q9_heat`` so the
+temperature lattice also collides inside Solid-tagged regions (pure
+diffusion with ``SolidAlfa``) while flow bounces back there — conjugate
+heat transfer through immersed solids.  (The reference model named
+``d2q9_solid`` is the dendritic-solidification model, implemented
+faithfully in :mod:`tclb_tpu.models.d2q9_solid`.)
+"""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+from tclb_tpu.core.lattice import NodeCtx
+from tclb_tpu.models import d2q9_heat
+from tclb_tpu.models.d2q9 import E
+from tclb_tpu.models.d2q9_heat import _t_eq
+from tclb_tpu.ops import lbm
+
+W = lbm.weights(E)
+
+
+def _def():
+    d = d2q9_heat._def()
+    d.name = "d2q9_heat_conjugate"
+    d.description = "conjugate solid/fluid heat transfer"
+    d.add_setting("SolidAlfa", default=0.05,
+                  comment="thermal diffusivity of the solid")
+    return d
+
+
+def run(ctx: NodeCtx) -> jnp.ndarray:
+    # solid_adiabatic=False: temperature conducts THROUGH Solid regions
+    # (that is the whole point of the conjugate model)
+    out = d2q9_heat.run(ctx, solid_adiabatic=False)
+    # temperature additionally diffuses through Solid regions
+    fT = out["T"]
+    temp = jnp.sum(fT, axis=0)
+    z = jnp.zeros_like(temp)
+    om_s = 1.0 / (3.0 * ctx.setting("SolidAlfa") + 0.5)
+    tc = fT + om_s * (_t_eq(temp, z, z) - fT)
+    solid = ctx.nt_is("Solid")[None]
+    return {**out, "T": jnp.where(solid, tc, fT)}
+
+
+def build():
+    return _def().finalize().bind(
+        run=run, init=d2q9_heat.init,
+        quantities={"Rho": d2q9_heat.get_rho, "T": d2q9_heat.get_t,
+                    "U": d2q9_heat.get_u})

@@ -1,50 +1,342 @@
-"""d2q9_solid — conjugate solid/fluid heat transfer.
+"""d2q9_solid — dendritic solidification with flow, heat and solute.
 
 Behavioral parity target: reference model ``d2q9_solid``
-(reference src/d2q9_solid/Dynamics.R, Dynamics.c.Rt): flow + temperature
-where the temperature lattice also collides inside Solid-tagged regions
-(pure diffusion with ``SolidAlfa``) while flow bounces back there —
-conjugate heat transfer through immersed solids.
+(reference src/d2q9_solid/Dynamics.R, Dynamics.c.Rt): THREE d2q9 MRT
+lattices — ``f`` (flow), ``g`` (temperature ``rhoT``), ``h`` (solute
+concentration ``C``) — coupled to a solid-fraction field ``fi_s`` and a
+solid-side concentration ``Cs``:
+
+* every non-conserved moment keeps ``1 - 1/(3 nu + 0.5)`` (all rates
+  equal, reference OMEGA vector at Dynamics.c.Rt:303-307), so each MRT
+  collision is algebraically a BGK relaxation with forcing applied by
+  re-evaluating the equilibrium at the shifted velocity;
+* the solute keep factor is blended per node with the solid fraction,
+  ``kC_eff = kC (1 - fi_s) - fi_s`` (Dynamics.c.Rt:351-352): a fully
+  solid node reflects the solute non-equilibrium;
+* interface nodes (any fully-solid 9-neighborhood member,
+  Dynamics.c.Rt:354-360) grow: ``dfi = (Cl_eq - C)/(Cl_eq (1 - k))``
+  clamped to ``1 - fi_s``, rejecting ``dC = C (1-k) dfi`` into the
+  liquid and banking ``Cs += C k dfi`` (:361-374);
+* the local equilibrium interface concentration carries the
+  Gibbs-Thomson curvature and 4-fold surface-energy anisotropy:
+  ``Cl_eq = C0 + ((T - Teq) + GT K (1 - 15 SA cos(4 (theta - Theta0))))
+  / m_L`` with K/theta from central differences of ``fi_s``
+  (getCl_eq, Dynamics.c.Rt:70-91);
+* flow feels the solid through ``a = (-2 ux fi_s, -2 uy fi_s +
+  Buoyancy (rhoT/rho - T0))`` (:376-377), the temperature/solute
+  equilibria ride the midpoint velocity ``u + a/2`` (:386-390);
+* ``ForceTemperature`` / ``ForceConcentration`` nodes pin ``rhoT`` /
+  ``C`` to the zonal settings; ``Seed`` nodes start fully solid
+  (Init, :381-394); Obj nodes accumulate ``fi_s`` into the Material
+  global (Run, :243).
 """
 
 from __future__ import annotations
 
+import numpy as np
 import jax.numpy as jnp
 
 from tclb_tpu.core.lattice import NodeCtx
-from tclb_tpu.models import d2q9_heat
-from tclb_tpu.models.d2q9 import E
-from tclb_tpu.models.d2q9_heat import _t_eq
+from tclb_tpu.core.registry import ModelDef
+from tclb_tpu.models.d2q9 import E, OPP, _zou_he_x
 from tclb_tpu.ops import lbm
 
 W = lbm.weights(E)
+PI = 3.14159265358979311600
 
 
-def _def():
-    d = d2q9_heat._def()
-    d.name = "d2q9_solid"
-    d.description = "conjugate solid/fluid heat transfer"
-    d.add_setting("SolidAlfa", default=0.05,
-                  comment="thermal diffusivity of the solid")
+def _def() -> ModelDef:
+    d = ModelDef("d2q9_solid", ndim=2,
+                 description="dendritic solidification: flow + heat + "
+                             "solute + solid fraction")
+    d.add_densities("f", E)
+    d.add_densities("g", E, group="g")
+    d.add_densities("h", E, group="h")
+    d.add_field("fi_s", dx=(-1, 1), dy=(-1, 1),
+                comment="solid fraction (solidification)")
+    d.add_density("Cs", comment="solid-side banked concentration")
+    d.add_quantity("Rho", unit="kg/m3")
+    d.add_quantity("T", unit="K")
+    d.add_quantity("C", unit="1")
+    d.add_quantity("Ct", unit="1")
+    d.add_quantity("Cl_eq", unit="1")
+    d.add_quantity("Solid", unit="1")
+    d.add_quantity("U", unit="m/s", vector=True)
+    d.add_quantity("K", unit="1/m")
+    d.add_quantity("Theta", unit="1")
+    d.add_setting("nu", default=1 / 6, comment="viscosity", unit="m2/s")
+    d.add_setting("FluidAlfa", default=1.0, unit="m2/s",
+                  comment="thermal diffusivity")
+    d.add_setting("SoluteDiffusion", default=1.0, unit="m2/s",
+                  comment="solute diffusion coefficient in liquid")
+    d.add_setting("C0", comment="concentration 0")
+    d.add_setting("T0", comment="temperature 0", unit="K")
+    d.add_setting("Teq", comment="equilibrium interface temperature",
+                  unit="K")
+    d.add_setting("Velocity", default=0.0, zonal=True, unit="m/s")
+    d.add_setting("Pressure", default=0.0, zonal=True, unit="Pa")
+    d.add_setting("Temperature", default=0.0, zonal=True, unit="K")
+    d.add_setting("Concentration", default=0.0, zonal=True)
+    d.add_setting("Theta0", default=0.0, zonal=True, unit="d",
+                  comment="angle of preferential growth")
+    d.add_setting("PartitionCoef", default=0.1,
+                  comment="partition coefficient k")
+    d.add_setting("LiquidusSlope", default=1.0, comment="liquidus slope m")
+    d.add_setting("GTCoef", default=0.0, unit="mK",
+                  comment="Gibbs-Thomson coefficient")
+    d.add_setting("SurfaceAnisotropy", default=0.0,
+                  comment="degree of surface-energy anisotropy")
+    d.add_setting("SoluteCapillar", default=0.0, unit="m",
+                  comment="solutal capillary length d_0")
+    d.add_setting("Buoyancy", default=0.0, unit="m/s2K",
+                  comment="Boussinesq buoyancy coefficient")
+    d.add_global("OutFlux")
+    d.add_global("Material")
+    d.add_node_type("Heater", "ADDITIONALS")
+    d.add_node_type("ForceTemperature", "ADDITIONALS")
+    d.add_node_type("ForceConcentration", "ADDITIONALS")
+    d.add_node_type("Seed", "ADDITIONALS")
+    d.add_node_type("Obj", "OBJECTIVE")
     return d
 
 
-def run(ctx: NodeCtx) -> jnp.ndarray:
-    # solid_adiabatic=False: temperature conducts THROUGH Solid regions
-    # (that is the whole point of the conjugate model)
-    out = d2q9_heat.run(ctx, solid_adiabatic=False)
-    # temperature additionally diffuses through Solid regions
-    fT = out["T"]
-    temp = jnp.sum(fT, axis=0)
-    z = jnp.zeros_like(temp)
-    om_s = 1.0 / (3.0 * ctx.setting("SolidAlfa") + 0.5)
-    tc = fT + om_s * (_t_eq(temp, z, z) - fT)
-    solid = ctx.nt_is("Solid")[None]
-    return {**out, "T": jnp.where(solid, tc, fT)}
+def _eq(rho, ux, uy):
+    """Standard quadratic MRT equilibrium (reference lib/feq.R MRT_feq)."""
+    return lbm.equilibrium(E, W, rho, (ux, uy))
+
+
+def _fi_derivs(ctx: NodeCtx):
+    """Central differences of the fi_s neighborhood (the reference's
+    LBM_FD=FALSE branch, Dynamics.c.Rt:41-46)."""
+    fi = {(dx, dy): ctx.load("fi_s", dx, dy)
+          for dx in (-1, 0, 1) for dy in (-1, 0, 1)}
+    dx_ = 0.5 * (fi[(1, 0)] - fi[(-1, 0)])
+    dy_ = 0.5 * (fi[(0, 1)] - fi[(0, -1)])
+    dxx = fi[(1, 0)] - 2.0 * fi[(0, 0)] + fi[(-1, 0)]
+    dyy = fi[(0, 1)] - 2.0 * fi[(0, 0)] + fi[(0, -1)]
+    dxy = 0.25 * (fi[(1, 1)] + fi[(-1, -1)]
+                  - fi[(1, -1)] - fi[(-1, 1)])
+    return fi, dx_, dy_, dxx, dyy, dxy
+
+
+def _angle(dx_, dy_):
+    """Gradient angle with quadrant corrections, 0 where the gradient
+    vanishes (reference getCl_eq/getTheta acos + sign fixes)."""
+    d2 = dx_ * dx_ + dy_ * dy_
+    safe = jnp.where(d2 > 0.0, d2, 1.0)
+    theta = jnp.arccos(jnp.sqrt(jnp.clip(dx_ * dx_ / safe, 0.0, 1.0)))
+    theta = jnp.where(dx_ < 0, PI - theta, theta)
+    theta = jnp.where(dy_ < 0, 2.0 * PI - theta, theta)
+    return jnp.where(d2 > 0.0, theta, jnp.zeros_like(d2))
+
+
+def _curvature_theta(dx_, dy_, dxx, dyy, dxy):
+    d2 = dx_ * dx_ + dy_ * dy_
+    safe = jnp.where(d2 > 0.0, d2, 1.0)
+    k = (2.0 * dx_ * dy_ * dxy - dx_ * dx_ * dyy
+         - dy_ * dy_ * dxx) * safe ** -1.5
+    return jnp.where(d2 > 0.0, k, jnp.zeros_like(d2)), _angle(dx_, dy_)
+
+
+def _cl_eq(ctx: NodeCtx, T):
+    """Equilibrium interface concentration with Gibbs-Thomson curvature
+    undercooling + 4-fold anisotropy (reference getCl_eq)."""
+    _, dx_, dy_, dxx, dyy, dxy = _fi_derivs(ctx)
+    k, theta = _curvature_theta(dx_, dy_, dxx, dyy, dxy)
+    aniso = 1.0 - 15.0 * ctx.setting("SurfaceAnisotropy") * jnp.cos(
+        4.0 * (theta - ctx.setting("Theta0")))
+    return ctx.setting("C0") + ((T - ctx.setting("Teq"))
+                                + ctx.setting("GTCoef") * k * aniso
+                                ) / ctx.setting("LiquidusSlope")
+
+
+def _refill_w(q, target):
+    """West-face equilibrium refill of an AD lattice: populations with
+    e_x=+1 rebuilt from the target scalar (reference WVelocity/WPressure
+    g/h blocks: rho = 6 (target - sum_{ex<=0}); g_i = w_i rho)."""
+    keep = sum(q[i] for i in range(9) if E[i, 0] <= 0)
+    s = 6.0 * (target - keep)
+    return jnp.stack([jnp.asarray(float(W[i]), q.dtype) * s
+                      if E[i, 0] == 1 else q[i] for i in range(9)])
+
+
+def _refill_e(q):
+    """East-face outflow refill: e_x=-1 populations from the e_x=+1 ones
+    (reference EPressure/EVelocity g/h blocks)."""
+    s = 6.0 * sum(q[i] for i in range(9) if E[i, 0] == 1)
+    return jnp.stack([jnp.asarray(float(W[i]), q.dtype) * s
+                      if E[i, 0] == -1 else q[i] for i in range(9)])
+
+
+def run(ctx: NodeCtx) -> dict:
+    f = ctx.group("f")
+    g = ctx.group("g")
+    h = ctx.group("h")
+    fi_s = ctx.density("fi_s")
+    cs = ctx.density("Cs")
+    dt = f.dtype
+    opp = jnp.asarray(OPP)
+    vel = ctx.setting("Velocity")
+    den = 1.0 + ctx.setting("Pressure") / 3.0
+
+    # ---- boundaries (reference Run switch, Dynamics.c.Rt:243-270) ----- #
+    bb = ctx.nt_is("Wall") | ctx.nt_is("Solid")
+    f = jnp.where(bb[None], f[opp], f)
+    g = jnp.where(bb[None], g[opp], g)
+    h = jnp.where(bb[None], h[opp], h)
+    t_in = jnp.broadcast_to(ctx.setting("Temperature"),
+                            f.shape[1:]).astype(dt)
+    c_in = jnp.broadcast_to(ctx.setting("Concentration"),
+                            f.shape[1:]).astype(dt)
+    for name, ff, gg, hh in (
+            ("WVelocity", _zou_he_x(f, vel, "velocity", "W"),
+             _refill_w(g, t_in), _refill_w(h, c_in)),
+            ("WPressure", _zou_he_x(f, den, "pressure", "W"),
+             _refill_w(g, t_in), _refill_w(h, c_in)),
+            ("EVelocity", _zou_he_x(f, vel, "velocity", "E"),
+             _refill_e(g), _refill_e(h)),
+            ("EPressure", _zou_he_x(f, 1.0, "pressure", "E"),
+             _refill_e(g), _refill_e(h))):
+        m = ctx.nt_is(name)
+        f = jnp.where(m[None], ff, f)
+        g = jnp.where(m[None], gg, g)
+        h = jnp.where(m[None], hh, h)
+
+    # ---- macroscopic fields ------------------------------------------- #
+    rho = jnp.sum(f, axis=0)
+    ux = jnp.tensordot(jnp.asarray(E[:, 0], dt), f, axes=1) / rho
+    uy = jnp.tensordot(jnp.asarray(E[:, 1], dt), f, axes=1) / rho
+    rhoT = jnp.sum(g, axis=0)
+    c = jnp.sum(h, axis=0)
+
+    ctx.add_global("Material", fi_s, where=ctx.nt_is("Obj"))
+
+    # Dirichlet forcing (reference Q / dC, Dynamics.c.Rt:341-346)
+    q_force = jnp.where(ctx.nt_is("ForceTemperature"),
+                        ctx.setting("Temperature") - rhoT, 0.0)
+    dc = jnp.where(ctx.nt_is("ForceConcentration"),
+                   ctx.setting("Concentration") - c, 0.0)
+
+    # keep factors (reference omega = 1 - 1/(3 nu + 0.5) etc.)
+    kf = 1.0 - 1.0 / (3.0 * ctx.setting("nu") + 0.5)
+    kt = 1.0 - 1.0 / (3.0 * ctx.setting("FluidAlfa") + 0.5)
+    kc0 = 1.0 - 1.0 / (3.0 * ctx.setting("SoluteDiffusion") + 0.5)
+    kc = (-kc0 - 1.0) * fi_s + kc0   # solid nodes reflect solute
+
+    # ---- interface growth (Dynamics.c.Rt:354-374) --------------------- #
+    fi_nb, *_ = _fi_derivs(ctx)
+    all_liquid = None
+    for off, plane in fi_nb.items():
+        cond = plane < 1.0
+        all_liquid = cond if all_liquid is None else (all_liquid & cond)
+    interface = ~all_liquid
+    cl_eq = _cl_eq(ctx, rhoT / rho)
+    pk = ctx.setting("PartitionCoef")
+    grow = interface & (cl_eq > c)
+    dfi_raw = (cl_eq - c) / (cl_eq * (1.0 - pk))
+    dfi = jnp.where(grow, jnp.minimum(dfi_raw, 1.0 - fi_s), 0.0)
+    fi_new = fi_s + dfi
+    # the reference OVERWRITES dC at growing nodes (:369) — mirror that
+    dc = jnp.where(grow, c * (1.0 - pk) * dfi, dc)
+    cs_new = cs + c * pk * dfi
+
+    # ---- forcing accelerations (Dynamics.c.Rt:376-377) ---------------- #
+    ax = -2.0 * ux * fi_new
+    ay = -2.0 * uy * fi_new + ctx.setting("Buoyancy") * (
+        rhoT / rho - ctx.setting("T0"))
+
+    # ---- collisions: keep*(x - xeq(u)) + xeq(shifted) ----------------- #
+    coll = ctx.nt_in_group("COLLISION")
+    feq = _eq(rho, ux, uy)
+    fc = kf * (f - feq) + _eq(rho, ux + ax, uy + ay)
+    uxm, uym = ux + 0.5 * ax, uy + 0.5 * ay
+    geq = _eq(rhoT, ux, uy)
+    gc = kt * (g - geq) + _eq(rhoT + q_force, uxm, uym)
+    heq = _eq(c, ux, uy)
+    hc = kc[None] * (h - heq) + _eq(c + dc, uxm, uym)
+
+    f = jnp.where(coll[None], fc, f)
+    g = jnp.where(coll[None], gc, g)
+    h = jnp.where(coll[None], hc, h)
+    fi_out = jnp.where(coll, fi_new, fi_s)
+    cs_out = jnp.where(coll, cs_new, cs)
+    return ctx.store({"f": f, "g": g, "h": h,
+                      "fi_s": fi_out, "Cs": cs_out})
+
+
+def init(ctx: NodeCtx) -> dict:
+    shape = ctx.flags.shape
+    dt = ctx._fields.dtype
+    rho = jnp.ones(shape, dt)
+    ux = jnp.broadcast_to(ctx.setting("Velocity"), shape).astype(dt)
+    uy = jnp.zeros(shape, dt)
+    rhoT = jnp.broadcast_to(ctx.setting("Temperature"), shape).astype(dt)
+    c = jnp.broadcast_to(ctx.setting("Concentration"), shape).astype(dt)
+    seed = ctx.nt_is("Seed")
+    fi = jnp.where(seed, 1.0, 0.0).astype(dt)
+    cs = jnp.where(seed, c * ctx.setting("PartitionCoef"), 0.0).astype(dt)
+    return ctx.store({"f": _eq(rho, ux, uy), "g": _eq(rhoT, ux, uy),
+                      "h": _eq(c, ux, uy), "fi_s": fi, "Cs": cs})
+
+
+def get_u(ctx: NodeCtx) -> jnp.ndarray:
+    f = ctx.group("f")
+    dt = f.dtype
+    rho = jnp.sum(f, axis=0)
+    ux = jnp.tensordot(jnp.asarray(E[:, 0], dt), f, axes=1) / rho
+    uy = jnp.tensordot(jnp.asarray(E[:, 1], dt), f, axes=1) / rho
+    return jnp.stack([ux, uy, jnp.zeros_like(ux)])
+
+
+def get_theta(ctx: NodeCtx) -> jnp.ndarray:
+    """Growth angle from the ISOTROPIC (weighted) fi_s gradient — the
+    reference getTheta uses the LBM_FD D1 form (Dynamics.c.Rt:117-131),
+    unlike getCl_eq's central differences."""
+    dt = ctx._fields.dtype
+    over_c2 = 3.0
+    dx_ = dy_ = None
+    for i in range(9):
+        ex, ey = int(E[i, 0]), int(E[i, 1])
+        if ex == 0 and ey == 0:
+            continue
+        p = ctx.load("fi_s", ex, ey) * jnp.asarray(float(W[i]), dt)
+        tx = p * ex if ex else None
+        ty = p * ey if ey else None
+        if tx is not None:
+            dx_ = tx if dx_ is None else dx_ + tx
+        if ty is not None:
+            dy_ = ty if dy_ is None else dy_ + ty
+    return _angle(dx_ * over_c2, dy_ * over_c2)
 
 
 def build():
+    def get_rho(ctx):
+        return jnp.sum(ctx.group("f"), axis=0)
+
+    def get_t(ctx):
+        return jnp.sum(ctx.group("g"), axis=0)
+
+    def get_c(ctx):
+        return jnp.sum(ctx.group("h"), axis=0)
+
+    def get_ct(ctx):
+        return (jnp.sum(ctx.group("h"), axis=0)
+                * (1.0 - ctx.density("fi_s")) + ctx.density("Cs"))
+
+    def get_solid(ctx):
+        return ctx.density("fi_s")
+
+    def get_cl_eq(ctx):
+        rho = jnp.sum(ctx.group("f"), axis=0)
+        return _cl_eq(ctx, jnp.sum(ctx.group("g"), axis=0) / rho)
+
+    def get_k(ctx):
+        _, dx_, dy_, dxx, dyy, dxy = _fi_derivs(ctx)
+        k, _ = _curvature_theta(dx_, dy_, dxx, dyy, dxy)
+        return k
+
     return _def().finalize().bind(
-        run=run, init=d2q9_heat.init,
-        quantities={"Rho": d2q9_heat.get_rho, "T": d2q9_heat.get_t,
-                    "U": d2q9_heat.get_u})
+        run=run, init=init,
+        quantities={"Rho": get_rho, "T": get_t, "C": get_c, "Ct": get_ct,
+                    "Cl_eq": get_cl_eq, "Solid": get_solid, "U": get_u,
+                    "K": get_k, "Theta": get_theta})

@@ -1,0 +1,52 @@
+"""Multi-host initialization: the TPU-pod counterpart of the reference's
+MPI startup (reference src/main.cpp.Rt:178-216: MPI_Init, rank/size, node
+table, per-rank GPU binding).
+
+On TPU pods each host runs one identical process; ``jax.distributed``
+wires them into a single JAX runtime whose ``jax.devices()`` spans ALL
+chips, global-view arrays shard transparently, and the halo ``ppermute``s
+ride ICI within a slice / DCN across slices.  Nothing else in the
+framework changes — the mesh in :mod:`tclb_tpu.parallel.mesh` simply gets
+more devices, which is the whole point of designing against
+``jax.sharding`` instead of translating the reference's per-rank MPI
+bookkeeping.
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+
+def initialize_distributed(spec: Optional[str] = "auto") -> None:
+    """Initialize the multi-host runtime.
+
+    ``spec``:
+    * ``"auto"`` / ``None`` — rely on the environment (TPU pod metadata,
+      or the ``JAX_COORDINATOR_ADDRESS``/``JAX_NUM_PROCESSES``/
+      ``JAX_PROCESS_ID`` variables a launcher sets);
+    * ``"host:port,num_processes,process_id"`` — explicit wiring, the
+      moral equivalent of an mpirun rank file.
+
+    Must run before any other JAX API initializes the backend.
+    """
+    import jax
+
+    if spec in (None, "", "auto"):
+        jax.distributed.initialize()
+        return
+    parts = spec.split(",")
+    if len(parts) != 3:
+        raise ValueError(
+            "distributed spec must be 'auto' or "
+            "'coordinator:port,num_processes,process_id'")
+    jax.distributed.initialize(
+        coordinator_address=parts[0],
+        num_processes=int(parts[1]),
+        process_id=int(parts[2]))
+
+
+def is_main_process() -> bool:
+    """True on the process that should own rank-0 duties (file output,
+    console logging) — the reference's ``InitPrint`` root filter."""
+    import jax
+    return jax.process_index() == 0

@@ -118,7 +118,7 @@ HEAT_ADJ = """<?xml version="1.0"?>
         <DesignSpace><Box dx="8" nx="16"/></DesignSpace>
     </Geometry>
     <Model>
-        <Params Velocity="0.02" nu="0.05"/>
+        <Params InletVelocity="0.02" nu="0.05"/>
         <Params InletTemperature="1" InitTemperature="0"/>
         <Params FluidAlfa="0.05" SolidAlfa="0.005"/>
     </Model>

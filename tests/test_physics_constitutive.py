@@ -8,10 +8,14 @@ distinguishing PHYSICS of the model, not just stability.
 * d2q9_cumulant — at omega = omega_bulk = 1 every cumulant relaxes fully
   to equilibrium, which coincides with BGK at omega=1 up to the O(u^3)
   difference between the factorized-Maxwellian and quadratic equilibria.
-* d2q9_solid — conjugate heat transfer: at steady state the temperature
-  is continuous across the fluid/solid interface and the conductive flux
-  alfa * dT/dx is continuous, so the slope ratio equals the inverse
-  diffusivity ratio (reference src/d2q9_solid/Dynamics.c.Rt semantics).
+* d2q9_heat_conjugate — conjugate heat transfer (framework extension):
+  at steady state the temperature is continuous across the fluid/solid
+  interface and the conductive flux alfa * dT/dx is continuous, so the
+  slope ratio equals the inverse diffusivity ratio.
+* d2q9_solid — dendritic solidification (reference
+  src/d2q9_solid/Dynamics.c.Rt): a seed in an undercooled melt grows,
+  rejects solute at the interface (partition coefficient), and the
+  curvature getter recovers 1/R on a painted disc.
 """
 
 import jax.numpy as jnp
@@ -151,6 +155,72 @@ def test_cumulant_matches_bgk_at_omega_one():
     assert err < 5e-5
 
 
+def test_solidification_seed_growth():
+    """d2q9_solid dendritic solidification: a Seed in an undercooled melt
+    (Cl_eq > C via a negative liquidus slope) grows outward, rejects
+    solute at the interface (C rises above the far-field value by the
+    partition coefficient), and banks Cs only where solid — the
+    reference's interface update op-for-op
+    (src/d2q9_solid/Dynamics.c.Rt:354-374)."""
+    n = 48
+    m = get_model("d2q9_solid")
+    lat = Lattice(m, (n, n), dtype=jnp.float64, settings={
+        "nu": 0.1, "FluidAlfa": 0.05, "SoluteDiffusion": 0.05,
+        "C0": 0.5, "Concentration": 0.5, "Temperature": 0.95,
+        "T0": 0.95, "Teq": 1.0, "LiquidusSlope": -1.0,
+        "PartitionCoef": 0.1})
+    flags = np.full((n, n), m.flag_for("MRT"), dtype=np.uint16)
+    flags[n // 2 - 1:n // 2 + 1, n // 2 - 1:n // 2 + 1] = \
+        m.flag_for("MRT", "Seed")
+    lat.set_flags(flags)
+    lat.init()
+    fi0 = float(np.asarray(lat.get_quantity("Solid")).sum())
+    assert fi0 == 4.0                      # the Seed starts fully solid
+    sums = [fi0]
+    for _ in range(4):
+        lat.iterate(15)
+        fi = np.asarray(lat.get_quantity("Solid"))
+        assert np.isfinite(fi).all()
+        assert fi.min() >= 0.0 and fi.max() <= 1.0 + 1e-12
+        sums.append(float(fi.sum()))
+    assert all(b > a for a, b in zip(sums, sums[1:])), \
+        f"solid fraction must grow monotonically: {sums}"
+    # growth decelerates as rejected solute raises C toward Cl_eq — the
+    # physically expected diffusion-limited slowdown
+    assert sums[-1] > 2 * fi0, f"growth too slow: {sums}"
+    c = np.asarray(lat.get_quantity("C"))
+    assert c.max() > 0.5 + 1e-4, "no solute rejection at the interface"
+    cs = np.asarray(lat.state.fields[m.storage_index["Cs"]])
+    assert cs.max() > 0.0
+    assert abs(cs[0, 0]) < 1e-12           # far field: no banked solute
+    # growth is centered on the seed (roughly isotropic with SA=0)
+    com_y = (fi * np.arange(n)[:, None]).sum() / fi.sum()
+    com_x = (fi * np.arange(n)[None, :]).sum() / fi.sum()
+    assert abs(com_y - (n / 2 - 0.5)) < 1.0
+    assert abs(com_x - (n / 2 - 0.5)) < 1.0
+
+
+def test_solidification_curvature_getter():
+    """The K quantity recovers ~1/R on a painted solid disc (the
+    Gibbs-Thomson undercooling input, reference getCl_eq/getK)."""
+    n, r = 48, 10.0
+    m = get_model("d2q9_solid")
+    lat = Lattice(m, (n, n), dtype=jnp.float64,
+                  settings={"nu": 0.1, "LiquidusSlope": -1.0})
+    lat.set_flags(np.full((n, n), m.flag_for("MRT"), dtype=np.uint16))
+    lat.init()
+    y, x = np.meshgrid(np.arange(n), np.arange(n), indexing="ij")
+    d = np.sqrt((y - n / 2) ** 2 + (x - n / 2) ** 2)
+    # smooth solid disc (a hard 0/1 disc has staircase curvature)
+    fi = np.clip((r + 1.5 - d) / 3.0, 0.0, 1.0)
+    lat.set_density("fi_s", fi)
+    k = np.asarray(lat.get_quantity("K"))
+    ring = (np.abs(d - r) < 1.0)
+    k_mean = float(np.abs(k[ring]).mean())
+    assert abs(k_mean - 1.0 / r) / (1.0 / r) < 0.3, \
+        f"disc curvature {k_mean:.4f} vs 1/R = {1.0 / r:.4f}"
+
+
 @pytest.mark.slow   # 6000 f64 XLA steps of a 3D model — physics-job fare
 def test_cumulant_channel_matches_analytic_poiseuille():
     """d3q27_cumulant force-driven channel vs the analytic parabolic
@@ -180,7 +250,7 @@ def test_cumulant_channel_matches_analytic_poiseuille():
 
 
 def test_solid_conjugate_flux_continuity():
-    """d2q9_solid: steady 1D conduction through a fluid|solid bilayer.
+    """d2q9_heat_conjugate: steady 1D conduction, fluid|solid bilayer.
 
     Heaters pin T_hot at x=0 (zone 0) and T_cold at x=n-1 (zone 1,
     zonal HeaterTemperature); fluid occupies the left half (FluidAlfa),
@@ -190,7 +260,7 @@ def test_solid_conjugate_flux_continuity():
     SolidAlfa/FluidAlfa."""
     n, h = 64, 8
     alfa_f, alfa_s = 0.3, 0.05
-    m = get_model("d2q9_solid")
+    m = get_model("d2q9_heat_conjugate")
     lat = Lattice(m, (h, n), dtype=jnp.float64,
                   settings={"omega": 1.0, "InletVelocity": 0.0,
                             "FluidAlfa": alfa_f, "SolidAlfa": alfa_s,

@@ -115,12 +115,33 @@ def bench_d2q9(results):
         results["pallas_mlups"] = round(mlups_pallas, 1)
         results["pallas_fused2_mlups"] = round(mlups_fused, 1)
 
+    # sharded fast path on a 1-device mesh: measures the per-step
+    # ppermute + shard_map machinery overhead vs the single-device
+    # kernels (multi-chip hardware is not available here; the identity
+    # exchange is the overhead floor a real mesh adds per step)
+    mlups_sharded = None
+    try:
+        from tclb_tpu.parallel.mesh import make_mesh
+        mesh1 = make_mesh((ny, nx), devices=jax.devices()[:1],
+                          decomposition={"y": 1, "x": 1})
+        lat_s = Lattice(m, (ny, nx), dtype=jnp.float32,
+                        settings={"nu": 0.02, "Velocity": 0.01},
+                        mesh=mesh1)
+        lat_s.set_flags(flags)
+        lat_s.init()
+        mlups_sharded = timed_solver(lat_s, iters * 2)
+        results["sharded_1dev_mlups"] = round(mlups_sharded, 1)
+        results["sharded_1dev_engine"] = lat_s._fast_name or "xla"
+    except Exception as e:      # never let the overhead probe kill bench
+        results["sharded_1dev_error"] = str(e)[:200]
+
     bytes_per_update = 2 * m.n_storage * 4 + 2
     return (ny, nx), bytes_per_update, [
         ("solver", mlups_solver, 2.0),   # hybrid includes the fused kernel
         ("xla", mlups_xla, 1.0),
         ("pallas", mlups_pallas, 1.0),
-        ("pallas_fused2", mlups_fused, 2.0)]
+        ("pallas_fused2", mlups_fused, 2.0),
+        ("sharded_1dev", mlups_sharded, 2.0)]
 
 
 def bench_d3q27(results):

@@ -67,6 +67,14 @@ class Solver:
         tag = f"_{name}_{self.iter:08d}" if with_iter else f"_{name}"
         return f"{base}{tag}.{ext}"
 
+    @property
+    def is_main(self) -> bool:
+        """Rank-0 duty filter for file output under --distributed (the
+        reference's InitPrint root filter, src/main.cpp.Rt:186): every
+        host runs the identical handler tree, only one writes files."""
+        import jax
+        return jax.process_index() == 0
+
     # -- setup --------------------------------------------------------------- #
 
     def set_size(self, shape: tuple[int, ...]) -> None:
@@ -184,6 +192,8 @@ class Solver:
         return row
 
     def write_log(self) -> None:
+        if not self.is_main:
+            return
         if self.log is None:
             self.log = CSVLog(self.out_path("Log", "csv", with_iter=False))
         self.log.write(self.log_row())
@@ -222,7 +232,9 @@ class Solver:
         return path
 
     def write_vtk(self, what: Optional[set[str]] = None,
-                  compress: bool = False) -> str:
+                  compress: bool = False) -> Optional[str]:
+        if not self.is_main:
+            return None
         from tclb_tpu.utils.vtk import write_pvti, write_vti
         arrays = self.quantity_arrays(what)
         flags = np.asarray(self.lattice.state.flags)
@@ -240,6 +252,8 @@ class Solver:
         """Per-quantity text dumps (reference cbTXT/writeTXT gzip path,
         src/Solver.cpp.Rt:228-260)."""
         import gzip
+        if not self.is_main:
+            return []
         paths = []
         for name, arr in self.quantity_arrays(what).items():
             p = self.out_path(f"TXT_{name}", "txt.gz" if gzip_out else "txt")
@@ -252,9 +266,11 @@ class Solver:
             paths.append(p)
         return paths
 
-    def write_bin(self) -> str:
+    def write_bin(self) -> Optional[str]:
         """Raw binary dump of all storage planes (reference cbBIN,
         src/Handlers.cpp.Rt:1011-1027)."""
+        if not self.is_main:
+            return None
         p = self.out_path("BIN", "npz")
         self.lattice.save(p[:-4])
         return p

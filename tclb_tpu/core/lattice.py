@@ -152,7 +152,9 @@ class NodeCtx:
     def __init__(self, model: Model, fields: jnp.ndarray, raw: jnp.ndarray,
                  flags: jnp.ndarray, params: SimParams,
                  loader: Optional[Callable] = None,
-                 iteration: Any = 0, avg_start: Any = 0):
+                 iteration: Any = 0, avg_start: Any = 0,
+                 present: Optional[set] = None,
+                 compute_globals: bool = True):
         self.model = model
         self._fields = fields      # pulled (streamed) storage
         self._raw = raw            # un-streamed storage (for Field loads)
@@ -163,6 +165,12 @@ class NodeCtx:
         self.avg_start = avg_start
         self._globals: dict[str, jnp.ndarray] = {}
         self._zone_ids = None
+        # static specialization knobs (the reference compiles its kernels
+        # per model boundary set and per Globals mode, src/cuda.cu.Rt:81):
+        # `present` skips boundary cases whose node types are not painted;
+        # `compute_globals=False` is the NoGlobals kernel flavor
+        self.present = present
+        self.compute_globals = compute_globals
 
     def avg_samples(self) -> jnp.ndarray:
         """Iterations accumulated into the running averages since the last
@@ -275,6 +283,10 @@ class NodeCtx:
         for names, fn in cases.items():
             if isinstance(names, str):
                 names = (names,)
+            if self.present is not None:
+                names = tuple(n for n in names if n in self.present)
+                if not names:
+                    continue   # type not painted: skip the whole case
             mask = self.nt_is(names[0])
             for n in names[1:]:
                 mask = mask | self.nt_is(n)
@@ -288,6 +300,8 @@ class NodeCtx:
         """Accumulate a per-node contribution to a Global (reference
         ``AddTo<Global>`` + atomic reduction, src/cuda.cu.Rt:130-202).
         ``where`` masks contributing nodes (e.g. objective node types)."""
+        if not self.compute_globals:
+            return
         if where is not None:
             plane = jnp.where(where, plane, jnp.zeros_like(plane))
         if name in self._globals:
@@ -312,13 +326,20 @@ class NodeCtx:
 
 
 def make_stage_step(model: Model, stage_name: str,
-                    streaming: Optional[Streaming] = None) -> Callable:
+                    streaming: Optional[Streaming] = None,
+                    present: Optional[set] = None,
+                    compute_globals: bool = True) -> Callable:
     """Build the pure step function for one stage (the reference compiles a
     ``Node_Run`` kernel per stage, src/cuda.cu.Rt:209-283; we trace one).
 
     ``streaming`` injects the streaming strategy (pull + neighbor loads):
     default is the global periodic roll; the sharded engine
-    (parallel/halo.py) injects a halo-exchange strategy instead."""
+    (parallel/halo.py) injects a halo-exchange strategy instead.
+
+    ``present``/``compute_globals`` specialize the trace the way the
+    reference specializes its kernel zoo (per boundary set and per
+    Globals mode): absent node types skip their full-lattice boundary
+    case, and the NoGlobals flavor skips every reduction."""
     stage = model.stages[stage_name]
     fn = model.stage_fns[stage.main]
     if fn is None:
@@ -342,7 +363,8 @@ def make_stage_step(model: Model, stage_name: str,
         pulled = streaming.pull(raw) if stage.load_densities else raw
         ctx = NodeCtx(model, pulled, raw, state.flags, params,
                       loader=streaming.make_loader(raw),
-                      iteration=state.iteration)
+                      iteration=state.iteration,
+                      present=present, compute_globals=compute_globals)
         new_fields = fn(ctx)
         # A stage returns its write set as a dict (group or plane name ->
         # stack/plane): only the named planes are saved, everything else
@@ -376,6 +398,10 @@ def make_stage_step(model: Model, stage_name: str,
         # the objectives the Run stage just computed.  SUM globals add;
         # MAX globals combine with max (the reference's atomicMax path,
         # src/cross.h:104-132) — adding per-stage maxima would double-count.
+        if not compute_globals:
+            return LatticeState(
+                fields=new_fields, flags=state.flags,
+                globals_=state.globals_, iteration=state.iteration)
         stage_globals = ctx.reduce_globals()
         max_rows = [i for i, g in enumerate(model.globals_) if g.op == "MAX"]
         if max_rows:
@@ -397,11 +423,14 @@ def make_stage_step(model: Model, stage_name: str,
 
 
 def make_action_step(model: Model, action: str = "Iteration",
-                     streaming: Optional[Streaming] = None) -> Callable:
+                     streaming: Optional[Streaming] = None,
+                     present: Optional[set] = None,
+                     compute_globals: bool = True) -> Callable:
     """Compose an action's stages into one step (reference Actions,
     src/conf.R:339 + the per-stage loop in Lattice::Iteration,
     src/Lattice.cu.Rt:414-457)."""
-    steps = [make_stage_step(model, s, streaming)
+    steps = [make_stage_step(model, s, streaming, present=present,
+                             compute_globals=compute_globals)
              for s in model.actions[action]]
     # one action == one lattice iteration (when it streams at all):
     # the counter advances once per action, not per stage
@@ -409,7 +438,8 @@ def make_action_step(model: Model, action: str = "Iteration",
                    for s in model.actions[action])
 
     def step(state: LatticeState, params: SimParams) -> LatticeState:
-        state = state.replace(globals_=jnp.zeros_like(state.globals_))
+        if compute_globals:
+            state = state.replace(globals_=jnp.zeros_like(state.globals_))
         for s in steps:
             state = s(state, params)
         if advances:
@@ -421,20 +451,33 @@ def make_action_step(model: Model, action: str = "Iteration",
 
 def make_iterate(model: Model, action: str = "Iteration",
                  unroll: int = 1,
-                 streaming: Optional[Streaming] = None) -> Callable:
+                 streaming: Optional[Streaming] = None,
+                 present: Optional[set] = None) -> Callable:
     """niter-step loop as a ``lax.scan`` (reference Lattice::Iterate,
     src/Lattice.cu.Rt:780-869).  Differentiable; wrap with ``jax.checkpoint``
     policies for long-horizon adjoints (reference SnapLevel tape,
-    src/Lattice.cu.Rt:34-49)."""
-    step = make_action_step(model, action, streaming)
+    src/Lattice.cu.Rt:34-49).
+
+    ``iterate``'s contract is "globals_ = the LAST step's integrals"
+    (each action step zeroes them), so the first niter-1 steps run the
+    NoGlobals specialization — the reductions are pure waste there (the
+    reference's Globals-mode template parameter, src/cuda.cu.Rt:81) —
+    and only the final step reduces."""
+    step_ng = make_action_step(model, action, streaming, present=present,
+                               compute_globals=False)
+    step_full = make_action_step(model, action, streaming, present=present,
+                                 compute_globals=True)
 
     def iterate(state: LatticeState, params: SimParams, niter: int
                 ) -> LatticeState:
+        if niter <= 0:
+            return state
+
         def body(s, _):
-            return step(s, params), None
-        state, _ = jax.lax.scan(body, state, None, length=niter,
+            return step_ng(s, params), None
+        state, _ = jax.lax.scan(body, state, None, length=niter - 1,
                                 unroll=unroll)
-        return state
+        return step_full(state, params)
 
     return iterate
 
@@ -518,16 +561,18 @@ class Lattice:
             iteration=jnp.zeros((), dtype=jnp.int32),
         )
         if mesh is not None:
-            from tclb_tpu.parallel.halo import make_sharded_iterate
             from tclb_tpu.parallel.mesh import shard_state
-            self._iterate = make_sharded_iterate(model, mesh)
             self._place = lambda: shard_state(self.state, self.params, mesh)
             self.state, self.params = self._place()
         else:
-            self._iterate = jax.jit(make_iterate(model),
-                                    static_argnames=("niter",),
-                                    donate_argnums=0)
             self._place = None
+        # the XLA engine is built lazily so its trace can specialize on
+        # the PAINTED node types (the reference compiles per boundary
+        # set); set_flags invalidates it.  _host_flags keeps the host-side
+        # copy present_types needs — under multi-host the sharded device
+        # flags span non-addressable devices and cannot be fetched back
+        self._iterate_cached = None
+        self._host_flags: Optional[np.ndarray] = None
         self._init = jax.jit(make_action_step(model, "Init"), donate_argnums=0)
         self.sampler = None
         self._iterate_sampled = None
@@ -545,11 +590,13 @@ class Lattice:
         """Overwrite the node-type field (reference Lattice::FlagOverwrite,
         src/Lattice.cu.Rt:892-905)."""
         assert flags.shape == self.shape
+        self._host_flags = np.asarray(flags, dtype=np.uint16).copy()
         self.state = dataclasses.replace(
             self.state, flags=jnp.asarray(flags, dtype=FLAG_DTYPE))
         if self._place is not None:
             self.state, self.params = self._place()
         self._fast_tried = False   # present node types may have changed
+        self._iterate_cached = None
 
     def set_setting(self, name: str, value: float, zone: Optional[int] = None
                     ) -> None:
@@ -603,6 +650,31 @@ class Lattice:
 
     # -- running ------------------------------------------------------------ #
 
+    def _flags_host(self) -> np.ndarray:
+        """Host-side flag field for static specialization (multi-host
+        safe: sharded device flags may span non-addressable devices)."""
+        if self._host_flags is not None:
+            return self._host_flags
+        return np.asarray(self.state.flags)
+
+    @property
+    def _iterate(self):
+        """The XLA engine, built on demand and specialized on the painted
+        node types (absent boundary cases are skipped; globals reduce on
+        the final step only — iterate()'s contract)."""
+        if self._iterate_cached is None:
+            from tclb_tpu.ops.lbm import present_types
+            present = present_types(self.model, self._flags_host())
+            if self.mesh is not None:
+                from tclb_tpu.parallel.halo import make_sharded_iterate
+                self._iterate_cached = make_sharded_iterate(
+                    self.model, self.mesh, present=present)
+            else:
+                self._iterate_cached = jax.jit(
+                    make_iterate(self.model, present=present),
+                    static_argnames=("niter",), donate_argnums=0)
+        return self._iterate_cached
+
     def _build_fast(self):
         """Try to build the fused Pallas fast path for this configuration
         (the reference's tuned kernel IS its engine — Lattice::Iteration
@@ -624,21 +696,20 @@ class Lattice:
             from tclb_tpu.parallel.halo import make_sharded_pallas_iterate
             it = make_sharded_pallas_iterate(
                 self.model, self.mesh, self.shape, self.dtype,
-                present=present_types(self.model,
-                                      np.asarray(self.state.flags)))
+                present=present_types(self.model, self._flags_host()))
             if it is not None:
                 return it, f"pallas_sharded[{dict(self.mesh.shape)}]"
             return None, None
         if pallas_d2q9.supports(self.model, self.shape, self.dtype):
             present = pallas_d2q9.present_types(
-                self.model, np.asarray(self.state.flags))
+                self.model, self._flags_host())
             return (pallas_d2q9.make_pallas_iterate(
                 self.model, self.shape, self.dtype, fuse=2,
                 present=present),
                 "pallas_d2q9[fuse=2]")
         if pallas_d3q.supports(self.model, self.shape, self.dtype):
             present = pallas_d3q.present_types(
-                self.model, np.asarray(self.state.flags))
+                self.model, self._flags_host())
             return (pallas_d3q.make_pallas_iterate(
                 self.model, self.shape, self.dtype, present=present),
                 f"pallas_d3q[{self.model.name}]")
@@ -778,6 +849,8 @@ class Lattice:
     def load(self, path: str) -> None:
         d = np.load(path if path.endswith(".npz") else path + ".npz")
         self._fast_tried = False   # restored flags may paint new types
+        self._iterate_cached = None
+        self._host_flags = np.asarray(d["flags"], dtype=np.uint16)
         self.state = LatticeState(
             fields=jnp.asarray(d["fields"], dtype=self.dtype),
             flags=jnp.asarray(d["flags"], dtype=FLAG_DTYPE),

@@ -151,8 +151,9 @@ def _collision_mrt(ctx: NodeCtx, f: jnp.ndarray):
     # post-force equilibrium moments back
     ux2 = ux + ctx.setting("GravitationX") + ctx.density("BC[0]")
     uy2 = uy + ctx.setting("GravitationY") + ctx.density("BC[1]")
-    m_post = m_neq + lbm.moments(M, _equilibrium(rho, ux2, uy2))
-    return lbm.from_moments(M, m_post)
+    # Minv @ (m_neq + M @ feq2) == Minv @ m_neq + feq2 — one transform
+    # saved (exact algebra; the Pallas kernel uses the same identity)
+    return lbm.from_moments(M, m_neq) + _equilibrium(rho, ux2, uy2)
 
 
 def run(ctx: NodeCtx) -> jnp.ndarray:

@@ -90,6 +90,9 @@ def _def() -> ModelDef:
                   comment="solutal capillary length d_0")
     d.add_setting("Buoyancy", default=0.0, unit="m/s2K",
                   comment="Boussinesq buoyancy coefficient")
+    # OutFlux and Heater are DECLARED but unused, exactly like the
+    # reference: Dynamics.R registers both, Dynamics.c.Rt's Run() never
+    # accumulates OutFlux nor dispatches on Heater
     d.add_global("OutFlux")
     d.add_global("Material")
     d.add_node_type("Heater", "ADDITIONALS")

@@ -290,17 +290,22 @@ def make_sharded_pallas_iterate(model: Model, mesh: Mesh, shape,
 
 def make_sharded_iterate(model: Model, mesh: Mesh,
                          action: str = "Iteration",
-                         unroll: int = 1) -> Callable:
+                         unroll: int = 1,
+                         present: Optional[set] = None) -> Callable:
     """``iterate(state, params, niter)`` over the device mesh.
 
     The whole scan lives inside one ``shard_map`` so per-step halo exchanges
     are collectives inside the compiled loop — the reference's
     per-iteration MPIStream_A/B dance (src/Lattice.cu.Rt:424-456) with the
-    host entirely out of the loop.  The globals allreduce happens once after
-    the scan (each step's locals fully replace the previous step's)."""
+    host entirely out of the loop.  Like the single-device engine, the
+    first niter-1 steps run the NoGlobals specialization; the final step
+    reduces and the allreduce happens once after the scan."""
     _validate_mesh(model, mesh)
     streaming = HaloStreaming(model, mesh)
-    step = make_action_step(model, action, streaming)
+    step_ng = make_action_step(model, action, streaming, present=present,
+                               compute_globals=False)
+    step = make_action_step(model, action, streaming, present=present,
+                            compute_globals=True)
     names = tuple(mesh.axis_names)
 
     state_specs = LatticeState(
@@ -315,9 +320,11 @@ def make_sharded_iterate(model: Model, mesh: Mesh,
         def local_iterate(state: LatticeState, params: SimParams
                           ) -> LatticeState:
             def body(s, _):
-                return step(s, params), None
-            state, _ = lax.scan(body, state, None, length=niter,
+                return step_ng(s, params), None
+            state, _ = lax.scan(body, state, None, length=max(niter - 1, 0),
                                 unroll=unroll)
+            if niter > 0:
+                state = step(state, params)
             return state.replace(
                 globals_=_globals_allreduce(model, state.globals_, names))
 
@@ -327,6 +334,11 @@ def make_sharded_iterate(model: Model, mesh: Mesh,
         return jax.jit(f, donate_argnums=0)
 
     def iterate(state, params, niter):
+        if int(niter) <= 0:
+            # match the single-device engine: no steps, no allreduce (a
+            # psum of the already-reduced globals would scale them by the
+            # device count)
+            return state
         return _for_niter(int(niter))(state, params)
 
     return iterate

@@ -706,7 +706,7 @@ class Lattice:
             return (pallas_d2q9.make_pallas_iterate(
                 self.model, self.shape, self.dtype, fuse=2,
                 present=present),
-                "pallas_d2q9[fuse=2]")
+                f"pallas_2d[{self.model.name},fuse=2]")
         if pallas_d3q.supports(self.model, self.shape, self.dtype):
             present = pallas_d3q.present_types(
                 self.model, self._flags_host())

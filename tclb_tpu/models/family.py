@@ -200,6 +200,26 @@ def make_getters(E: np.ndarray, force_of=None) -> dict[str, Callable]:
     return {"Rho": get_rho, "U": get_u}
 
 
+def dispatch_boundary_cases(cases: dict, f, mask_of,
+                            present: Optional[set] = None):
+    """Mask-dispatch a :func:`boundary_cases` dict — the shared inner
+    loop of the Pallas kernels (2D and 3D): ``mask_of(name)`` yields the
+    bool plane for a node type; cases whose types are all absent from
+    ``present`` are skipped entirely (compile-time specialization on the
+    painted boundary set, reference src/cuda.cu.Rt:81)."""
+    out = f
+    for names, fn in cases.items():
+        names = [n for n in ((names,) if isinstance(names, str) else names)
+                 if present is None or n in present]
+        if not names:
+            continue
+        m = mask_of(names[0])
+        for n in names[1:]:
+            m = m | mask_of(n)
+        out = jnp.where(m[None], fn(f), out)
+    return out
+
+
 def gravity_of(ctx: NodeCtx):
     """Acceleration tuple from the Gravitation* settings."""
     names = [f"Gravitation{a}" for a in ("X", "Y", "Z")]

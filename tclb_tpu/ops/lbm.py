@@ -309,6 +309,29 @@ def _unrolled_matvec(mat: np.ndarray, f) -> jnp.ndarray:
     return jnp.stack(rows)
 
 
+def smagorinsky_omega_unrolled(E: np.ndarray, f, feq, rho, omega0, smag):
+    """Mosaic-safe form of :func:`smagorinsky_omega`: the |Pi| contraction
+    unrolled with SCALAR coefficients (Pallas rejects materialized
+    constant coefficient vectors).  Identical algebra — the Pallas LES
+    branches (2D and 3D) share this one implementation."""
+    d = E.shape[1]
+    pi2 = None
+    for a in range(d):
+        for b in range(a, d):
+            ks = [k for k in range(len(E)) if E[k, a] * E[k, b]]
+            if not ks:
+                continue
+            pab = sum(float(E[k, a] * E[k, b]) * (f[k] - feq[k])
+                      for k in ks)
+            term = pab * pab * (1.0 if a == b else 2.0)
+            pi2 = term if pi2 is None else pi2 + term
+    tau0 = 1.0 / omega0
+    tau_eff = 0.5 * (tau0 + jnp.sqrt(
+        tau0 * tau0 + 18.0 * math.sqrt(2.0) * smag * smag
+        * jnp.sqrt(pi2) / rho))
+    return 1.0 / tau_eff
+
+
 def moments(M: np.ndarray, f: jnp.ndarray) -> jnp.ndarray:
     """m = M f over the leading (population) axis."""
     return _unrolled_matvec(M, f)

@@ -123,13 +123,24 @@ def _pad_rows(model: Model, ny: int, nx: int) -> Optional[int]:
     return best
 
 
+# family models whose collision the kernel implements via per-model
+# branches (same pattern as ops/pallas_d3q.py); d2q9 itself keeps its
+# hand-tuned MRT path with the BC coupling planes
+_FAMILY_2D = ("d2q9_SRT", "d2q9_les", "d2q9_inc", "d2q9_cumulant")
+
+
 def supports(model: Model, shape, dtype) -> bool:
     """Whether the fused kernel can run this configuration.
 
-    Only plain ``d2q9``: the kernel hardcodes d2q9's MRT physics and node
-    types; ``d2q9_new``'s raw-moment/LES/entropic collision is different
-    physics and must not silently run through this kernel."""
-    if model.name != "d2q9":
+    ``d2q9`` plus the pure-f family models whose collisions the kernel
+    implements (``_FAMILY_2D``); ``d2q9_new``'s raw-moment/LES/entropic
+    collision is different physics and must not silently run through
+    this kernel."""
+    if model.name == "d2q9":
+        pass
+    elif model.name in _FAMILY_2D and model.n_storage == 9:
+        pass
+    else:
         return False
     if len(shape) != 2 or dtype != jnp.float32:
         return False
@@ -184,6 +195,10 @@ def make_pallas_iterate(model: Model, shape, dtype=jnp.float32,
     reference's equivalent composition is RunBorder/MPIStream/RunInterior,
     src/Lattice.cu.Rt:424-456)."""
     from tclb_tpu.models import d2q9 as mod
+    from tclb_tpu.models import d2q9_inc as inc_mod
+    from tclb_tpu.models import family
+    from tclb_tpu.ops import cumulant
+    from tclb_tpu.ops import lbm as lbm_mod
 
     if not supports(model, shape, dtype):
         raise ValueError(f"pallas path unsupported for {model.name} {shape}")
@@ -206,31 +221,49 @@ def make_pallas_iterate(model: Model, shape, dtype=jnp.float32,
     if interpret is None:
         interpret = jax.default_backend() != "tpu"
 
-    E, W, OPP, M = mod.E, mod.W, mod.OPP, mod.M
-    norm = (M * M).sum(axis=1)
-    Minv = (M / norm[:, None]).T
+    is_d2q9 = model.name == "d2q9"
+    if is_d2q9:
+        E, W, OPP, M = mod.E, mod.W, mod.OPP, mod.M
+        norm = (M * M).sum(axis=1)
+        Minv = (M / norm[:, None]).T
+        bc_idx = list(model.groups["BC"])
+    else:
+        E = model.ei[:9, :2]
+        W = lbm_mod.weights(E)
+        OPP = lbm_mod.opposite(E)
+        bc_idx = None
     n_storage = model.n_storage
     f_idx = list(model.groups["f"])
-    bc_idx = list(model.groups["BC"])
     assert f_idx == list(range(9)), "kernel assumes f planes lead the stack"
 
     si = model.setting_index
-    i_s3, i_s4, i_s56, i_s78 = si["S3"], si["S4"], si["S56"], si["S78"]
     i_gx, i_gy = si["GravitationX"], si["GravitationY"]
+    coll_mask = int(model.group_masks["COLLISION"])
     nt = {n: (int(t.mask), int(t.value)) for n, t in model.node_types.items()}
-    present = set(nt) if present is None else set(present) | {"MRT"}
+    present = set(nt) if present is None else set(present)
 
     def _is(flags, name):
         mask, val = nt[name]
         return (flags & jnp.int32(mask)) == jnp.int32(val)
 
-    def _lbm_step(f, flags, vel, den, bc0, bc1, sett):
+    def _apply_family_boundaries(f, flags, vel, den):
+        """Mask-dispatch family.boundary_cases, skipping absent types —
+        the identical closures the XLA path applies (same contract as
+        ops/pallas_d3q.py)."""
+        cases = family.boundary_cases(model, E, W, OPP, vel, den)
+        return family.dispatch_boundary_cases(
+            cases, f, lambda n: _is(flags, n), present)
+
+    def _lbm_step_d2q9(f, flags, vel, den, bc0, bc1, sett):
         """One collide step on an arbitrary row band: boundary dispatch in
         the same case order as models.d2q9.run, then the MRT collision
         (mirrors models.d2q9._collision_mrt, sans globals).  Absent node
         types (``present``) are skipped entirely — each case is a
         full-band compute, so this mirrors the reference's compile-time
         specialization of the kernel on the model's boundary set."""
+        i_s3, i_s4 = si["S3"], si["S4"]
+        i_s56, i_s78 = si["S56"], si["S78"]
+
         def apply(mask, new, cur):
             return jnp.where(mask[None], new, cur)
 
@@ -284,6 +317,49 @@ def make_pallas_iterate(model: Model, shape, dtype=jnp.float32,
         coll = [r + q for r, q in zip(relax, feq2)]
         mrt = _is(flags, "MRT")
         return jnp.stack([jnp.where(mrt, coll[k], f[k]) for k in range(9)])
+
+    def _lbm_step_family(f, flags, vel, den, bc0, bc1, sett):
+        """Family-model collide step: shared boundary dispatch + the
+        model's own collision, op-for-op the XLA model code (minus
+        globals) — BGK (d2q9_SRT), Smagorinsky (d2q9_les, in-kernel
+        unrolled |Pi|), He-Luo incompressible (d2q9_inc), central-moment
+        cumulant (d2q9_cumulant via ops/cumulant.py)."""
+        f = _apply_family_boundaries(f, flags, vel, den)
+        coll = (flags & jnp.int32(coll_mask)) != jnp.int32(0)
+        gx, gy = sett[i_gx], sett[i_gy]
+        if model.name == "d2q9_cumulant":
+            F = f.reshape((3, 3) + f.shape[1:])
+            Fp, _, _ = cumulant.collide_d2q9(
+                F, sett[si["omega"]], sett[si["omega_bulk"]],
+                force=(gx, gy))
+            fc = Fp.reshape(f.shape)
+        elif model.name == "d2q9_inc":
+            rho = jnp.sum(f, axis=0)
+            ux = sum(float(E[k, 0]) * f[k] for k in range(9)
+                     if E[k, 0]) / inc_mod.RHO0
+            uy = sum(float(E[k, 1]) * f[k] for k in range(9)
+                     if E[k, 1]) / inc_mod.RHO0
+            feq = inc_mod._inc_equilibrium(rho, ux, uy)
+            fc = f + sett[si["omega"]] * (feq - f)
+            fc = fc + (inc_mod._inc_equilibrium(rho, ux + gx, uy + gy)
+                       - feq)
+        else:   # d2q9_SRT / d2q9_les
+            rho = jnp.sum(f, axis=0)
+            ux = sum(float(E[k, 0]) * f[k] for k in range(9)
+                     if E[k, 0]) / rho
+            uy = sum(float(E[k, 1]) * f[k] for k in range(9)
+                     if E[k, 1]) / rho
+            feq = equilibrium(E, W, rho, (ux, uy))
+            if model.name == "d2q9_les":
+                om = lbm_mod.smagorinsky_omega_unrolled(
+                    E, f, feq, rho, sett[si["omega"]], sett[si["Smag"]])
+            else:
+                om = sett[si["omega"]]
+            fc = f + om * (feq - f)
+            fc = fc + (equilibrium(E, W, rho, (ux + gx, uy + gy)) - feq)
+        return jnp.where(coll[None], fc, f)
+
+    _lbm_step = _lbm_step_d2q9 if is_d2q9 else _lbm_step_family
 
     def kernel(sett, f_hbm, flags_ref, vel_ref, den_ref, out_ref,
                buf2, sems):
@@ -357,14 +433,15 @@ def make_pallas_iterate(model: Model, shape, dtype=jnp.float32,
             sl = buf2[slot, k, 8 - dy:8 - dy + by, :]
             pulled.append(pltpu.roll(sl, dx % nx, axis=1) if dx else sl)
         f = jnp.stack(pulled)
-        bc0 = mid(bc_idx[0])
-        bc1 = mid(bc_idx[1])
+        bc0 = mid(bc_idx[0]) if bc_idx else 0.0
+        bc1 = mid(bc_idx[1]) if bc_idx else 0.0
         fnew = _lbm_step(f, flags_ref[:], vel_ref[:], den_ref[:],
                          bc0, bc1, sett)
         for k in range(9):
             out_ref[k] = fnew[k]
-        out_ref[bc_idx[0]] = bc0
-        out_ref[bc_idx[1]] = bc1
+        if bc_idx:
+            out_ref[bc_idx[0]] = bc0
+            out_ref[bc_idx[1]] = bc1
 
     def kernel2(sett, f_hbm, aux_hbm, out_ref, buff, bufa, sems):
         """Temporally-fused kernel: TWO collide-stream steps per band pass
@@ -426,8 +503,8 @@ def make_pallas_iterate(model: Model, shape, dtype=jnp.float32,
         flags_e = ext(bufa, 0, -1, by2 + 1).astype(jnp.int32)
         vel_e = ext(bufa, 1, -1, by2 + 1)
         den_e = ext(bufa, 2, -1, by2 + 1)
-        bc0_e = ext(buff, bc_idx[0], -1, by2 + 1)
-        bc1_e = ext(buff, bc_idx[1], -1, by2 + 1)
+        bc0_e = ext(buff, bc_idx[0], -1, by2 + 1) if bc_idx else 0.0
+        bc1_e = ext(buff, bc_idx[1], -1, by2 + 1) if bc_idx else 0.0
         f1 = _lbm_step(f, flags_e, vel_e, den_e, bc0_e, bc1_e, sett)
 
         # ---- step 2 on rows [0, by) ------------------------------------- #
@@ -438,12 +515,15 @@ def make_pallas_iterate(model: Model, shape, dtype=jnp.float32,
             pulled.append(pltpu.roll(sl, dx % nx, axis=1) if dx else sl)
         f = jnp.stack(pulled)
         f2 = _lbm_step(f, flags_e[1:by2 + 1], vel_e[1:by2 + 1],
-                       den_e[1:by2 + 1], bc0_e[1:by2 + 1], bc1_e[1:by2 + 1],
+                       den_e[1:by2 + 1],
+                       bc0_e[1:by2 + 1] if bc_idx else 0.0,
+                       bc1_e[1:by2 + 1] if bc_idx else 0.0,
                        sett)
         for k in range(9):
             out_ref[k] = f2[k]
-        out_ref[bc_idx[0]] = ext(buff, bc_idx[0], 0, by2)
-        out_ref[bc_idx[1]] = ext(buff, bc_idx[1], 0, by2)
+        if bc_idx:
+            out_ref[bc_idx[0]] = ext(buff, bc_idx[0], 0, by2)
+            out_ref[bc_idx[1]] = ext(buff, bc_idx[1], 0, by2)
 
     grid2 = (ny // by2,)
     call2 = pl.pallas_call(

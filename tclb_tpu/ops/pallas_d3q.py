@@ -175,17 +175,8 @@ def make_pallas_iterate(model: Model, shape, dtype=jnp.float32,
                 E, W, OPP, f, 0, +1, "velocity", turb_u,
                 vt={1: turb * synth[1], 2: turb * synth[2]})}
         cases = family.boundary_cases(model, E_, W_, OPP_, vel, den, extra)
-        out = f
-        for names, fn in cases.items():
-            names = [n for n in ((names,) if isinstance(names, str)
-                                 else names) if n in present]
-            if not names:
-                continue
-            mask = _is(flags, names[0])
-            for n in names[1:]:
-                mask = mask | _is(flags, n)
-            out = jnp.where(mask[None], fn(f), out)
-        f = out
+        f = family.dispatch_boundary_cases(
+            cases, f, lambda n: _is(flags, n), present)
 
         coll = (flags & jnp.int32(coll_mask)) != jnp.int32(0)
         if is_cumulant:
@@ -211,23 +202,10 @@ def make_pallas_iterate(model: Model, shape, dtype=jnp.float32,
             u2 = tuple(u[a] + g[a] for a in range(3))
             feq2 = lbm.equilibrium(E19, W19, rho, u2)
             if is_les:
-                # BGK + Smagorinsky (models/d3q19_les.py), |Pi| unrolled
-                # with scalar coefficients (Mosaic-safe)
-                import math as _math
-                pi2 = None
-                for a in range(3):
-                    for b in range(a, 3):
-                        pab = sum(float(E19[k, a] * E19[k, b])
-                                  * (f[k] - feq[k]) for k in range(19)
-                                  if E19[k, a] * E19[k, b])
-                        term = pab * pab * (1.0 if a == b else 2.0)
-                        pi2 = term if pi2 is None else pi2 + term
-                tau0 = 1.0 / sett[si["omega"]]
-                tau_eff = 0.5 * (tau0 + jnp.sqrt(
-                    tau0 * tau0 + 18.0 * _math.sqrt(2.0)
-                    * sett[si["Smag"]] * sett[si["Smag"]]
-                    * jnp.sqrt(pi2) / rho))
-                om_eff = 1.0 / tau_eff
+                # BGK + Smagorinsky (models/d3q19_les.py), shared
+                # Mosaic-safe unrolled |Pi| helper
+                om_eff = lbm.smagorinsky_omega_unrolled(
+                    E19, f, feq, rho, sett[si["omega"]], sett[si["Smag"]])
                 fc = jnp.stack([f[k] + om_eff * (feq[k] - f[k])
                                 + (feq2[k] - feq[k]) for k in range(19)])
             else:

@@ -56,7 +56,7 @@ def test_engine_dispatch_matches_xla(monkeypatch):
     monkeypatch.setenv("TCLB_FASTPATH", "force")
     _, lat_f = _karman_lattice()
     lat_f.iterate(niter)
-    assert lat_f._fast_name == "pallas_d2q9[fuse=2]"
+    assert lat_f._fast_name == "pallas_2d[d2q9,fuse=2]"
 
     np.testing.assert_allclose(np.asarray(lat_f.state.fields),
                                np.asarray(lat_x.state.fields),
@@ -111,7 +111,7 @@ def test_fallbacks(monkeypatch):
     lat.iterate(8)   # must not raise: dispatch sees time_series, uses XLA
     assert np.isfinite(np.asarray(lat.state.fields)).all()
 
-    m2 = get_model("d2q9_SRT")
+    m2 = get_model("d2q9_heat")
     lat2 = Lattice(m2, (32, 64), dtype=jnp.float32, settings={"nu": 0.05})
     lat2.init()
     lat2.iterate(4)

@@ -115,6 +115,21 @@ def bench_d2q9(results):
         results["pallas_mlups"] = round(mlups_pallas, 1)
         results["pallas_fused2_mlups"] = round(mlups_fused, 1)
 
+    # the 2D cumulant family kernel (best roofline fraction in the repo)
+    mc = get_model("d2q9_cumulant")
+    latc = Lattice(mc, (ny, nx), dtype=jnp.float32,
+                   settings={"nu": 0.02, "Velocity": 0.01,
+                             "omega_bulk": 1.0})
+    fc = np.full((ny, nx), mc.flag_for("BGK"), dtype=np.uint16)
+    fc[:, 0] = mc.flag_for("WVelocity", "BGK")
+    fc[:, -1] = mc.flag_for("EPressure", "BGK")
+    fc[0, :] = fc[-1, :] = mc.flag_for("Wall")
+    latc.set_flags(fc)
+    latc.init()
+    mlups_cum = timed_solver(latc, solver_iters)
+    results["d2q9_cumulant_mlups"] = round(mlups_cum, 1)
+    results["d2q9_cumulant_engine"] = latc._fast_name or "xla"
+
     # sharded fast path on a 1-device mesh: measures the per-step
     # ppermute + shard_map machinery overhead vs the single-device
     # kernels (multi-chip hardware is not available here; the identity
@@ -141,6 +156,7 @@ def bench_d2q9(results):
         ("xla", mlups_xla, 1.0),
         ("pallas", mlups_pallas, 1.0),
         ("pallas_fused2", mlups_fused, 2.0),
+        ("d2q9_cumulant", mlups_cum, 2.0),
         ("sharded_1dev", mlups_sharded, 2.0)]
 
 

@@ -101,17 +101,21 @@ def _hquad(u, v, rho):
     return acc if acc is not None else jnp.zeros_like(rho)
 
 
-def _collision(ctx: NodeCtx, f):
+def collision_core(f, omega, smag, smag_mask, stab_mask):
+    """The raw-moment MRT + per-node Smagorinsky + entropic-stabilizer
+    collision as a PURE function of planes and masks — one source of
+    physics shared by the XLA path (:func:`_collision`) and the Pallas
+    kernel branch (ops/pallas_d2q9.py); scalar-coefficient unrolled
+    sums only, so it is Mosaic-safe as-is."""
     rho, meq, neq = _neq_split(f)
-    gamma = 1.0 - ctx.setting("omega")
+    gamma = 1.0 - omega
 
     # Smagorinsky mode (reference Dynamics.c.Rt:166-182)
     q2 = sum(neq[r] * neq[r] for r in range(9) if _ORDER[r] == 2)
-    qs = 18.0 * jnp.sqrt(jnp.maximum(q2, 0.0)) * ctx.setting("Smag")
+    qs = 18.0 * jnp.sqrt(jnp.maximum(q2, 0.0)) * smag
     tau0 = 1.0 / (1.0 - gamma)
     tau = 0.5 * (jnp.sqrt(tau0 * tau0 + qs) + tau0)
-    gamma_eff = jnp.where(ctx.nt_is("Smagorinsky"),
-                          1.0 - 1.0 / tau, gamma)
+    gamma_eff = jnp.where(smag_mask, 1.0 - 1.0 / tau, gamma)
 
     # entropic stabilizer (reference :184-195)
     ds = [neq[r] if _ORDER[r] == 2 else None for r in range(9)]
@@ -121,7 +125,7 @@ def _collision(ctx: NodeCtx, f):
     safe_b = jnp.where(jnp.abs(b) > 1e-30, b, 1.0)
     gamma_ent = -gamma_eff * jnp.where(jnp.abs(b) > 1e-30,
                                        a / safe_b, -1.0)
-    gamma2 = jnp.where(ctx.nt_is("Stab"), gamma_ent, gamma_eff)
+    gamma2 = jnp.where(stab_mask, gamma_ent, gamma_eff)
 
     out_m = []
     for r in range(9):
@@ -134,6 +138,11 @@ def _collision(ctx: NodeCtx, f):
     return jnp.stack([
         sum(float(MINV[i, r]) * out_m[r] for r in range(9) if MINV[i, r])
         for i in range(9)])
+
+
+def _collision(ctx: NodeCtx, f):
+    return collision_core(f, ctx.setting("omega"), ctx.setting("Smag"),
+                          ctx.nt_is("Smagorinsky"), ctx.nt_is("Stab"))
 
 
 def run(ctx: NodeCtx) -> jnp.ndarray:

@@ -126,16 +126,17 @@ def _pad_rows(model: Model, ny: int, nx: int) -> Optional[int]:
 # family models whose collision the kernel implements via per-model
 # branches (same pattern as ops/pallas_d3q.py); d2q9 itself keeps its
 # hand-tuned MRT path with the BC coupling planes
-_FAMILY_2D = ("d2q9_SRT", "d2q9_les", "d2q9_inc", "d2q9_cumulant")
+_FAMILY_2D = ("d2q9_SRT", "d2q9_les", "d2q9_inc", "d2q9_cumulant",
+              "d2q9_new")
 
 
 def supports(model: Model, shape, dtype) -> bool:
     """Whether the fused kernel can run this configuration.
 
     ``d2q9`` plus the pure-f family models whose collisions the kernel
-    implements (``_FAMILY_2D``); ``d2q9_new``'s raw-moment/LES/entropic
-    collision is different physics and must not silently run through
-    this kernel."""
+    implements as dedicated branches (``_FAMILY_2D`` — including
+    d2q9_new's raw-moment/LES/entropic collision, which shares
+    models.d2q9_new.collision_core with the XLA path)."""
     if model.name == "d2q9":
         pass
     elif model.name in _FAMILY_2D and model.n_storage == 9:
@@ -169,6 +170,21 @@ def _sparse_matvec(mat: np.ndarray, planes: list) -> list:
     return out
 
 
+def gather_zonal_planes(model: Model, params, zones, dtype):
+    """Per-node (velocity, density) planes from the zonal tables — the
+    kernels' static per-call inputs.  Models without a Density setting
+    (d2q9_new) parameterize the boundary density via zonal Pressure,
+    rho = 1 + 3 p."""
+    si = model.setting_index
+    vel = params.zone_table[si["Velocity"]].astype(dtype)[zones]
+    if "Density" in si:
+        den = params.zone_table[si["Density"]].astype(dtype)[zones]
+    else:
+        den = 1.0 + 3.0 * \
+            params.zone_table[si["Pressure"]].astype(dtype)[zones]
+    return vel, den
+
+
 def make_pallas_iterate(model: Model, shape, dtype=jnp.float32,
                         interpret: Optional[bool] = None,
                         fuse: int = 1,
@@ -196,6 +212,7 @@ def make_pallas_iterate(model: Model, shape, dtype=jnp.float32,
     src/Lattice.cu.Rt:424-456)."""
     from tclb_tpu.models import d2q9 as mod
     from tclb_tpu.models import d2q9_inc as inc_mod
+    from tclb_tpu.models import d2q9_new as new_mod
     from tclb_tpu.models import family
     from tclb_tpu.ops import cumulant
     from tclb_tpu.ops import lbm as lbm_mod
@@ -237,7 +254,8 @@ def make_pallas_iterate(model: Model, shape, dtype=jnp.float32,
     assert f_idx == list(range(9)), "kernel assumes f planes lead the stack"
 
     si = model.setting_index
-    i_gx, i_gy = si["GravitationX"], si["GravitationY"]
+    i_gx = si.get("GravitationX")
+    i_gy = si.get("GravitationY")
     coll_mask = int(model.group_masks["COLLISION"])
     nt = {n: (int(t.mask), int(t.value)) for n, t in model.node_types.items()}
     present = set(nt) if present is None else set(present)
@@ -254,21 +272,17 @@ def make_pallas_iterate(model: Model, shape, dtype=jnp.float32,
         return family.dispatch_boundary_cases(
             cases, f, lambda n: _is(flags, n), present)
 
-    def _lbm_step_d2q9(f, flags, vel, den, bc0, bc1, sett):
-        """One collide step on an arbitrary row band: boundary dispatch in
-        the same case order as models.d2q9.run, then the MRT collision
-        (mirrors models.d2q9._collision_mrt, sans globals).  Absent node
-        types (``present``) are skipped entirely — each case is a
-        full-band compute, so this mirrors the reference's compile-time
-        specialization of the kernel on the model's boundary set."""
-        i_s3, i_s4 = si["S3"], si["S4"]
-        i_s56, i_s78 = si["S56"], si["S78"]
-
+    def _zouhe_boundaries(f, flags, vel, den):
+        """d2q9-style explicit boundary list (models/d2q9.run order),
+        shared by the d2q9 and d2q9_new branches; absent node types
+        (``present``) are skipped entirely — each case is a full-band
+        compute, so this mirrors the reference's compile-time
+        specialization on the model's boundary set."""
         def apply(mask, new, cur):
             return jnp.where(mask[None], new, cur)
 
         def mask_of(*names):
-            names = [n for n in names if n in present]
+            names = [n for n in names if n in present and n in nt]
             if not names:
                 return None
             m = _is(flags, names[0])
@@ -284,15 +298,24 @@ def make_pallas_iterate(model: Model, shape, dtype=jnp.float32,
                 ("WPressure", den, "pressure", "W"),
                 ("WVelocity", vel, "velocity", "W"),
                 ("EPressure", den, "pressure", "E")):
-            if name in present:
+            if name in present and name in nt:
                 f = apply(_is(flags, name),
                           mod._zou_he_x(f, plane, kind, side), f)
-        if "TopSymmetry" in present:
+        if "TopSymmetry" in present and "TopSymmetry" in nt:
             f = apply(_is(flags, "TopSymmetry"),
                       mod._symmetry(f, top=True), f)
-        if "BottomSymmetry" in present:
+        if "BottomSymmetry" in present and "BottomSymmetry" in nt:
             f = apply(_is(flags, "BottomSymmetry"),
                       mod._symmetry(f, top=False), f)
+        return f
+
+    def _lbm_step_d2q9(f, flags, vel, den, bc0, bc1, sett):
+        """One collide step on an arbitrary row band: d2q9-style boundary
+        dispatch, then the MRT collision (mirrors
+        models.d2q9._collision_mrt, sans globals)."""
+        i_s3, i_s4 = si["S3"], si["S4"]
+        i_s56, i_s78 = si["S56"], si["S78"]
+        f = _zouhe_boundaries(f, flags, vel, den)
 
         rho = sum(f[k] for k in range(9))
         ux = sum(float(E[k, 0]) * f[k] for k in range(9) if E[k, 0]) / rho
@@ -324,6 +347,16 @@ def make_pallas_iterate(model: Model, shape, dtype=jnp.float32,
         globals) — BGK (d2q9_SRT), Smagorinsky (d2q9_les, in-kernel
         unrolled |Pi|), He-Luo incompressible (d2q9_inc), central-moment
         cumulant (d2q9_cumulant via ops/cumulant.py)."""
+        if model.name == "d2q9_new":
+            # d2q9-style explicit Zou-He list (the model's own run(),
+            # models/d2q9_new.py), then the shared raw-moment collision
+            # core — one source of physics for both engines
+            f = _zouhe_boundaries(f, flags, vel, den)
+            fc = new_mod.collision_core(
+                f, sett[si["omega"]], sett[si["Smag"]],
+                _is(flags, "Smagorinsky"), _is(flags, "Stab"))
+            mrt = _is(flags, "MRT")
+            return jnp.where(mrt[None], fc, f)
         f = _apply_family_boundaries(f, flags, vel, den)
         coll = (flags & jnp.int32(coll_mask)) != jnp.int32(0)
         gx, gy = sett[i_gx], sett[i_gy]
@@ -571,7 +604,6 @@ def make_pallas_iterate(model: Model, shape, dtype=jnp.float32,
     if ext_halo:
         return call, call2, by, by2
 
-    i_vel, i_den = si["Velocity"], si["Density"]
     zshift = model.zone_shift
 
     @partial(jax.jit, static_argnames=("niter", "fuse"), donate_argnums=0)
@@ -594,8 +626,7 @@ def make_pallas_iterate(model: Model, shape, dtype=jnp.float32,
             fields = jnp.concatenate([fields, fields[:, init_src, :]],
                                      axis=1)
         zones = flags_i32 >> zshift
-        vel = params.zone_table[i_vel].astype(dtype)[zones]
-        den = params.zone_table[i_den].astype(dtype)[zones]
+        vel, den = gather_zonal_planes(model, params, zones, dtype)
         sett = params.settings.astype(dtype)
 
         def refresh(fields):

@@ -213,8 +213,6 @@ def make_sharded_pallas_iterate(model: Model, mesh: Mesh, shape,
         call1, call2, by, by2 = pallas_d2q9.make_pallas_iterate(
             model, local, dtype, interpret=interpret, fuse=2,
             present=present, ext_halo=True)
-        si = model.setting_index
-        i_vel, i_den = si["Velocity"], si["Density"]
         width = 8
     else:
         if not pallas_d3q.supports(model, local, dtype):
@@ -246,8 +244,8 @@ def make_sharded_pallas_iterate(model: Model, mesh: Mesh, shape,
             sett = params.settings.astype(dtype)
             fields = state.fields
             if model.ndim == 2:
-                vel = params.zone_table[i_vel].astype(dtype)[zones]
-                den = params.zone_table[i_den].astype(dtype)[zones]
+                vel, den = pallas_d2q9.gather_zonal_planes(
+                    model, params, zones, dtype)
                 aux_ext = exch(jnp.stack(
                     [flags_i32.astype(dtype), vel, den]))
 

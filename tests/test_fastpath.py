@@ -36,12 +36,19 @@ def _karman_lattice(ny=64, nx=128):
     return m, lat
 
 
-def test_supports_rejects_d2q9_new():
+def test_supports_only_implemented_models():
     """supports() must not claim models whose physics the kernel does not
-    implement (round-2 VERDICT Weak #1: the claim crashed on build and
-    would have been silently wrong physics if it built)."""
-    m = get_model("d2q9_new")
-    assert not pallas_d2q9.supports(m, (64, 128), jnp.float32)
+    implement (round-2 VERDICT Weak #1: a false claim crashed on build
+    and would have been silently wrong physics if it built).  d2q9_new is
+    now genuinely implemented — its kernel branch shares
+    models.d2q9_new.collision_core with the XLA path and is pinned by
+    tests/test_pallas.py::test_pallas_family_models — while multi-lattice
+    models stay rejected."""
+    assert pallas_d2q9.supports(get_model("d2q9_new"), (64, 128),
+                                jnp.float32)
+    for name in ("d2q9_heat", "d2q9_hb", "d2q9_kuper", "d2q9_adj"):
+        assert not pallas_d2q9.supports(get_model(name), (64, 128),
+                                        jnp.float32), name
 
 
 def test_engine_dispatch_matches_xla(monkeypatch):

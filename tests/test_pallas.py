@@ -118,6 +118,7 @@ def test_pallas_matches_xla(case):
     ("d2q9_les", {"Smag": 0.16}),
     ("d2q9_inc", {}),
     ("d2q9_cumulant", {"omega_bulk": 1.0}),
+    ("d2q9_new", {"Smag": 0.02}),
 ])
 @pytest.mark.parametrize("fuse", [1, 2])
 def test_pallas_family_models(name, extra, fuse):
@@ -126,12 +127,14 @@ def test_pallas_family_models(name, extra, fuse):
     kernel uses): parity with the XLA engine on a boundary-rich case."""
     ny, nx = 64, 128
     m = get_model(name)
-    lat = Lattice(m, (ny, nx), dtype=jnp.float32,
-                  settings={"nu": 0.05, "Velocity": 0.03,
-                            "GravitationX": 1e-6, **extra})
-    flags = np.full((ny, nx), m.flag_for("BGK"), dtype=np.uint16)
-    flags[:, 0] = m.flag_for("WVelocity", "BGK")
-    flags[:, -1] = m.flag_for("EPressure", "BGK")
+    settings = {"nu": 0.05, "Velocity": 0.03, **extra}
+    if "GravitationX" in m.setting_index:
+        settings["GravitationX"] = 1e-6
+    lat = Lattice(m, (ny, nx), dtype=jnp.float32, settings=settings)
+    coll = "BGK" if "BGK" in m.node_types else "MRT"
+    flags = np.full((ny, nx), m.flag_for(coll), dtype=np.uint16)
+    flags[:, 0] = m.flag_for("WVelocity", coll)
+    flags[:, -1] = m.flag_for("EPressure", coll)
     flags[0, :] = m.flag_for("Wall")
     flags[-1, :] = m.flag_for("Wall")
     flags[ny // 3:2 * ny // 3, nx // 8:nx // 4] = m.flag_for("Wall")

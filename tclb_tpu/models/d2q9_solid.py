@@ -196,8 +196,9 @@ def run(ctx: NodeCtx) -> dict:
              _refill_w(g, t_in), _refill_w(h, c_in)),
             ("WPressure", _zou_he_x(f, den, "pressure", "W"),
              _refill_w(g, t_in), _refill_w(h, c_in)),
-            ("EVelocity", _zou_he_x(f, vel, "velocity", "E"),
-             _refill_e(g), _refill_e(h)),
+            # reference EVelocity touches only f (Dynamics.c.Rt:168-177);
+            # g/h pass through unchanged.
+            ("EVelocity", _zou_he_x(f, vel, "velocity", "E"), g, h),
             ("EPressure", _zou_he_x(f, 1.0, "pressure", "E"),
              _refill_e(g), _refill_e(h))):
         m = ctx.nt_is(name)
@@ -252,10 +253,13 @@ def run(ctx: NodeCtx) -> dict:
     coll = ctx.nt_in_group("COLLISION")
     feq = _eq(rho, ux, uy)
     fc = kf * (f - feq) + _eq(rho, ux + ax, uy + ay)
+    # g/h are emitted after `ux -= ax/2` in the reference
+    # (Dynamics.c.Rt:371-388), so BOTH the relaxed non-equilibrium and the
+    # re-added equilibrium ride the midpoint velocity u + a/2.
     uxm, uym = ux + 0.5 * ax, uy + 0.5 * ay
-    geq = _eq(rhoT, ux, uy)
+    geq = _eq(rhoT, uxm, uym)
     gc = kt * (g - geq) + _eq(rhoT + q_force, uxm, uym)
-    heq = _eq(c, ux, uy)
+    heq = _eq(c, uxm, uym)
     hc = kc[None] * (h - heq) + _eq(c + dc, uxm, uym)
 
     f = jnp.where(coll[None], fc, f)

@@ -204,8 +204,6 @@ def make_sharded_pallas_iterate(model: Model, mesh: Mesh, shape,
     if shape[0] % n:
         return None
     local = (shape[0] // n,) + tuple(shape[1:])
-    fwd = [(i, (i + 1) % n) for i in range(n)]
-    bwd = [(i, (i - 1) % n) for i in range(n)]
 
     if model.ndim == 2:
         if local[0] % 8 or not pallas_d2q9.supports(model, local, dtype):

@@ -583,6 +583,8 @@ class Lattice:
         self._fast = None
         self._fast_name = None
         self._fast_tried = False
+        self._fast_probing = False
+        self._fast_cfg = (1, None)
 
     # -- setup -------------------------------------------------------------- #
 
@@ -713,6 +715,27 @@ class Lattice:
             return (pallas_d3q.make_pallas_iterate(
                 self.model, self.shape, self.dtype, present=present),
                 f"pallas_d3q[{self.model.name}]")
+        from tclb_tpu.ops import pallas_generic
+        if (pallas_generic.supports(self.model, self.shape, self.dtype)
+                and pallas_generic.mosaic_ok(self.model, self.shape)):
+            from tclb_tpu.ops.lbm import present_types
+            present = present_types(self.model, self._flags_host())
+            self._fast_probing = True   # first call may still hit a Mosaic
+            cfg = pallas_generic.get_build_cfg(self.model, self.shape)
+            if cfg is not None:
+                fz, cap = cfg
+            else:
+                # temporal fusion halves traffic but doubles the in-band
+                # reach; deep-stencil models (lee: reach 6/step) must
+                # stay at fuse=1
+                fz = 2 if pallas_generic.action_plan(
+                    self.model, fuse=2)[1] <= pallas_generic.HALO else 1
+                cap = None
+            self._fast_cfg = (fz, cap)
+            return (pallas_generic.make_pallas_iterate(  # lowering gap
+                self.model, self.shape, self.dtype, fuse=fz,
+                present=present, by_cap=cap),
+                f"pallas_generic[{self.model.name},fuse={fz}]")
         return None, None
 
     def _fast_path(self):
@@ -746,7 +769,68 @@ class Lattice:
             # The reference accumulates globals inside the same hot kernel
             # (src/cuda.cu.Rt:176-202); here the trailing step plays that
             # role at 1/niter amortized cost.
-            self.state = fast(self.state, self.params, niter - 1)
+            if self._fast_probing:
+                # the generic engine's trace probe cannot see Mosaic
+                # lowering gaps (e.g. a model using arccos) or
+                # scoped-VMEM overflows — those only surface at first
+                # TPU compile.  Probe on a COPY of the state (the
+                # engines donate their input; a failure that happens at
+                # execution rather than compile would otherwise leave
+                # the real state's buffers deleted), retry down a
+                # smaller-band/no-fusion ladder, remember the verdict
+                # process-wide, and fall back to XLA if nothing fits.
+                from tclb_tpu.ops import pallas_generic
+                from tclb_tpu.utils import log
+
+                def attempt(it_fn):
+                    probe = jax.tree.map(jnp.copy, self.state)
+                    return it_fn(probe, self.params, niter - 1)
+
+                try:
+                    self.state = attempt(fast)
+                except Exception as e:  # noqa: BLE001
+                    log.debug(f"engine: {self._fast_name} first compile "
+                              f"failed ({type(e).__name__}); trying "
+                              "smaller bands")
+                    from tclb_tpu.ops.lbm import present_types
+                    present = present_types(self.model, self._flags_host())
+                    fz0, _ = self._fast_cfg
+                    ladder = [(fz0, 16), (fz0, 8)]
+                    if fz0 == 2:
+                        ladder += [(1, 16), (1, 8)]
+                    ladder = [c for c in ladder if c != self._fast_cfg]
+                    for fz, cap in ladder:
+                        try:
+                            it2 = pallas_generic.make_pallas_iterate(
+                                self.model, self.shape, self.dtype,
+                                fuse=fz, present=present, by_cap=cap)
+                            self.state = attempt(it2)
+                        except Exception:  # noqa: BLE001
+                            continue
+                        self._fast = fast = it2
+                        self._fast_cfg = (fz, cap)
+                        self._fast_name = (f"pallas_generic"
+                                           f"[{self.model.name},fuse={fz},"
+                                           f"by<={cap}]")
+                        break
+                    else:
+                        log.info(f"engine: {self._fast_name} failed to "
+                                 f"compile ({type(e).__name__}); XLA "
+                                 "fallback")
+                        pallas_generic.set_mosaic_ok(self.model,
+                                                     self.shape, False)
+                        self._fast = fast = None
+                        self._fast_name = None
+                        self._fast_probing = False
+                        self.state = self._iterate(self.state, self.params,
+                                                   niter)
+                        return
+                pallas_generic.set_mosaic_ok(self.model, self.shape, True)
+                pallas_generic.set_build_cfg(self.model, self.shape,
+                                             *self._fast_cfg)
+                self._fast_probing = False
+            else:
+                self.state = fast(self.state, self.params, niter - 1)
             self.state = self._iterate(self.state, self.params, 1)
         else:
             self.state = self._iterate(self.state, self.params, niter)

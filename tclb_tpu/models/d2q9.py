@@ -118,8 +118,8 @@ def _symmetry(f, top: bool):
 def _collision_mrt(ctx: NodeCtx, f: jnp.ndarray):
     dt = f.dtype
     rho = jnp.sum(f, axis=0)
-    jx = jnp.tensordot(jnp.asarray(E[:, 0], dt), f, axes=1)
-    jy = jnp.tensordot(jnp.asarray(E[:, 1], dt), f, axes=1)
+    jx = lbm.edot(E[:, 0], f)
+    jy = lbm.edot(E[:, 1], f)
     ux, uy = jx / rho, jy / rho
 
     # objectives on Inlet/Outlet-tagged collision nodes
@@ -135,17 +135,17 @@ def _collision_mrt(ctx: NodeCtx, f: jnp.ndarray):
                    where=(ctx.nt_is("Inlet") | ctx.nt_is("Outlet")) & mrt)
 
     # relax the non-equilibrium moments with pre-force velocity ...
-    omega_m = jnp.stack([jnp.zeros((), dt), jnp.zeros((), dt),
-                         jnp.zeros((), dt),
-                         ctx.setting("S3").astype(dt),
-                         ctx.setting("S4").astype(dt),
-                         ctx.setting("S56").astype(dt),
-                         ctx.setting("S56").astype(dt),
-                         ctx.setting("S78").astype(dt),
-                         ctx.setting("S78").astype(dt)])
+    # per-plane scalar rates (a stacked-then-reshaped (9,) settings
+    # vector is a shape cast Mosaic cannot lower); conserved moments
+    # relax at rate 0 and drop out exactly
+    rates = [None, None, None,
+             ctx.setting("S3"), ctx.setting("S4"),
+             ctx.setting("S56"), ctx.setting("S56"),
+             ctx.setting("S78"), ctx.setting("S78")]
     feq = _equilibrium(rho, ux, uy)
-    m_neq = lbm.moments(M, f - feq) * omega_m.reshape(
-        (9,) + (1,) * (f.ndim - 1))
+    mn = lbm.moments(M, f - feq)
+    m_neq = jnp.stack([jnp.zeros_like(mn[i]) if r is None else mn[i] * r
+                       for i, r in enumerate(rates)])
     # ... then shift velocity by the body force (exact-difference style
     # forcing, reference src/d2q9/Dynamics.c.Rt:279-285) and add the
     # post-force equilibrium moments back
@@ -161,7 +161,7 @@ def run(ctx: NodeCtx) -> jnp.ndarray:
     vel = ctx.setting("Velocity")
     den = ctx.setting("Density")
     f = ctx.boundary_case(f, {
-        ("Wall", "Solid"): lambda f: f[jnp.asarray(OPP)],
+        ("Wall", "Solid"): lambda f: lbm.perm(f, OPP),
         "EVelocity": lambda f: _zou_he_x(f, vel, "velocity", "E"),
         "WPressure": lambda f: _zou_he_x(f, den, "pressure", "W"),
         "WVelocity": lambda f: _zou_he_x(f, vel, "velocity", "W"),
@@ -192,8 +192,8 @@ def get_u(ctx: NodeCtx) -> jnp.ndarray:
     f = ctx.group("f")
     dt = f.dtype
     rho = jnp.sum(f, axis=0)
-    ux = jnp.tensordot(jnp.asarray(E[:, 0], dt), f, axes=1) / rho
-    uy = jnp.tensordot(jnp.asarray(E[:, 1], dt), f, axes=1) / rho
+    ux = lbm.edot(E[:, 0], f) / rho
+    uy = lbm.edot(E[:, 1], f) / rho
     # measured velocity includes half the body force
     # (reference src/d2q9/Dynamics.c.Rt:43-49)
     ux = ux + ctx.density("BC[0]") * 0.5 + ctx.setting("GravitationX") * 0.5

@@ -65,8 +65,8 @@ def _def() -> ModelDef:
 def _collision_mrt(ctx: NodeCtx, f: jnp.ndarray, w: jnp.ndarray):
     dt = f.dtype
     rho = jnp.sum(f, axis=0)
-    ux = jnp.tensordot(jnp.asarray(E[:, 0], dt), f, axes=1) / rho
-    uy = jnp.tensordot(jnp.asarray(E[:, 1], dt), f, axes=1) / rho
+    ux = lbm.edot(E[:, 0], f) / rho
+    uy = lbm.edot(E[:, 1], f) / rho
 
     usq = ux * ux + uy * uy
     ploss = ux / rho * ((rho - 1.0) / 3.0 + usq / rho * 0.5)
@@ -79,11 +79,15 @@ def _collision_mrt(ctx: NodeCtx, f: jnp.ndarray, w: jnp.ndarray):
     # keep-factors: energy -1/3, heat-flux/stress relax with omega
     # (reference OMEGA vector, src/d2q9_adj/Dynamics.c.Rt:137)
     om = ctx.setting("omega").astype(dt)
-    zero = jnp.zeros((), dt)
-    keep = jnp.stack([zero, zero, zero, jnp.asarray(-1 / 3, dt), zero,
-                      zero, zero, om, om])
     feq = _equilibrium(rho, ux, uy)
-    m_neq = lbm.moments(M, f - feq) * keep.reshape((9,) + (1,) * (f.ndim - 1))
+    mn = lbm.moments(M, f - feq)
+    # per-plane scalar keep factors (a stacked-then-reshaped (9,)
+    # settings vector is a shape cast Mosaic cannot lower)
+    keep = [0.0, 0.0, 0.0, -1.0 / 3.0, 0.0, 0.0, 0.0, om, om]
+    m_neq = jnp.stack([mn[i] * keep[i] if not isinstance(keep[i], float)
+                       else (keep[i] * mn[i] if keep[i] else
+                             jnp.zeros_like(mn[i]))
+                       for i in range(9)])
 
     ux2 = ux + ctx.setting("ForceX")
     uy2 = uy + ctx.setting("ForceY")
@@ -103,7 +107,7 @@ def run(ctx: NodeCtx) -> jnp.ndarray:
     vel = ctx.setting("Velocity")
     den = 1.0 + 3.0 * ctx.setting("Pressure")
     f = ctx.boundary_case(f, {
-        ("Wall", "Solid"): lambda f: f[jnp.asarray(OPP)],
+        ("Wall", "Solid"): lambda f: lbm.perm(f, OPP),
         "EVelocity": lambda f: _zou_he_x(f, vel, "velocity", "E"),
         "WPressure": lambda f: _zou_he_x(f, den, "pressure", "W"),
         "WVelocity": lambda f: _zou_he_x(f, vel, "velocity", "W"),
@@ -138,8 +142,8 @@ def get_u(ctx: NodeCtx) -> jnp.ndarray:
     f = ctx.group("f")
     dt = f.dtype
     rho = jnp.sum(f, axis=0)
-    ux = jnp.tensordot(jnp.asarray(E[:, 0], dt), f, axes=1) / rho
-    uy = jnp.tensordot(jnp.asarray(E[:, 1], dt), f, axes=1) / rho
+    ux = lbm.edot(E[:, 0], f) / rho
+    uy = lbm.edot(E[:, 1], f) / rho
     return jnp.stack([ux, uy, jnp.zeros_like(ux)])
 
 

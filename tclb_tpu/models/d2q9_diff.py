@@ -51,7 +51,7 @@ def run(ctx: NodeCtx) -> jnp.ndarray:
     f = ctx.group("f")
     w = ctx.density("w")
     f = ctx.boundary_case(f, {
-        ("Wall", "Solid"): lambda f: f[jnp.asarray(OPP)],
+        ("Wall", "Solid"): lambda f: lbm.perm(f, OPP),
     })
     c = jnp.sum(f, axis=0)
     ux = ctx.setting("UX")

@@ -41,13 +41,13 @@ def _def():
 def _neq_stress(ctx: NodeCtx, f: jnp.ndarray):
     dt = f.dtype
     rho = jnp.sum(f, axis=0)
-    ux = jnp.tensordot(jnp.asarray(E[:, 0], dt), f, axes=1) / rho
-    uy = jnp.tensordot(jnp.asarray(E[:, 1], dt), f, axes=1) / rho
+    ux = lbm.edot(E[:, 0], f) / rho
+    uy = lbm.edot(E[:, 1], f) / rho
     feq = lbm.equilibrium(E, W, rho, (ux, uy))
     fneq = f - feq
-    qxx = jnp.tensordot(jnp.asarray(E[:, 0] * E[:, 0], dt), fneq, axes=1)
-    qxy = jnp.tensordot(jnp.asarray(E[:, 0] * E[:, 1], dt), fneq, axes=1)
-    qyy = jnp.tensordot(jnp.asarray(E[:, 1] * E[:, 1], dt), fneq, axes=1)
+    qxx = lbm.edot(E[:, 0] * E[:, 0], fneq)
+    qxy = lbm.edot(E[:, 0] * E[:, 1], fneq)
+    qyy = lbm.edot(E[:, 1] * E[:, 1], fneq)
     ss = jnp.sqrt(qxx * qxx + 2.0 * qxy * qxy + qyy * qyy)
     return qxx, qxy, qyy, ss
 

@@ -67,7 +67,7 @@ def run(ctx: NodeCtx, solid_adiabatic: bool = True) -> jnp.ndarray:
     t_in = ctx.setting("InletTemperature")
 
     f = ctx.boundary_case(f, {
-        ("Wall", "Solid"): lambda f: f[jnp.asarray(OPP)],
+        ("Wall", "Solid"): lambda f: lbm.perm(f, OPP),
         "WVelocity": lambda f: _zou_he_x(f, vel, "velocity", "W"),
         "EVelocity": lambda f: _zou_he_x(f, vel, "velocity", "E"),
         "WPressure": lambda f: _zou_he_x(f, den, "pressure", "W"),
@@ -82,15 +82,15 @@ def run(ctx: NodeCtx, solid_adiabatic: bool = True) -> jnp.ndarray:
     # flux continuity.
     t_wall = ("Wall", "Solid") if solid_adiabatic else ("Wall",)
     fT = ctx.boundary_case(fT, {
-        t_wall: lambda t: t[jnp.asarray(OPP)],
+        t_wall: lambda t: lbm.perm(t, OPP),
         ("WVelocity", "EPressure"): lambda t: _t_eq(
             jnp.broadcast_to(t_in, t.shape[1:]).astype(dt),
             jnp.zeros(t.shape[1:], dt), jnp.zeros(t.shape[1:], dt)),
     })
 
     rho = jnp.sum(f, axis=0)
-    ux = jnp.tensordot(jnp.asarray(E[:, 0], dt), f, axes=1) / rho
-    uy = jnp.tensordot(jnp.asarray(E[:, 1], dt), f, axes=1) / rho
+    ux = lbm.edot(E[:, 0], f) / rho
+    uy = lbm.edot(E[:, 1], f) / rho
 
     om = ctx.setting("omega")
     feq = lbm.equilibrium(E, W, rho, (ux, uy))
@@ -134,8 +134,8 @@ def get_u(ctx):
     f = ctx.group("f")
     dt = f.dtype
     rho = jnp.sum(f, axis=0)
-    ux = jnp.tensordot(jnp.asarray(E[:, 0], dt), f, axes=1) / rho
-    uy = jnp.tensordot(jnp.asarray(E[:, 1], dt), f, axes=1) / rho
+    ux = lbm.edot(E[:, 0], f) / rho
+    uy = lbm.edot(E[:, 1], f) / rho
     return jnp.stack([ux, uy, jnp.zeros_like(ux)])
 
 

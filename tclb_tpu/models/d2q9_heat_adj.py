@@ -62,20 +62,20 @@ def run(ctx: NodeCtx) -> jnp.ndarray:
     t_in = ctx.setting("InletTemperature")
 
     f = ctx.boundary_case(f, {
-        ("Wall", "Solid"): lambda f: f[jnp.asarray(OPP)],
+        ("Wall", "Solid"): lambda f: lbm.perm(f, OPP),
         "WVelocity": lambda f: _zou_he_x(f, vel, "velocity", "W"),
         "EPressure": lambda f: _zou_he_x(f, den, "pressure", "E"),
     })
     fT = ctx.boundary_case(fT, {
-        ("Wall", "Solid"): lambda t: t[jnp.asarray(OPP)],
+        ("Wall", "Solid"): lambda t: lbm.perm(t, OPP),
         "WVelocity": lambda t: _t_eq(
             jnp.broadcast_to(t_in, t.shape[1:]).astype(dt),
             jnp.zeros(t.shape[1:], dt), jnp.zeros(t.shape[1:], dt)),
     })
 
     rho = jnp.sum(f, axis=0)
-    ux = jnp.tensordot(jnp.asarray(E[:, 0], dt), f, axes=1) / rho
-    uy = jnp.tensordot(jnp.asarray(E[:, 1], dt), f, axes=1) / rho
+    ux = lbm.edot(E[:, 0], f) / rho
+    uy = lbm.edot(E[:, 1], f) / rho
 
     om = ctx.setting("omega")
     feq = lbm.equilibrium(E, W, rho, (ux, uy))

@@ -47,8 +47,8 @@ def run(ctx: NodeCtx) -> jnp.ndarray:
     family.add_flux_objectives(ctx, f, E)
     dt = f.dtype
     rho = jnp.sum(f, axis=0)
-    ux = jnp.tensordot(jnp.asarray(E[:, 0], dt), f, axes=1) / RHO0
-    uy = jnp.tensordot(jnp.asarray(E[:, 1], dt), f, axes=1) / RHO0
+    ux = lbm.edot(E[:, 0], f) / RHO0
+    uy = lbm.edot(E[:, 1], f) / RHO0
     om = ctx.setting("omega")
     feq = _inc_equilibrium(rho, ux, uy)
     fc = f + om * (feq - f)
@@ -69,8 +69,8 @@ def init(ctx: NodeCtx) -> jnp.ndarray:
 def get_u(ctx: NodeCtx) -> jnp.ndarray:
     f = ctx.group("f")
     dt = f.dtype
-    ux = jnp.tensordot(jnp.asarray(E[:, 0], dt), f, axes=1) / RHO0
-    uy = jnp.tensordot(jnp.asarray(E[:, 1], dt), f, axes=1) / RHO0
+    ux = lbm.edot(E[:, 0], f) / RHO0
+    uy = lbm.edot(E[:, 1], f) / RHO0
     gx, gy = family.gravity_of(ctx)
     return jnp.stack([ux + 0.5 * gx, uy + 0.5 * gy, jnp.zeros_like(ux)])
 

@@ -116,8 +116,8 @@ def _force(ctx: NodeCtx, f: jnp.ndarray):
     scale = ctx.setting("MagicF")
     fx, fy = scale * fx, scale * fy
     # wall momentum term (reference :60-66) + wall force objectives
-    ex = jnp.tensordot(jnp.asarray(E[:, 0], dt), f, axes=1)
-    ey = jnp.tensordot(jnp.asarray(E[:, 1], dt), f, axes=1)
+    ex = lbm.edot(E[:, 0], f)
+    ey = lbm.edot(E[:, 1], f)
     wall = ctx.nt_is("Wall")
     fx = jnp.where(wall, fx + 2.0 * ex, fx)
     fy = jnp.where(wall, fy + 2.0 * ey, fy)
@@ -133,28 +133,31 @@ def run(ctx: NodeCtx) -> jnp.ndarray:
 
     def moving_wall(f):
         # bounce-back with tangential wall momentum (Ladd correction)
-        fb = f[jnp.asarray(OPP)]
+        fb = lbm.perm(f, OPP)
         corr = jnp.stack([6.0 * float(W[i]) * float(E[i, 0]) * mwv
                           * jnp.ones(f.shape[1:], dt) for i in range(9)])
         return fb + corr
 
     def mirror(perm):
-        return lambda f: f[jnp.asarray(perm)]
+        return lambda f: lbm.perm(f, perm)
 
     from tclb_tpu.models.family import mirror_perm
     f = ctx.boundary_case(f, {
-        ("Wall", "Solid"): lambda f: f[jnp.asarray(OPP)],
+        ("Wall", "Solid"): lambda f: lbm.perm(f, OPP),
         "MovingWall": moving_wall,
         "NSymmetry": mirror(mirror_perm(E, 1)),
         "SSymmetry": mirror(mirror_perm(E, 1)),
     })
 
     rho = jnp.sum(f, axis=0)
-    ux = jnp.tensordot(jnp.asarray(E[:, 0], dt), f, axes=1) / rho
-    uy = jnp.tensordot(jnp.asarray(E[:, 1], dt), f, axes=1) / rho
-    keep = jnp.stack([ctx.setting(f"S{i}") for i in range(9)]).astype(dt)
+    ux = lbm.edot(E[:, 0], f) / rho
+    uy = lbm.edot(E[:, 1], f) / rho
     feq = _equilibrium(rho, ux, uy)
-    m_neq = lbm.moments(M, f - feq) * keep.reshape((9,) + (1,) * (f.ndim - 1))
+    mn = lbm.moments(M, f - feq)
+    # per-plane scalar keep factors (a stacked-then-reshaped (9,)
+    # settings vector is a shape cast Mosaic cannot lower)
+    m_neq = jnp.stack([mn[i] * ctx.setting(f"S{i}")
+                       for i in range(9)])
     fx, fy = _force(ctx, f)
     ux2 = ux + fx / rho + ctx.setting("GravitationX")
     uy2 = uy + fy / rho + ctx.setting("GravitationY")
@@ -177,8 +180,8 @@ def get_u(ctx):
     f = ctx.group("f")
     dt = f.dtype
     rho = jnp.sum(f, axis=0)
-    ux = jnp.tensordot(jnp.asarray(E[:, 0], dt), f, axes=1) / rho
-    uy = jnp.tensordot(jnp.asarray(E[:, 1], dt), f, axes=1) / rho
+    ux = lbm.edot(E[:, 0], f) / rho
+    uy = lbm.edot(E[:, 1], f) / rho
     return jnp.stack([ux, uy, jnp.zeros_like(ux)])
 
 

@@ -179,8 +179,8 @@ def _fill(ctx: NodeCtx, f):
     """d, u (with the half-central-force shift) and the projections."""
     dt = f.dtype
     d = jnp.sum(f, axis=0)
-    jx = jnp.tensordot(jnp.asarray(E[:, 0], dt), f, axes=1)
-    jy = jnp.tensordot(jnp.asarray(E[:, 1], dt), f, axes=1)
+    jx = lbm.edot(E[:, 0], f)
+    jy = lbm.edot(E[:, 1], f)
     u_bare = (jx / d, jy / d)
     fB, fC = _projections(ctx, u_bare, d)
     fcx, fcy = _vec_of(fC)
@@ -240,7 +240,7 @@ def run(ctx: NodeCtx) -> jnp.ndarray:
         return lbm.equilibrium(E, W, rho2, (ux, jnp.zeros(shape, f.dtype)))
 
     f = ctx.boundary_case(f, {
-        ("Wall", "Solid"): lambda f: f[jnp.asarray(OPP)],
+        ("Wall", "Solid"): lambda f: lbm.perm(f, OPP),
         "MovingWall": moving_wall,
         "EVelocity": lambda f: _zou_he_x(f, vel, "velocity", "E"),
         "WPressure": lambda f: _zou_he_x(f, ctx.setting("InletDensity"),

@@ -33,10 +33,10 @@ def run(ctx: NodeCtx) -> jnp.ndarray:
     family.add_flux_objectives(ctx, f, E)
     dt = f.dtype
     rho = jnp.sum(f, axis=0)
-    ux = jnp.tensordot(jnp.asarray(E[:, 0], dt), f, axes=1) / rho
-    uy = jnp.tensordot(jnp.asarray(E[:, 1], dt), f, axes=1) / rho
+    ux = lbm.edot(E[:, 0], f) / rho
+    uy = lbm.edot(E[:, 1], f) / rho
     feq = lbm.equilibrium(E, W, rho, (ux, uy))
-    om_eff = lbm.smagorinsky_omega(E, f, feq, rho, ctx.setting("omega"),
+    om_eff = lbm.smagorinsky_omega_unrolled(E, f, feq, rho, ctx.setting("omega"),
                                    ctx.setting("Smag"))
     fc = f + om_eff[None] * (feq - f)
     gx, gy = family.gravity_of(ctx)

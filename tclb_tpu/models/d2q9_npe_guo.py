@@ -126,22 +126,20 @@ def run(ctx: NodeCtx) -> jnp.ndarray:
     n_inf_1 = ctx.setting("n_inf_1")
     psi_bc = ctx.setting("psi_bc")
     phi_bc = ctx.setting("phi_bc")
-    wi = jnp.asarray(W, dt).reshape((9,) + (1,) * (s.ndim - 1))
-    wp = jnp.asarray(WP, dt).reshape((9,) + (1,) * (s.ndim - 1))
     full = s.shape[1:]
 
-    def _b(x):
-        return jnp.broadcast_to(x, (9,) + full)
+    def _plane(x):
+        return jnp.broadcast_to(x, full).astype(dt)
 
     def wall(stack):
         phi_, g_, f_, h0_, h1_ = (stack[9 * i:9 * i + 9] for i in range(5))
-        f_ = f_[jnp.asarray(OPP)]
-        phi_ = phi_[jnp.asarray(OPP)]
-        g_ = _b(wp * psi_bc)
-        h0_ = _b(n_inf_0 * wi * jnp.exp(-ctx.setting("ez") * psi_bc
-                                        * ctx.setting("el_kbT")))
-        h1_ = _b(n_inf_1 * wi * jnp.exp(ctx.setting("ez") * psi_bc
-                                        * ctx.setting("el_kbT")))
+        f_ = lbm.perm(f_, OPP)
+        phi_ = lbm.perm(phi_, OPP)
+        g_ = lbm.wstack(WP, _plane(psi_bc))
+        h0_ = lbm.wstack(W, _plane(n_inf_0 * jnp.exp(
+            -ctx.setting("ez") * psi_bc * ctx.setting("el_kbT"))))
+        h1_ = lbm.wstack(W, _plane(n_inf_1 * jnp.exp(
+            ctx.setting("ez") * psi_bc * ctx.setting("el_kbT"))))
         return jnp.concatenate([phi_, g_, f_, h0_, h1_])
 
     def pressure(stack, side):
@@ -149,10 +147,10 @@ def run(ctx: NodeCtx) -> jnp.ndarray:
         phi_, g_, f_, h0_, h1_ = (stack[9 * i:9 * i + 9] for i in range(5))
         rho_b = ctx.setting("rho_bc") if side == "W" else 1.0
         f_ = _zou_he_x(f_, rho_b, "pressure", side)
-        g_ = g_[jnp.asarray(OPP)]
-        h0_ = _b(n_inf_0 * wi)
-        h1_ = _b(n_inf_1 * wi)
-        phi_ = _b(wp * phi_bc)
+        g_ = lbm.perm(g_, OPP)
+        h0_ = lbm.wstack(W, _plane(n_inf_0))
+        h1_ = lbm.wstack(W, _plane(n_inf_1))
+        phi_ = lbm.wstack(WP, _plane(phi_bc))
         return jnp.concatenate([phi_, g_, f_, h0_, h1_])
 
     def symmetry(stack, top):
@@ -182,8 +180,8 @@ def run(ctx: NodeCtx) -> jnp.ndarray:
     # ---------------- collision (reference CollisionBGK :241-317) ------- #
     rho, n0, n1, psi, pot, rho_e, gpsi, force = _macro(
         ctx, f, g, phi, h0, h1)
-    ux = jnp.tensordot(jnp.asarray(E[:, 0], dt), f, axes=1) / rho
-    uy = jnp.tensordot(jnp.asarray(E[:, 1], dt), f, axes=1) / rho
+    ux = lbm.edot(E[:, 0], f) / rho
+    uy = lbm.edot(E[:, 1], f) / rho
     # measured velocity (with half-force) enters the ion equilibria
     umx = ux + force[0] * 0.5
     umy = uy + force[1] * 0.5
@@ -207,7 +205,7 @@ def run(ctx: NodeCtx) -> jnp.ndarray:
 
     gc = _guo_collide(g, psi, rho_e, TAU_PSI, ctx.setting("dt"),
                       ctx.setting("epsilon"))
-    phic = phi - (phi - wp * pot) / TAU_PHI
+    phic = phi - (phi - lbm.wstack(WP, pot)) / TAU_PHI
 
     omega = 1.0 / (3.0 * ctx.setting("nu") + 0.5)
     feq = lbm.equilibrium(E, W, rho, (ux, uy))
@@ -250,8 +248,8 @@ def _q(fn):
 def build():
     def u_of(ctx, rho, n0, n1, psi, pot, rho_e, gpsi, force, f):
         dt = f.dtype
-        ux = jnp.tensordot(jnp.asarray(E[:, 0], dt), f, axes=1) / rho
-        uy = jnp.tensordot(jnp.asarray(E[:, 1], dt), f, axes=1) / rho
+        ux = lbm.edot(E[:, 0], f) / rho
+        uy = lbm.edot(E[:, 1], f) / rho
         return jnp.stack([ux + 0.5 * force[0], uy + 0.5 * force[1],
                           jnp.zeros_like(ux)])
 

@@ -66,7 +66,7 @@ def run(ctx: NodeCtx) -> jnp.ndarray:
     mwv = ctx.setting("MovingWallVelocity")
 
     def moving_wall(f):
-        fb = f[jnp.asarray(OPP)]
+        fb = lbm.perm(f, OPP)
         corr = jnp.stack([
             6.0 * float(W[i]) * float(E[i, 0]) * mwv
             * jnp.ones(f.shape[1:], dt) if E[i, 0] else
@@ -74,16 +74,16 @@ def run(ctx: NodeCtx) -> jnp.ndarray:
         return fb + corr
 
     f = ctx.boundary_case(f, {
-        ("Wall", "Solid"): lambda f: f[jnp.asarray(OPP)],
+        ("Wall", "Solid"): lambda f: lbm.perm(f, OPP),
         "MovingWall": moving_wall,
     })
     g = ctx.boundary_case(g, {
-        ("Wall", "Solid", "MovingWall"): lambda g: g[jnp.asarray(OPPG)],
+        ("Wall", "Solid", "MovingWall"): lambda g: lbm.perm(g, OPPG),
     })
 
     rho = jnp.sum(f, axis=0)
-    ux = jnp.tensordot(jnp.asarray(E[:, 0], dt), f, axes=1) / rho
-    uy = jnp.tensordot(jnp.asarray(E[:, 1], dt), f, axes=1) / rho
+    ux = lbm.edot(E[:, 0], f) / rho
+    uy = lbm.edot(E[:, 1], f) / rho
     fc = f + ctx.setting("omega") * (lbm.equilibrium(E, W, rho, (ux, uy)) - f)
     temp = jnp.sum(g, axis=0)
     gc = g + ctx.setting("omegaT") * (_g_eq(temp, ux, uy) - g)
@@ -96,7 +96,7 @@ def run(ctx: NodeCtx) -> jnp.ndarray:
     where = ctx.nt_in_group("COLLISION")
     ctx.add_global("TotalTempSqr", temp * temp, where=where)
     ctx.add_global("CountCells", jnp.ones_like(temp), where=where)
-    ex = jnp.tensordot(jnp.asarray(E[:, 0], dt), f, axes=1)
+    ex = lbm.edot(E[:, 0], f)
     ctx.add_global("NMovingWallForce", 2.0 * ex * mwv,
                    where=ctx.nt_is("MovingWall"))
     return ctx.store({"f": f, "g": g})

@@ -57,12 +57,14 @@ def _heq(pf, n, u, bh):
     """h equilibrium: advected phase field + sharpening flux along the
     interface normal (reference Heq, src/d2q9_pf/Dynamics.c.Rt:44-46)."""
     base = lbm.equilibrium(E, W, pf, u)
-    dt = pf.dtype
-    en = jnp.stack([jnp.asarray(float(E[i, 0]), dt) * n[0]
-                    + jnp.asarray(float(E[i, 1]), dt) * n[1]
-                    for i in range(9)])
-    wi = jnp.asarray(W, dt).reshape((9,) + (1,) * pf.ndim)
-    return base + bh * wi * en
+    # unrolled with scalar coefficients (kernel-safe: no captured
+    # constant arrays), skipping the zero e.n terms
+    out = []
+    for i in range(9):
+        en = sum(float(E[i, a]) * n[a] for a in range(2) if E[i, a])
+        out.append(base[i] if isinstance(en, int)
+                   else base[i] + bh * float(W[i]) * en)
+    return jnp.stack(out)
 
 
 def _normal(h, u):
@@ -71,8 +73,8 @@ def _normal(h, u):
     n = -k/|k| (zero where |k| vanishes)."""
     dt = h.dtype
     pf = jnp.sum(h, axis=0)
-    k10 = jnp.tensordot(jnp.asarray(E[:, 0], dt), h, axes=1) - pf * u[0]
-    k01 = jnp.tensordot(jnp.asarray(E[:, 1], dt), h, axes=1) - pf * u[1]
+    k10 = lbm.edot(E[:, 0], h) - pf * u[0]
+    k01 = lbm.edot(E[:, 1], h) - pf * u[1]
     ln = jnp.sqrt(k10 * k10 + k01 * k01)
     safe = jnp.where(ln > 0, ln, 1.0)
     return (jnp.where(ln > 0, -k10 / safe, 0.0),
@@ -95,7 +97,7 @@ def _boundaries(ctx: NodeCtx, fh: jnp.ndarray) -> jnp.ndarray:
         return apply
 
     return ctx.boundary_case(fh, {
-        ("Wall", "Solid"): lambda s: s[jnp.asarray(OPP18)],
+        ("Wall", "Solid"): lambda s: lbm.perm(s, OPP18),
         "EVelocity": zou("velocity", "E"),
         "WPressure": zou("pressure", "W"),
         "WVelocity": zou("velocity", "W"),
@@ -114,8 +116,8 @@ def run(ctx: NodeCtx) -> jnp.ndarray:
     # src/d2q9_pf/Dynamics.c.Rt:189-225: equal S on every order makes the
     # orthonormal basis immaterial)
     rho = jnp.sum(f, axis=0)
-    ux = jnp.tensordot(jnp.asarray(E[:, 0], dt), f, axes=1) / rho
-    uy = jnp.tensordot(jnp.asarray(E[:, 1], dt), f, axes=1) / rho
+    ux = lbm.edot(E[:, 0], f) / rho
+    uy = lbm.edot(E[:, 1], f) / rho
     gx = ctx.setting("GravitationX")
     gy = ctx.setting("GravitationY")
     omega = ctx.setting("omega")
@@ -155,8 +157,8 @@ def get_u(ctx: NodeCtx) -> jnp.ndarray:
     f = ctx.group("f")
     dt = f.dtype
     rho = jnp.sum(f, axis=0)
-    ux = jnp.tensordot(jnp.asarray(E[:, 0], dt), f, axes=1) / rho
-    uy = jnp.tensordot(jnp.asarray(E[:, 1], dt), f, axes=1) / rho
+    ux = lbm.edot(E[:, 0], f) / rho
+    uy = lbm.edot(E[:, 1], f) / rho
     return jnp.stack([ux, uy, jnp.zeros_like(ux)])
 
 
@@ -165,8 +167,8 @@ def get_normal(ctx: NodeCtx) -> jnp.ndarray:
     h = ctx.group("h")
     dt = f.dtype
     rho = jnp.sum(f, axis=0)
-    u = (jnp.tensordot(jnp.asarray(E[:, 0], dt), f, axes=1) / rho,
-         jnp.tensordot(jnp.asarray(E[:, 1], dt), f, axes=1) / rho)
+    u = (lbm.edot(E[:, 0], f) / rho,
+         lbm.edot(E[:, 1], f) / rho)
     nx, ny = _normal(h, u)
     return jnp.stack([nx, ny, jnp.zeros_like(nx)])
 

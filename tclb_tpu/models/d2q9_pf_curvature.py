@@ -81,9 +81,9 @@ def calc_phi(ctx: NodeCtx):
     dt = h.dtype
     phi = jnp.sum(h, axis=0)
     ey = E[:, 1]
-    tang = jnp.sum(h[jnp.asarray(np.where(ey == 0)[0])], axis=0)
-    south = tang + 2.0 * jnp.sum(h[jnp.asarray(np.where(ey < 0)[0])], axis=0)
-    north = tang + 2.0 * jnp.sum(h[jnp.asarray(np.where(ey > 0)[0])], axis=0)
+    tang = sum(h[i] for i in range(9) if ey[i] == 0)
+    south = tang + 2.0 * sum(h[i] for i in range(9) if ey[i] < 0)
+    north = tang + 2.0 * sum(h[i] for i in range(9) if ey[i] > 0)
     phi = jnp.where(ctx.nt_is("SSymmetry"), south, phi)
     phi = jnp.where(ctx.nt_is("NSymmetry"), north, phi)
     phi = jnp.where(ctx.nt_is("Wall"), jnp.asarray(SENTINEL, dt), phi)
@@ -179,20 +179,20 @@ def _boundaries(ctx: NodeCtx, fh: jnp.ndarray) -> jnp.ndarray:
                 # WPressure/EPressure, Dynamics.c.Rt:416-437)
                 dt = f.dtype
                 rho = jnp.sum(f, axis=0)
-                ux = jnp.tensordot(jnp.asarray(E[:, 0], dt), f, axes=1) / rho
-                uy = jnp.tensordot(jnp.asarray(E[:, 1], dt), f, axes=1) / rho
+                ux = lbm.edot(E[:, 0], f) / rho
+                uy = lbm.edot(E[:, 1], f) / rho
                 pf = jnp.broadcast_to(pf_set, rho.shape).astype(dt)
                 h = lbm.equilibrium(E, W, pf, (ux, uy))
             return jnp.concatenate([f, h])
         return apply
 
     return ctx.boundary_case(fh, {
-        ("Wall", "Solid"): lambda s: s[jnp.asarray(OPP18)],
+        ("Wall", "Solid"): lambda s: lbm.perm(s, OPP18),
         "EVelocity": zou("velocity", "E", False),
         "WPressure": zou("pressure", "W", True),
         "WVelocity": zou("velocity", "W", False),
         "EPressure": zou("pressure", "E", True),
-        ("NSymmetry", "SSymmetry"): lambda s: s[jnp.asarray(MIRY18)],
+        ("NSymmetry", "SSymmetry"): lambda s: lbm.perm(s, MIRY18),
     })
 
 
@@ -214,8 +214,8 @@ def run(ctx: NodeCtx) -> jnp.ndarray:
     omega_eff = ctx.setting("omega_l") \
         - (pf - 0.5) * (ctx.setting("omega") - ctx.setting("omega_l"))
     rho = jnp.sum(f, axis=0)
-    jx = jnp.tensordot(jnp.asarray(E[:, 0], dt), f, axes=1)
-    jy = jnp.tensordot(jnp.asarray(E[:, 1], dt), f, axes=1)
+    jx = lbm.edot(E[:, 0], f)
+    jy = lbm.edot(E[:, 1], f)
     feq = lbm.equilibrium(E, W, rho, (jx / rho, jy / rho))
     # force enters the momentum directly (J += F, Dynamics.c.Rt:523-525)
     feq2 = lbm.equilibrium(E, W, rho, ((jx + fx) / rho, (jy + fy) / rho))
@@ -243,8 +243,8 @@ def get_u(ctx: NodeCtx) -> jnp.ndarray:
                     jnp.sum(f, axis=0))
     pf = jnp.sum(ctx.group("h"), axis=0)
     fx, fy, _ = _force(ctx, pf)
-    ux = (jnp.tensordot(jnp.asarray(E[:, 0], dt), f, axes=1) + 0.5 * fx) / rho
-    uy = (jnp.tensordot(jnp.asarray(E[:, 1], dt), f, axes=1) + 0.5 * fy) / rho
+    ux = (lbm.edot(E[:, 0], f) + 0.5 * fx) / rho
+    uy = (lbm.edot(E[:, 1], f) + 0.5 * fy) / rho
     return jnp.stack([ux, uy, jnp.zeros_like(ux)])
 
 

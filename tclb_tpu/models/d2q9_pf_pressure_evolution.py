@@ -202,11 +202,12 @@ def _heq(ctx, pf, gamma, n):
     pavg = 0.5 * (ctx.setting("PhaseField_l") + ctx.setting("PhaseField_h"))
     theta = (3.0 * ctx.setting("M")) \
         * (1.0 - 4.0 * (pf - pavg) * (pf - pavg)) / ctx.setting("W")
-    en = jnp.stack([jnp.asarray(float(E[i, 0]), dt) * n[0]
-                    + jnp.asarray(float(E[i, 1]), dt) * n[1]
-                    for i in range(9)])
-    wi = jnp.asarray(W, dt).reshape((9,) + (1,) * pf.ndim)
-    return gamma * pf + theta * wi * en
+    out = []
+    for i in range(9):
+        en = sum(float(E[i, a]) * n[a] for a in range(2) if E[i, a])
+        out.append(gamma[i] * pf if isinstance(en, int)
+                   else gamma[i] * pf + theta * float(W[i]) * en)
+    return jnp.stack(out)
 
 
 # --------------------------------------------------------------------- #
@@ -255,7 +256,7 @@ def run(ctx: NodeCtx) -> jnp.ndarray:
     # only bounce-back walls: the reference's velocity/pressure BC bodies
     # are empty (Dynamics.c.Rt:362-377)
     fh = ctx.boundary_case(fh, {
-        ("Wall", "Solid"): lambda s: s[jnp.asarray(OPP18)],
+        ("Wall", "Solid"): lambda s: lbm.perm(s, OPP18),
     })
     f, h = fh[:9], fh[9:]
     dt = f.dtype
@@ -266,8 +267,8 @@ def run(ctx: NodeCtx) -> jnp.ndarray:
     mu = _mu(ctx)
     fb = _body_force(ctx, rho, pf)
     grad = _grad_phi(ctx)
-    jx = jnp.tensordot(jnp.asarray(E[:, 0], dt), f, axes=1)
-    jy = jnp.tensordot(jnp.asarray(E[:, 1], dt), f, axes=1)
+    jx = lbm.edot(E[:, 0], f)
+    jy = lbm.edot(E[:, 1], f)
     u = ((3.0 / rho) * (jx + (0.5 / 3.0) * (mu * grad[0] + fb[0])),
          (3.0 / rho) * (jy + (0.5 / 3.0) * (mu * grad[1] + fb[1])))
     p = jnp.sum(f, axis=0) \
@@ -277,8 +278,7 @@ def run(ctx: NodeCtx) -> jnp.ndarray:
     gamma = _gamma(u)
     rc = _rc(ctx)
     iface, body = _correction_terms(ctx, gamma, u, grad, fb, mu, rc)
-    wi = jnp.asarray(W, dt).reshape((9,) + (1,) * pf.ndim)
-    g_bar_eq = gamma * rho / 3.0 + wi * (p - rho / 3.0)
+    g_bar_eq = gamma * rho / 3.0 + lbm.wstack(W, p - rho / 3.0)
     r = f - (g_bar_eq - 0.5 * iface - 0.5 * body)
 
     # classical-matrix MRT relaxation with phase-interpolated stress rate
@@ -320,8 +320,8 @@ def get_u(ctx: NodeCtx) -> jnp.ndarray:
     mu = _mu(ctx)
     fb = _body_force(ctx, rho, pf)
     grad = _grad_phi(ctx)
-    jx = jnp.tensordot(jnp.asarray(E[:, 0], dt), f, axes=1)
-    jy = jnp.tensordot(jnp.asarray(E[:, 1], dt), f, axes=1)
+    jx = lbm.edot(E[:, 0], f)
+    jy = lbm.edot(E[:, 1], f)
     ux = (3.0 / rho) * (jx + (0.5 / 3.0) * (mu * grad[0] + fb[0]))
     uy = (3.0 / rho) * (jy + (0.5 / 3.0) * (mu * grad[1] + fb[1]))
     return jnp.stack([ux, uy, jnp.zeros_like(ux)])

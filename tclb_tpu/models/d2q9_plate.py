@@ -38,8 +38,8 @@ def run(ctx: NodeCtx) -> jnp.ndarray:
     # momentum exchange on walls = plate reaction force
     # (reference ForceX/ForceY globals)
     wall = ctx.nt_is("Wall")
-    ex = jnp.tensordot(jnp.asarray(E[:, 0], dt), f, axes=1)
-    ey = jnp.tensordot(jnp.asarray(E[:, 1], dt), f, axes=1)
+    ex = lbm.edot(E[:, 0], f)
+    ey = lbm.edot(E[:, 1], f)
     ctx.add_global("ForceX", 2.0 * ex, where=wall)
     ctx.add_global("ForceY", 2.0 * ey, where=wall)
     vel = ctx.setting("Velocity")
@@ -49,11 +49,11 @@ def run(ctx: NodeCtx) -> jnp.ndarray:
     f = family.apply_boundaries(ctx, f, E, W, OPP)
     family.add_flux_objectives(ctx, f, E)
     rho = jnp.sum(f, axis=0)
-    ux = jnp.tensordot(jnp.asarray(E[:, 0], dt), f, axes=1) / rho
-    uy = jnp.tensordot(jnp.asarray(E[:, 1], dt), f, axes=1) / rho
+    ux = lbm.edot(E[:, 0], f) / rho
+    uy = lbm.edot(E[:, 1], f) / rho
     feq = lbm.equilibrium(E, W, rho, (ux, uy))
     om0 = 1.0 / (3.0 * ctx.setting("nu") + 0.5)
-    om_eff = lbm.smagorinsky_omega(E, f, feq, rho, om0, ctx.setting("Smag"))
+    om_eff = lbm.smagorinsky_omega_unrolled(E, f, feq, rho, om0, ctx.setting("Smag"))
     fc = f + om_eff[None] * (feq - f)
     gx, gy = family.gravity_of(ctx)
     fc = fc + (lbm.equilibrium(E, W, rho, (ux + gx, uy + gy)) - feq)

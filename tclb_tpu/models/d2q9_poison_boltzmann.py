@@ -19,6 +19,7 @@ import numpy as np
 import jax.numpy as jnp
 
 from tclb_tpu.core.lattice import NodeCtx
+from tclb_tpu.ops import lbm
 from tclb_tpu.core.registry import ModelDef
 from tclb_tpu.models.d2q9 import E
 from tclb_tpu.models.guo_poisson import WP, \
@@ -64,9 +65,10 @@ def _rho_e(ctx: NodeCtx, psi):
 def run(ctx: NodeCtx) -> jnp.ndarray:
     g = ctx.group("g")
     dt_ = g.dtype
-    wp = jnp.asarray(WP, dt_).reshape((9,) + (1,) * (g.ndim - 1))
     g = ctx.boundary_case(g, {
-        ("Wall", "Solid"): lambda g: wp * ctx.setting("psi_bc"),
+        ("Wall", "Solid"): lambda g: lbm.wstack(
+            WP, jnp.broadcast_to(ctx.setting("psi_bc"),
+                                 g.shape[1:]).astype(dt_)),
     })
     psi = _psi_of(g)
     rho_e = _rho_e(ctx, psi)
@@ -87,9 +89,8 @@ def calc_subiter(ctx: NodeCtx):
 def init(ctx: NodeCtx) -> jnp.ndarray:
     shape = ctx.flags.shape
     dt_ = ctx._fields.dtype
-    wp = jnp.asarray(WP, dt_).reshape((9,) + (1,) * (len(shape)))
     psi0 = jnp.broadcast_to(ctx.setting("psi0"), shape).astype(dt_)
-    g = wp * psi0[None]
+    g = lbm.wstack(WP, psi0)
     return ctx.store({"g": g, "subiter": jnp.zeros(shape, dt_)})
 
 

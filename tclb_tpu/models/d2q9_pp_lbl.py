@@ -128,8 +128,8 @@ def _collision_bgk(ctx: NodeCtx, f):
     generated' S block is the live one — it overwrites the sympy S)."""
     dt = f.dtype
     rho = jnp.sum(f, axis=0)
-    ux = jnp.tensordot(jnp.asarray(E[:, 0], dt), f, axes=1) / rho
-    uy = jnp.tensordot(jnp.asarray(E[:, 1], dt), f, axes=1) / rho
+    ux = lbm.edot(E[:, 0], f) / rho
+    uy = lbm.edot(E[:, 1], f) / rho
     fx, fy = _force(ctx, rho)
     om = ctx.setting("tempomega")
     g = ctx.setting("G")
@@ -166,7 +166,7 @@ def run(ctx: NodeCtx) -> jnp.ndarray:
         return lbm.equilibrium(E, W, rho, (ux, jnp.zeros(shape, f.dtype)))
 
     f = ctx.boundary_case(f, {
-        ("Wall", "Solid"): lambda f: f[jnp.asarray(OPP)],
+        ("Wall", "Solid"): lambda f: lbm.perm(f, OPP),
         "EVelocity": lambda f: _zou_he_x(f, vel, "velocity", "E"),
         "WPressure": lambda f: _zou_he_x(f, den, "pressure", "W"),
         "WVelocity": _wvel_eq,
@@ -195,8 +195,8 @@ def get_u(ctx: NodeCtx) -> jnp.ndarray:
     dt = f.dtype
     rho = jnp.sum(f, axis=0)
     fx, fy = _force(ctx, rho)
-    ux = (jnp.tensordot(jnp.asarray(E[:, 0], dt), f, axes=1) + 0.5 * fx) / rho
-    uy = (jnp.tensordot(jnp.asarray(E[:, 1], dt), f, axes=1) + 0.5 * fy) / rho
+    ux = (lbm.edot(E[:, 0], f) + 0.5 * fx) / rho
+    uy = (lbm.edot(E[:, 1], f) + 0.5 * fy) / rho
     return jnp.stack([ux, uy, jnp.zeros_like(ux)])
 
 

@@ -126,10 +126,10 @@ def _common_u(ctx: NodeCtx, f, g):
     src/d2q9_pp_MCMP/Dynamics.c.Rt:93-115)."""
     dt = f.dtype
     om_f, om_g = ctx.setting("omega"), ctx.setting("omega_g")
-    jfx = jnp.tensordot(jnp.asarray(E[:, 0], dt), f, axes=1)
-    jfy = jnp.tensordot(jnp.asarray(E[:, 1], dt), f, axes=1)
-    jgx = jnp.tensordot(jnp.asarray(E[:, 0], dt), g, axes=1)
-    jgy = jnp.tensordot(jnp.asarray(E[:, 1], dt), g, axes=1)
+    jfx = lbm.edot(E[:, 0], f)
+    jfy = lbm.edot(E[:, 1], f)
+    jgx = lbm.edot(E[:, 0], g)
+    jgy = lbm.edot(E[:, 1], g)
     rf = jnp.sum(f, axis=0)
     rg = jnp.sum(g, axis=0)
     den = rf / om_f + rg / om_g
@@ -155,7 +155,7 @@ def _zou_he(ctx: NodeCtx, stack, side, kind, vel_s, pres_s):
 def run(ctx: NodeCtx) -> jnp.ndarray:
     fg = jnp.concatenate([ctx.group("f"), ctx.group("g")])
     fg = ctx.boundary_case(fg, {
-        ("Wall", "Solid"): lambda s: s[jnp.asarray(OPP18)],
+        ("Wall", "Solid"): lambda s: lbm.perm(s, OPP18),
         "EVelocity": lambda s: _zou_he(ctx, s, -1, "velocity",
                                        "Velocity_f", "Pressure_f"),
         "WPressure": lambda s: _zou_he(ctx, s, +1, "pressure",

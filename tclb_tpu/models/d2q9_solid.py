@@ -178,15 +178,14 @@ def run(ctx: NodeCtx) -> dict:
     fi_s = ctx.density("fi_s")
     cs = ctx.density("Cs")
     dt = f.dtype
-    opp = jnp.asarray(OPP)
     vel = ctx.setting("Velocity")
     den = 1.0 + ctx.setting("Pressure") / 3.0
 
     # ---- boundaries (reference Run switch, Dynamics.c.Rt:243-270) ----- #
     bb = ctx.nt_is("Wall") | ctx.nt_is("Solid")
-    f = jnp.where(bb[None], f[opp], f)
-    g = jnp.where(bb[None], g[opp], g)
-    h = jnp.where(bb[None], h[opp], h)
+    f = jnp.where(bb[None], lbm.perm(f, OPP), f)
+    g = jnp.where(bb[None], lbm.perm(g, OPP), g)
+    h = jnp.where(bb[None], lbm.perm(h, OPP), h)
     t_in = jnp.broadcast_to(ctx.setting("Temperature"),
                             f.shape[1:]).astype(dt)
     c_in = jnp.broadcast_to(ctx.setting("Concentration"),
@@ -208,8 +207,8 @@ def run(ctx: NodeCtx) -> dict:
 
     # ---- macroscopic fields ------------------------------------------- #
     rho = jnp.sum(f, axis=0)
-    ux = jnp.tensordot(jnp.asarray(E[:, 0], dt), f, axes=1) / rho
-    uy = jnp.tensordot(jnp.asarray(E[:, 1], dt), f, axes=1) / rho
+    ux = lbm.edot(E[:, 0], f) / rho
+    uy = lbm.edot(E[:, 1], f) / rho
     rhoT = jnp.sum(g, axis=0)
     c = jnp.sum(h, axis=0)
 
@@ -290,8 +289,8 @@ def get_u(ctx: NodeCtx) -> jnp.ndarray:
     f = ctx.group("f")
     dt = f.dtype
     rho = jnp.sum(f, axis=0)
-    ux = jnp.tensordot(jnp.asarray(E[:, 0], dt), f, axes=1) / rho
-    uy = jnp.tensordot(jnp.asarray(E[:, 1], dt), f, axes=1) / rho
+    ux = lbm.edot(E[:, 0], f) / rho
+    uy = lbm.edot(E[:, 1], f) / rho
     return jnp.stack([ux, uy, jnp.zeros_like(ux)])
 
 

@@ -44,7 +44,7 @@ def _def():
 def collide(ctx: NodeCtx, f: jnp.ndarray) -> jnp.ndarray:
     dt = f.dtype
     rho = jnp.sum(f, axis=0)
-    u = tuple(jnp.tensordot(jnp.asarray(E[:, a], dt), f, axes=1) / rho
+    u = tuple(lbm.edot(E[:, a], f) / rho
               for a in range(3))
     feq = lbm.equilibrium(E, W, rho, u)
     keep = _keep_vector(ctx.setting("omega"), ctx.setting("S_high"), dt)

@@ -39,7 +39,7 @@ def run(ctx: NodeCtx) -> jnp.ndarray:
     f = family.apply_boundaries(ctx, f, E, W, OPP)
     family.add_flux_objectives(ctx, f, E)
     rho = jnp.sum(f, axis=0)
-    u = tuple(jnp.tensordot(jnp.asarray(E[:, a], dt), f, axes=1) / rho
+    u = tuple(lbm.edot(E[:, a], f) / rho
               for a in range(3))
     feq = lbm.equilibrium(E, W, rho, u)
     keep = _keep_vector(ctx.setting("omega"), ctx.setting("S_high"), dt)

@@ -59,13 +59,13 @@ def run(ctx: NodeCtx) -> jnp.ndarray:
     t_in = ctx.setting("InletTemperature")
     shape = f.shape[1:]
     fT = ctx.boundary_case(fT, {
-        ("Wall", "Solid"): lambda t: t[jnp.asarray(OPPT)],
+        ("Wall", "Solid"): lambda t: lbm.perm(t, OPPT),
         ("WVelocity", "EPressure"): lambda t: _t_eq(
             jnp.broadcast_to(t_in, shape).astype(dt),
             tuple(jnp.zeros(shape, dt) for _ in range(3))),
     })
     rho = jnp.sum(f, axis=0)
-    u = tuple(jnp.tensordot(jnp.asarray(E[:, a], dt), f, axes=1) / rho
+    u = tuple(lbm.edot(E[:, a], f) / rho
               for a in range(3))
     fc = collide(ctx, f)
     temp = jnp.sum(fT, axis=0)

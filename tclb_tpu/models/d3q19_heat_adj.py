@@ -75,7 +75,7 @@ def _make(name: str, variant: str = "base"):
         shape = f.shape[1:]
         t_in = ctx.setting("InletTemperature")
         fT = ctx.boundary_case(fT, {
-            ("Wall", "Solid"): lambda t: t[jnp.asarray(OPPT)],
+            ("Wall", "Solid"): lambda t: lbm.perm(t, OPPT),
             "WVelocity": lambda t: _t_eq(
                 jnp.broadcast_to(t_in, shape).astype(dt),
                 tuple(jnp.zeros(shape, dt) for _ in range(3))),
@@ -93,7 +93,7 @@ def _make(name: str, variant: str = "base"):
         else:
             w_eff = w
         rho = jnp.sum(f, axis=0)
-        u = tuple(jnp.tensordot(jnp.asarray(E[:, a], dt), f, axes=1) / rho
+        u = tuple(lbm.edot(E[:, a], f) / rho
                   for a in range(3))
         om = ctx.setting("omega")
         feq = lbm.equilibrium(E, W, rho, u)

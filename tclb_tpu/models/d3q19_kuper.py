@@ -77,7 +77,7 @@ def run(ctx: NodeCtx) -> jnp.ndarray:
         fz = fz + g * r * float(E[i, 2])
     s = ctx.setting("MagicF")
     rho = jnp.sum(f, axis=0)
-    u = tuple(jnp.tensordot(jnp.asarray(E[:, ax], dt), f, axes=1) / rho
+    u = tuple(lbm.edot(E[:, ax], f) / rho
               for ax in range(3))
     grav = family.gravity_of(ctx)
     frc = (s * fx / rho + grav[0], s * fy / rho + grav[1],

@@ -27,7 +27,7 @@ def run(ctx: NodeCtx) -> jnp.ndarray:
     family.add_flux_objectives(ctx, f, E)
     dt = f.dtype
     rho = jnp.sum(f, axis=0)
-    u = tuple(jnp.tensordot(jnp.asarray(E[:, a], dt), f, axes=1) / rho
+    u = tuple(lbm.edot(E[:, a], f) / rho
               for a in range(3))
     feq = lbm.equilibrium(E, W, rho, u)
     om_eff = lbm.smagorinsky_omega(E, f, feq, rho, ctx.setting("omega"),

@@ -54,7 +54,7 @@ def _make(name: str, galcor: bool):
         family.add_flux_objectives(ctx, f, E)
         dt = f.dtype
         rho = jnp.sum(f, axis=0)
-        u = tuple(jnp.tensordot(jnp.asarray(E[:, a], dt), f, axes=1) / rho
+        u = tuple(lbm.edot(E[:, a], f) / rho
                   for a in range(3))
         om = ctx.setting("omega")
         feq = _equilibrium(rho, u, galcor)

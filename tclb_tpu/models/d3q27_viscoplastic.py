@@ -121,7 +121,7 @@ def _mirror(f, axis):
         m[axis] = -m[axis]
         (j,) = np.where((E == m).all(axis=1))
         perm[i] = j[0]
-    return f[jnp.asarray(perm)]
+    return lbm.perm(f, perm)
 
 
 def _collision(ctx: NodeCtx, f):
@@ -130,9 +130,9 @@ def _collision(ctx: NodeCtx, f):
     fx = ctx.setting("ForceX")
     fy = ctx.setting("ForceY")
     fz = ctx.setting("ForceZ")
-    ux = jnp.tensordot(jnp.asarray(E[:, 0], dt), f, axes=1) / rho + fx * 0.5
-    uy = jnp.tensordot(jnp.asarray(E[:, 1], dt), f, axes=1) / rho + fy * 0.5
-    uz = jnp.tensordot(jnp.asarray(E[:, 2], dt), f, axes=1) / rho + fz * 0.5
+    ux = lbm.edot(E[:, 0], f) / rho + fx * 0.5
+    uy = lbm.edot(E[:, 1], f) / rho + fy * 0.5
+    uz = lbm.edot(E[:, 2], f) / rho + fz * 0.5
     usq = ux * ux + uy * uy + uz * uz
 
     phi, feq = [], []
@@ -217,7 +217,7 @@ def run(ctx: NodeCtx) -> jnp.ndarray:
         "EVelocity_ZouHe": lambda f: _zou_he_3d(ctx, f, 0, -1, "velocity"),
         "SymmetryY": lambda f: _mirror(f, 1),
         "SymmetryZ": lambda f: _mirror(f, 2),
-        ("Wall", "Solid"): lambda f: f[jnp.asarray(OPP)],
+        ("Wall", "Solid"): lambda f: lbm.perm(f, OPP),
     })
     fc, nu_app, yield_stat = _collision(ctx, f)
     coll = ctx.nt_is("MRT")[None]
@@ -243,7 +243,7 @@ def get_u(ctx: NodeCtx) -> jnp.ndarray:
     f = ctx.group("f")
     dt = f.dtype
     rho = jnp.sum(f, axis=0)
-    u = [(jnp.tensordot(jnp.asarray(E[:, a], dt), f, axes=1)
+    u = [(lbm.edot(E[:, a], f)
           + 0.5 * ctx.setting(n)) / rho
          for a, n in enumerate(("ForceX", "ForceY", "ForceZ"))]
     return jnp.stack(u)

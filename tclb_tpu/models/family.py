@@ -144,11 +144,11 @@ def add_flux_objectives(ctx: NodeCtx, f: jnp.ndarray, E: np.ndarray) -> None:
         return
     dt = f.dtype
     rho = jnp.sum(f, axis=0)
-    ux = jnp.tensordot(jnp.asarray(E[:, 0], dt), f, axes=1) / rho
-    uy = jnp.tensordot(jnp.asarray(E[:, 1], dt), f, axes=1) / rho
+    ux = lbm.edot(E[:, 0], f) / rho
+    uy = lbm.edot(E[:, 1], f) / rho
     usq = ux * ux + uy * uy
     if E.shape[1] == 3:
-        uz = jnp.tensordot(jnp.asarray(E[:, 2], dt), f, axes=1) / rho
+        uz = lbm.edot(E[:, 2], f) / rho
         usq = usq + uz * uz
     coll = ctx.nt_in_group("COLLISION")
     ploss = ux / rho * ((rho - 1.0) / 3.0 + usq / rho * 0.5)
@@ -188,7 +188,7 @@ def make_getters(E: np.ndarray, force_of=None) -> dict[str, Callable]:
         f = ctx.group("f")
         dt = f.dtype
         rho = jnp.sum(f, axis=0)
-        comps = [jnp.tensordot(jnp.asarray(E[:, a], dt), f, axes=1) / rho
+        comps = [lbm.edot(E[:, a], f) / rho
                  for a in range(E.shape[1])]
         if force_of is not None:
             frc = force_of(ctx)

@@ -16,6 +16,8 @@ from __future__ import annotations
 import numpy as np
 import jax.numpy as jnp
 
+from tclb_tpu.ops import lbm
+
 WP0 = 1.0 / 9.0
 WP = np.array([1.0 / 9.0 - 1.0] + [1.0 / 9.0] * 8)
 WPS = np.array([0.0] + [1.0 / 8.0] * 8)
@@ -28,9 +30,10 @@ def psi_of(g):
 
 def collide(g, psi, rho_e, tau_psi, dt, epsilon):
     """One Guo Poisson sweep: g' = g - (g - wp psi)/tau + dt wps RD."""
-    dt_ = g.dtype
-    ndim = g.ndim - 1
-    wp = jnp.asarray(WP, dt_).reshape((9,) + (1,) * ndim)
-    wps = jnp.asarray(WPS, dt_).reshape((9,) + (1,) * ndim)
     rd = -2.0 / 3.0 * (0.5 - tau_psi) * dt * rho_e / epsilon
-    return g - (g - wp * psi) / tau_psi + dt * wps * rd
+    # scalar-coefficient unroll (kernel-safe: no captured weight arrays)
+    return jnp.stack([
+        g[i] - (g[i] - float(WP[i]) * psi) / tau_psi
+        + (dt * float(WPS[i])) * rd if WPS[i]
+        else g[i] - (g[i] - float(WP[i]) * psi) / tau_psi
+        for i in range(9)])

@@ -78,7 +78,7 @@ def run(ctx: NodeCtx) -> jnp.ndarray:
     vel = ctx.setting("InletVelocity")
     den = ctx.setting("InletDensity")
     f = ctx.boundary_case(f, {
-        "Wall": lambda f: f[jnp.asarray(OPP)],
+        "Wall": lambda f: lbm.perm(f, OPP),
         "EVelocity": lambda f: _zou_he_x(f, vel, "velocity", "E"),
         "WPressure": lambda f: _zou_he_x(f, den, "pressure", "W"),
         "WVelocity": lambda f: _zou_he_x(f, vel, "velocity", "W"),
@@ -134,8 +134,8 @@ def get_u(ctx):
     f = ctx.group("f")
     dt = f.dtype
     rho = jnp.sum(f, axis=0)
-    ux = jnp.tensordot(jnp.asarray(E[:, 0], dt), f, axes=1) / rho
-    uy = jnp.tensordot(jnp.asarray(E[:, 1], dt), f, axes=1) / rho
+    ux = lbm.edot(E[:, 0], f) / rho
+    uy = lbm.edot(E[:, 1], f) / rho
     return jnp.stack([ux, uy, jnp.zeros_like(ux)])
 
 

@@ -51,6 +51,43 @@ def weights(E: np.ndarray) -> np.ndarray:
     return np.array([table[int((e * e).sum())] for e in E])
 
 
+def edot(vec, stack) -> jnp.ndarray:
+    """``sum_i vec[i] * stack[i]`` over the leading (population) axis,
+    unrolled with SCALAR coefficients and exact-zero terms skipped.
+
+    The kernel-safe replacement for
+    ``jnp.tensordot(jnp.asarray(vec, dt), stack, axes=1)``: Pallas
+    rejects kernels that capture constant ARRAYS (the materialized
+    ``vec``), and the tiny q-length contraction would otherwise become a
+    padded MXU pass.  Works identically under XLA (constant-folded
+    adds), so model code uses this one form for both engines."""
+    acc = None
+    for i, v in enumerate(np.asarray(vec)):
+        v = float(v)
+        if v == 0.0:
+            continue
+        t = stack[i] if v == 1.0 else (-stack[i] if v == -1.0
+                                       else v * stack[i])
+        acc = t if acc is None else acc + t
+    return acc if acc is not None else jnp.zeros_like(stack[0])
+
+
+def perm(stack, idx) -> jnp.ndarray:
+    """Reorder the leading (population) axis by a CONSTANT permutation:
+    ``stack[idx]`` as a static unstack/restack — the only form Mosaic
+    accepts inside a Pallas kernel (no gather, no captured index
+    array); XLA folds it to the same free layout change."""
+    return jnp.stack([stack[int(k)] for k in np.asarray(idx)])
+
+
+def wstack(w, value) -> jnp.ndarray:
+    """``(q, *shape)`` stack of ``w[i] * value`` with SCALAR weight
+    coefficients — the kernel-safe replacement for broadcasting a
+    materialized ``(q,1,1)`` weight-vector constant (which Pallas rejects
+    as a captured array).  ``value`` may be a plane or a traced scalar."""
+    return jnp.stack([float(wi) * value for wi in np.asarray(w)])
+
+
 def equilibrium(E: np.ndarray, W: np.ndarray, rho, u):
     """Second-order Maxwell equilibrium
     f_i = w_i rho (1 + e.u/cs2 + (e.u)^2/(2 cs4) - u^2/(2 cs2)).
@@ -163,11 +200,9 @@ def bgk_collide(E: np.ndarray, W: np.ndarray, f: jnp.ndarray, omega,
                 force=None, rho_u=None):
     """Plain BGK with optional velocity-shift (exact-difference) forcing.
     Returns (f', rho, u-tuple)."""
-    dt = f.dtype
     rho = jnp.sum(f, axis=0)
     d = E.shape[1]
-    u = tuple(jnp.tensordot(jnp.asarray(E[:, a], dt), f, axes=1) / rho
-              for a in range(d))
+    u = tuple(edot(E[:, a], f) / rho for a in range(d))
     feq = equilibrium(E, W, rho, u)
     out = f + omega * (feq - f)
     if force is not None:

@@ -118,12 +118,22 @@ def test_fallbacks(monkeypatch):
     lat.iterate(8)   # must not raise: dispatch sees time_series, uses XLA
     assert np.isfinite(np.asarray(lat.state.fields)).all()
 
+    # d2q9_heat used to be the fallback example; since round 4 the
+    # registry-driven generic engine covers it — assert it dispatches
     m2 = get_model("d2q9_heat")
     lat2 = Lattice(m2, (32, 64), dtype=jnp.float32, settings={"nu": 0.05})
     lat2.init()
     lat2.iterate(4)
-    assert lat2._fast_name is None
+    assert lat2._fast_name == "pallas_generic[d2q9_heat,fuse=2]"
     assert np.isfinite(np.asarray(lat2.state.fields)).all()
+
+    # f64 stays off every Pallas path (kernels are f32-only)
+    lat3 = Lattice(get_model("d2q9"), (32, 64), dtype=jnp.float64,
+                   settings={"nu": 0.05})
+    lat3.init()
+    lat3.iterate(4)
+    assert lat3._fast_name is None
+    assert np.isfinite(np.asarray(lat3.state.fields)).all()
 
 
 def test_sharded_pallas_matches_single(monkeypatch):

@@ -720,11 +720,13 @@ class Lattice:
                 and pallas_generic.mosaic_ok(self.model, self.shape)):
             from tclb_tpu.ops.lbm import present_types
             present = present_types(self.model, self._flags_host())
-            self._fast_probing = True   # first call may still hit a Mosaic
             cfg = pallas_generic.get_build_cfg(self.model, self.shape)
             if cfg is not None:
+                # this model/shape already proved it compiles: skip the
+                # first-call probe (and its full-state copy)
                 fz, cap = cfg
             else:
+                self._fast_probing = True   # first call may hit a Mosaic
                 # temporal fusion halves traffic but doubles the in-band
                 # reach; deep-stencil models (lee: reach 6/step) must
                 # stay at fuse=1

@@ -83,11 +83,9 @@ def _collision_mrt(ctx: NodeCtx, f: jnp.ndarray, w: jnp.ndarray):
     mn = lbm.moments(M, f - feq)
     # per-plane scalar keep factors (a stacked-then-reshaped (9,)
     # settings vector is a shape cast Mosaic cannot lower)
-    keep = [0.0, 0.0, 0.0, -1.0 / 3.0, 0.0, 0.0, 0.0, om, om]
-    m_neq = jnp.stack([mn[i] * keep[i] if not isinstance(keep[i], float)
-                       else (keep[i] * mn[i] if keep[i] else
-                             jnp.zeros_like(mn[i]))
-                       for i in range(9)])
+    keep = [None, None, None, -1.0 / 3.0, None, None, None, om, om]
+    m_neq = jnp.stack([jnp.zeros_like(mn[i]) if r is None else mn[i] * r
+                       for i, r in enumerate(keep)])
 
     ux2 = ux + ctx.setting("ForceX")
     uy2 = uy + ctx.setting("ForceY")

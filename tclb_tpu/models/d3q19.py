@@ -23,17 +23,6 @@ W = lbm.weights(E)
 OPP = lbm.opposite(E)
 M = lbm.gram_schmidt_basis(E)
 
-def _keep_vector(omega, s_high, dt):
-    """Per-moment keep factor (1 - rate).  The Gram-Schmidt builder orders
-    rows by monomial degree: 0 = rho, 1-3 = momentum (conserved), 4-9 = the
-    six degree-2 (stress) moments relaxing with ``omega``, the rest are
-    higher moments relaxing with ``S_high``."""
-    idx = np.arange(19)
-    return jnp.where(idx < 4, jnp.zeros((), dt),
-                     jnp.where(idx < 10, 1.0 - omega, 1.0 - s_high)
-                     ).astype(dt)
-
-
 def _def():
     d = family.base_def("d3q19", E, "3D MRT", faces="WE", symmetries="NS")
     d.add_setting("S_high", default=1.0,
@@ -42,17 +31,21 @@ def _def():
 
 
 def collide(ctx: NodeCtx, f: jnp.ndarray) -> jnp.ndarray:
-    dt = f.dtype
+    """Two-rate MRT: rows 0-3 (rho, momentum) conserved, rows 4-9 (the
+    six degree-2 stress moments) relax with ``omega``, the rest with
+    ``S_high`` — evaluated via the exact stress-projection identity
+    (lbm.two_rate_relax) instead of the full moment transform pair."""
     rho = jnp.sum(f, axis=0)
     u = tuple(lbm.edot(E[:, a], f) / rho
               for a in range(3))
     feq = lbm.equilibrium(E, W, rho, u)
-    keep = _keep_vector(ctx.setting("omega"), ctx.setting("S_high"), dt)
-    m_neq = lbm.moments(M, f - feq) * keep.reshape((19,) + (1,) * (f.ndim - 1))
+    fneq = [f[k] - feq[k] for k in range(19)]
+    relax = lbm.two_rate_relax(M, 4, 10, fneq,
+                               1.0 - ctx.setting("omega"),
+                               1.0 - ctx.setting("S_high"))
     g = family.gravity_of(ctx)
     u2 = tuple(u[a] + g[a] for a in range(3))
-    m_post = m_neq + lbm.moments(M, lbm.equilibrium(E, W, rho, u2))
-    return lbm.from_moments(M, m_post)
+    return relax + lbm.equilibrium(E, W, rho, u2)
 
 
 def run(ctx: NodeCtx) -> jnp.ndarray:

@@ -12,7 +12,7 @@ import jax.numpy as jnp
 
 from tclb_tpu.core.lattice import NodeCtx
 from tclb_tpu.models import family
-from tclb_tpu.models.d3q19 import E, OPP, W, M, _keep_vector
+from tclb_tpu.models.d3q19 import E, OPP, W, M
 from tclb_tpu.ops import lbm
 
 
@@ -42,8 +42,10 @@ def run(ctx: NodeCtx) -> jnp.ndarray:
     u = tuple(lbm.edot(E[:, a], f) / rho
               for a in range(3))
     feq = lbm.equilibrium(E, W, rho, u)
-    keep = _keep_vector(ctx.setting("omega"), ctx.setting("S_high"), dt)
-    m_neq = lbm.moments(M, f - feq) * keep.reshape((19,) + (1,) * (f.ndim - 1))
+    fneq = [f[k] - feq[k] for k in range(19)]
+    relax = lbm.two_rate_relax(M, 4, 10, fneq,
+                               1.0 - ctx.setting("omega"),
+                               1.0 - ctx.setting("S_high"))
     g = family.gravity_of(ctx)
     nw = w / (1.0 - ctx.setting("PorocityGamma") * (1.0 - w))
     u2 = tuple((u[a] + g[a]) for a in range(3))
@@ -51,8 +53,7 @@ def run(ctx: NodeCtx) -> jnp.ndarray:
     ctx.add_global("Drag", (1.0 - nw) * u2[0], where=coll)
     ctx.add_global("Lift", (1.0 - nw) * u2[1], where=coll)
     u2 = tuple(c * nw for c in u2)
-    m_post = m_neq + lbm.moments(M, lbm.equilibrium(E, W, rho, u2))
-    fc = lbm.from_moments(M, m_post)
+    fc = relax + lbm.equilibrium(E, W, rho, u2)
     f = jnp.where(ctx.nt_in_group("COLLISION")[None], fc, f)
     in_design = ctx.nt_in_group("DESIGNSPACE")
     ctx.add_global("MaterialPenalty", w * (1.0 - w), where=in_design)

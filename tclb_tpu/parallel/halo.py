@@ -213,7 +213,7 @@ def make_sharded_pallas_iterate(model: Model, mesh: Mesh, shape,
             present=present, ext_halo=True)
         width = 8
     else:
-        if not pallas_d3q.supports(model, local, dtype):
+        if not pallas_d3q.supports(model, local, dtype, ext_halo=True):
             return None
         call3, bz, zonal_names = pallas_d3q.make_pallas_iterate(
             model, local, dtype, interpret=interpret, present=present,

@@ -160,6 +160,90 @@ def bench_d2q9(results):
         ("sharded_1dev", mlups_sharded, 2.0)]
 
 
+def bench_baseline_cases(results):
+    """The driver-designated BASELINE geometries (BASELINE.md), on the
+    ENGINE path at their real shapes — not friendlier stand-ins:
+
+    * karman: the reference's headline karman.xml at 1024x100 (d2q9 MRT,
+      Zou/He inlet/outlet, wedge obstacle) — the small-ny case that
+      stresses the band-DMA halo amplification;
+    * kuper drop: drop.xml's physics at the reference's original 512^2
+      (two Density zones, 225x density ratio) on the generic engine;
+    * heat_adj: the d2q9_heat_adj primal (Brinkman-penalized flow +
+      temperature) at channel scale on the generic engine.
+    """
+    import jax
+    import jax.numpy as jnp
+    from tclb_tpu.core.lattice import Lattice
+    from tclb_tpu.models import get_model
+
+    on_tpu = jax.default_backend() == "tpu"
+    checks = []
+
+    # ---- karman.xml geometry: 1024 x 100 ------------------------------ #
+    nx, ny = (1024, 100) if on_tpu else (128, 20)
+    iters = int(os.environ.get("TCLB_BENCH_ITERS_KARMAN",
+                               30000 if on_tpu else 4))
+    m = get_model("d2q9")
+    lat = Lattice(m, (ny, nx), dtype=jnp.float32,
+                  settings={"nu": 0.02, "Velocity": 0.01})
+    flags = np.full((ny, nx), m.flag_for("MRT"), dtype=np.uint16)
+    flags[:, 0] = m.flag_for("WVelocity", "MRT")
+    flags[:, -1] = m.flag_for("EPressure", "MRT")
+    flags[0, :] = flags[-1, :] = m.flag_for("Wall")
+    if on_tpu:   # the karman.xml wedge obstacle (octagon bounding box)
+        flags[30:70, 120:160] = m.flag_for("Wall")
+        flags[1:-1, 5] = m.flag_for("MRT", "Inlet")
+        flags[1:-1, -6] = m.flag_for("MRT", "Outlet")
+    lat.set_flags(flags)
+    lat.init()
+    v = timed_solver(lat, iters)
+    results["karman_mlups"] = round(v, 1)
+    results["karman_engine"] = lat._fast_name or "xla"
+    results["karman_shape"] = f"{nx}x{ny}"
+    checks.append(("karman_solver", v, 2.0, 2 * m.n_storage * 4 + 2))
+
+    # ---- drop.xml physics at the reference's original 512^2 ----------- #
+    n = 512 if on_tpu else 32
+    iters = int(os.environ.get("TCLB_BENCH_ITERS_DROP",
+                               10000 if on_tpu else 4))
+    mk = get_model("d2q9_kuper")
+    latk = Lattice(mk, (n, n), dtype=jnp.float32,
+                   settings={"omega": 1.0, "Temperature": 0.56,
+                             "FAcc": 1.0, "Magic": 0.01,
+                             "MagicA": -0.152, "MagicF": -2.0 / 3.0,
+                             "Density": 3.2600529440452366})
+    latk.set_setting("Density", 0.014500641645077492, zone=1)
+    fk = np.full((n, n), mk.flag_for("MRT"), dtype=np.uint16)
+    yy, xx = np.mgrid[0:n, 0:n]
+    drop = (yy - n / 2) ** 2 + (xx - n / 2) ** 2 < (n / 5) ** 2
+    fk[drop] = mk.flag_for("MRT", zone=1)
+    latk.set_flags(fk)
+    latk.init()
+    v = timed_solver(latk, iters)
+    results["kuper_drop_mlups"] = round(v, 1)
+    results["kuper_drop_engine"] = latk._fast_name or "xla"
+    checks.append(("kuper_drop_solver", v, 2.0, 2 * mk.n_storage * 4 + 2))
+
+    # ---- heat_adj primal at channel scale ----------------------------- #
+    ny2, nx2 = (512, 1024) if on_tpu else (16, 128)
+    iters = int(os.environ.get("TCLB_BENCH_ITERS_HEATADJ",
+                               6000 if on_tpu else 4))
+    mh = get_model("d2q9_heat_adj")
+    lath = Lattice(mh, (ny2, nx2), dtype=jnp.float32,
+                   settings={"nu": 0.05, "InletVelocity": 0.02,
+                             "FluidAlfa": 0.05})
+    fh = np.full((ny2, nx2), mh.flag_for("MRT"), dtype=np.uint16)
+    fh[0, :] = fh[-1, :] = mh.flag_for("Wall")
+    lath.set_flags(fh)
+    lath.init()
+    v = timed_solver(lath, iters)
+    results["heat_adj_mlups"] = round(v, 1)
+    results["heat_adj_engine"] = lath._fast_name or "xla"
+    checks.append(("heat_adj_solver", v, 2.0, 2 * mh.n_storage * 4 + 2))
+    return checks
+
+
 def bench_d3q27(results):
     """d3q27_cumulant forced channel (the BASELINE north-star case,
     reference example/3d_channel_test_periodic_force_driven.xml geometry
@@ -173,7 +257,7 @@ def bench_d3q27(results):
     nz, ny, nx = (48, 48, 256) if on_tpu else (8, 16, 128)
     # long runs: the axon transport's ~100 ms sync round-trip would
     # otherwise dominate (the 3D case is only ~0.6M nodes)
-    iters = int(os.environ.get("TCLB_BENCH_ITERS3D", 2000 if on_tpu else 4))
+    iters = int(os.environ.get("TCLB_BENCH_ITERS3D", 4000 if on_tpu else 4))
     m = get_model("d3q27_cumulant")
     lat = Lattice(m, (nz, ny, nx), dtype=jnp.float32,
                   settings={"nu": 0.01, "ForceX": 1e-5})
@@ -198,10 +282,9 @@ def bench_d3q27(results):
     f19[:, -1, :] = m19.flag_for("Wall")
     lat19.set_flags(f19)
     lat19.init()
-    it19 = max(iters // 8, 2)
-    mlups19 = timed_solver(lat19, it19)
+    mlups19 = timed_solver(lat19, iters)
     results["d3q19_mlups"] = round(mlups19, 1)
-    # d3q19 has no Pallas kernel yet — pure XLA path, 1x ceiling
+    results["d3q19_engine"] = lat19._fast_name or "xla"
     checks.append(("d3q19_solver", mlups19, 1.0, 2 * m19.n_storage * 4 + 2))
     return checks
 
@@ -211,7 +294,7 @@ def main():
 
     results = {}
     shape2d, bytes_d2q9, checks2d = bench_d2q9(results)
-    checks3d = bench_d3q27(results)
+    checks3d = bench_d3q27(results) + bench_baseline_cases(results)
 
     dev = jax.devices()[0]
     hbm = HBM_GBS.get(dev.device_kind)

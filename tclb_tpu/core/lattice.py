@@ -139,6 +139,43 @@ class Streaming:
 # --------------------------------------------------------------------------- #
 
 
+def series_overrides(params: SimParams, i: int, iteration) -> list:
+    """``[(zone, value)]`` scalar overrides of setting ``i`` from its
+    registered <Control> time series at ``iteration`` (mod-T wrap);
+    empty without a series.  Shared by NodeCtx.setting and the fast
+    engines' per-step aux planes — one implementation, no drift.
+
+    Returned as per-zone SCALARS to be applied with
+    ``jnp.where(zones == z, value, plane)`` against a loop-invariant
+    base plane: modifying the zone TABLE and re-gathering per step keeps
+    a (zone_max,)->(ny,nx) gather inside the iteration scan, which XLA
+    cannot hoist and lowers catastrophically (~25 ms/step at 1024^2 on
+    v5e); masked selects against the hoisted base plane are free."""
+    rows = [(z, r) for (si, z, r) in params.series_map if si == i]
+    if not rows or params.time_series is None:
+        return []
+    T = params.time_series.shape[1]
+    t = jnp.mod(jnp.asarray(iteration, jnp.int32), T)
+    return [(z, params.time_series[r, t]) for z, r in rows]
+
+
+def series_dt_overrides(params: SimParams, i: int, iteration) -> list:
+    """``[(zone, d/dt value)]`` for setting ``i``'s series: one-sided
+    central differences clamped at the horizon endpoints (the finite
+    control horizon is not periodic — a wrapped difference would mix the
+    two ends into a spurious spike); empty without a series."""
+    rows = [(z, r) for (si, z, r) in params.series_map if si == i]
+    if not rows or params.time_series is None:
+        return []
+    ts = params.time_series
+    T = ts.shape[1]
+    t = jnp.mod(jnp.asarray(iteration, jnp.int32), T)
+    lo = jnp.maximum(t - 1, 0)
+    hi = jnp.minimum(t + 1, T - 1)
+    span = jnp.maximum(hi - lo, 1).astype(ts.dtype)
+    return [(z, (ts[r, hi] - ts[r, lo]) / span) for z, r in rows]
+
+
 class NodeCtx:
     """The model-facing view of one lattice-wide kernel invocation.
 
@@ -222,14 +259,11 @@ class NodeCtx:
         spec = m.settings[i]
         if not spec.zonal:
             return self.params.settings[i]
-        zone_vals = self.params.zone_table[i]
-        rows = [(z, r) for (si, z, r) in self.params.series_map if si == i]
-        if rows and self.params.time_series is not None:
-            T = self.params.time_series.shape[1]
-            t = jnp.mod(jnp.asarray(self.iteration, jnp.int32), T)
-            for z, r in rows:
-                zone_vals = zone_vals.at[z].set(self.params.time_series[r, t])
-        return zone_vals[self._zones()]
+        plane = self.params.zone_table[i][self._zones()]
+        for z, v in series_overrides(self.params, i, self.iteration):
+            plane = jnp.where(self._zones() == z,
+                              v.astype(plane.dtype), plane)
+        return plane
 
     def setting_dt(self, name: str) -> jnp.ndarray:
         """Time derivative of a zonal setting: central difference over its
@@ -240,19 +274,11 @@ class NodeCtx:
         difference would mix the two ends into a spurious spike."""
         m = self.model
         i = m.setting_index[name]
-        zone_vals = jnp.zeros((m.zone_max,), dtype=self._fields.dtype)
-        rows = [(z, r) for (si, z, r) in self.params.series_map if si == i]
-        if rows and self.params.time_series is not None:
-            ts = self.params.time_series
-            T = ts.shape[1]
-            t = jnp.mod(jnp.asarray(self.iteration, jnp.int32), T)
-            lo = jnp.maximum(t - 1, 0)
-            hi = jnp.minimum(t + 1, T - 1)
-            span = jnp.maximum(hi - lo, 1).astype(ts.dtype)
-            for z, r in rows:
-                d = (ts[r, hi] - ts[r, lo]) / span
-                zone_vals = zone_vals.at[z].set(d)
-        return zone_vals[self._zones()]
+        plane = jnp.zeros(self.flags.shape, dtype=self._fields.dtype)
+        for z, v in series_dt_overrides(self.params, i, self.iteration):
+            plane = jnp.where(self._zones() == z,
+                              v.astype(plane.dtype), plane)
+        return plane
 
     def _zones(self) -> jnp.ndarray:
         if self._zone_ids is None:
@@ -637,6 +663,7 @@ class Lattice:
                     f"all Control series must share one horizon: got "
                     f"{len(values)}, existing {len(old)}")
         self._series[(i, int(zone))] = values
+        self._fast_tried = False   # the engine re-selects series-aware
         keys = sorted(self._series)
         series_map = tuple((si, z, r) for r, (si, z) in enumerate(keys))
         ts = np.stack([self._series[k] for k in keys])
@@ -693,6 +720,10 @@ class Lattice:
         if jax.default_backend() != "tpu" and mode != "force":
             return None, None
         from tclb_tpu.ops import pallas_d2q9, pallas_d3q
+        # a Control time series needs per-iteration zonal planes, which
+        # only the generic engine implements — skip the tuned kernels
+        # (set_setting_series invalidates the engine so this re-runs)
+        has_series = self.params.time_series is not None
         if self.mesh is not None:
             from tclb_tpu.ops.lbm import present_types
             from tclb_tpu.parallel.halo import make_sharded_pallas_iterate
@@ -702,14 +733,17 @@ class Lattice:
             if it is not None:
                 return it, f"pallas_sharded[{dict(self.mesh.shape)}]"
             return None, None
-        if pallas_d2q9.supports(self.model, self.shape, self.dtype):
+        if (not has_series
+                and pallas_d2q9.supports(self.model, self.shape,
+                                         self.dtype)):
             present = pallas_d2q9.present_types(
                 self.model, self._flags_host())
             return (pallas_d2q9.make_pallas_iterate(
                 self.model, self.shape, self.dtype, fuse=2,
                 present=present),
                 f"pallas_2d[{self.model.name},fuse=2]")
-        if pallas_d3q.supports(self.model, self.shape, self.dtype):
+        if not has_series and pallas_d3q.supports(
+                self.model, self.shape, self.dtype):
             present = pallas_d3q.present_types(
                 self.model, self._flags_host())
             return (pallas_d3q.make_pallas_iterate(
@@ -746,8 +780,11 @@ class Lattice:
             self._fast, self._fast_name = self._build_fast()
             from tclb_tpu.utils import log
             if self._fast is not None:
+                suffix = "(in-kernel globals)" if getattr(
+                    self._fast, "full_globals", False) \
+                    else "(+1 XLA step per call for globals)"
                 log.info(f"engine: {self._fast_name} fused fast path "
-                         "(+1 XLA step per call for globals)")
+                         f"{suffix}")
             else:
                 log.debug(f"engine: XLA path ({self.model.name} "
                           f"{self.shape})")
@@ -762,15 +799,17 @@ class Lattice:
             self.sampler.append(it0, np.asarray(samples))
             return
         fast = self._fast_path()
-        if (fast is not None and niter > 1
-                and self.params.time_series is None):
-            # hybrid engine: the fused kernel runs niter-1 steps, then one
-            # XLA step refreshes globals — iterate()'s contract is
-            # "globals_ = the LAST step's integrals" (make_action_step
-            # zeroes per step), so this is exact, not an approximation.
-            # The reference accumulates globals inside the same hot kernel
-            # (src/cuda.cu.Rt:176-202); here the trailing step plays that
-            # role at 1/niter amortized cost.
+        # an engine advertising full_globals returns the LAST step's
+        # Globals itself (in-kernel accumulation, ≡ the reference's
+        # src/cuda.cu.Rt:176-202) — no trailing XLA step; the hybrid
+        # engines run niter-1 fused steps + one XLA step instead.
+        # Engines advertising supports_series gather Control time series
+        # per iteration themselves; others fall back to XLA for those.
+        full = bool(getattr(fast, "full_globals", False))
+        ok_series = (self.params.time_series is None
+                     or getattr(fast, "supports_series", False))
+        nfast = niter if full else niter - 1
+        if fast is not None and ok_series and nfast >= 1:
             if self._fast_probing:
                 # the generic engine's trace probe cannot see Mosaic
                 # lowering gaps (e.g. a model using arccos) or
@@ -786,7 +825,7 @@ class Lattice:
 
                 def attempt(it_fn):
                     probe = jax.tree.map(jnp.copy, self.state)
-                    return it_fn(probe, self.params, niter - 1)
+                    return it_fn(probe, self.params, nfast)
 
                 try:
                     self.state = attempt(fast)
@@ -832,8 +871,9 @@ class Lattice:
                                              *self._fast_cfg)
                 self._fast_probing = False
             else:
-                self.state = fast(self.state, self.params, niter - 1)
-            self.state = self._iterate(self.state, self.params, 1)
+                self.state = fast(self.state, self.params, nfast)
+            if not full:
+                self.state = self._iterate(self.state, self.params, 1)
         else:
             self.state = self._iterate(self.state, self.params, niter)
 

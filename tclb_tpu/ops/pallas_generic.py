@@ -46,7 +46,8 @@ import numpy as np
 from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu
 
-from tclb_tpu.core.lattice import LatticeState, NodeCtx, SimParams
+from tclb_tpu.core.lattice import (LatticeState, NodeCtx, SimParams,
+                                   series_dt_overrides, series_overrides)
 from tclb_tpu.core.registry import Model
 from tclb_tpu.ops.lbm import present_types  # noqa: F401  (re-export)
 
@@ -112,7 +113,14 @@ def _band_rows(model: Model, ny: int, nx: int,
     — the default cap keeps typical models inside the budget and the
     Lattice's first-call probe retries with a halved cap when a complex
     model still overflows (Mosaic's scoped-vmem limit error)."""
-    n_aux = 1 + len(model.zonal_settings)
+    # Budget against the LARGEST kernel flavor (the Control-series
+    # variant carries value + _DT planes per zonal setting): all flavors
+    # of one engine share `by` (the padded height and grid must agree),
+    # and a series run attaching mid-process reuses the cached build cfg
+    # WITHOUT a compile probe — an overflow there would escape the
+    # fallback ladder.  Costs at most one `by` notch on zonal-heavy
+    # models vs budgeting the plain flavor only.
+    n_aux = 1 + 2 * len(model.zonal_settings)
     per_row = (model.n_storage + n_aux) * nx * 4
     cap = _DEFAULT_BY_CAP if by_cap is None else by_cap
     best = None
@@ -172,7 +180,9 @@ class KernelCtx(NodeCtx):
 
     def __init__(self, model: Model, planes: list, loader: Callable,
                  flags_i32, zonal: dict, sett, dtype,
-                 iteration, present: Optional[set]):
+                 iteration, present: Optional[set],
+                 dt_planes: Optional[dict] = None,
+                 compute_globals: bool = False):
         # deliberately NOT calling NodeCtx.__init__: the band context has
         # list-of-planes storage and SMEM-backed settings
         self.model = model
@@ -180,13 +190,14 @@ class KernelCtx(NodeCtx):
         self._loader_fn = loader       # load(index, dx, dy) on the RAW band
         self.flags = flags_i32
         self._zonal = zonal            # zonal setting name -> band plane
+        self._dt = dt_planes or {}     # zonal setting name -> d/dt band plane
         self._sett = sett              # SMEM settings ref/array
         self._fields = _DtypeShim(dtype)
         self.iteration = iteration
         self.avg_start = 0
         self._globals: dict = {}
         self.present = present
-        self.compute_globals = False   # NoGlobals band kernel (hybrid engine)
+        self.compute_globals = compute_globals
 
     # -- field access -------------------------------------------------- #
 
@@ -212,8 +223,11 @@ class KernelCtx(NodeCtx):
         return self._sett[i]
 
     def setting_dt(self, name: str) -> jnp.ndarray:
-        # Control time series never reach this engine (Lattice rejects
-        # them before dispatch), so every series derivative is zero
+        # the series-aware kernel flavor carries per-iteration _DT planes
+        # in its aux stack; without a Control series every derivative is
+        # identically zero
+        if name in self._dt:
+            return self._dt[name]
         return jnp.zeros_like(self._planes[0])
 
     # -- node types ---------------------------------------------------- #
@@ -352,7 +366,6 @@ def make_pallas_iterate(model: Model, shape, dtype=jnp.float32,
 
     n_storage = model.n_storage
     zonal_names = list(model.zonal_settings)
-    n_aux = 1 + len(zonal_names)
     ei = model.ei
     stage_fns = {nm: model.stage_fns[model.stages[nm].main]
                  for nm, _ in plan}
@@ -364,11 +377,23 @@ def make_pallas_iterate(model: Model, shape, dtype=jnp.float32,
     def _roll(sl, shift):
         return pltpu.roll(sl, shift % nx, axis=1) if shift % nx else sl
 
-    def _mk_kernel(plan):  # noqa: ANN001 — plan shadows on purpose
-        return partial(kernel, plan)
+    def _mk_kernel(plan, with_dt=False, with_globals=False):
+        """Kernel flavor factory: ``with_dt`` adds per-iteration _DT
+        planes to the aux stack (the Control-series flavor), and
+        ``with_globals`` accumulates the model's SUM Globals in-kernel
+        into an extra (8, 128) partial-sums output (the reference's
+        in-kernel Globals accumulation, src/cuda.cu.Rt:176-202)."""
+        def kern(sett, it_ref, f_hbm, aux_hbm, *refs):
+            if with_globals:
+                out_ref, g_ref, buff, bufa, sems = refs
+            else:
+                (out_ref, buff, bufa, sems), g_ref = refs, None
+            kernel(plan, with_dt, sett, it_ref, f_hbm, aux_hbm,
+                   out_ref, g_ref, buff, bufa, sems)
+        return kern
 
-    def kernel(plan, sett, it_ref, f_hbm, aux_hbm, out_ref, buff, bufa,
-               sems):
+    def kernel(plan, with_dt, sett, it_ref, f_hbm, aux_hbm, out_ref,
+               g_ref, buff, bufa, sems):
         """One band pass = the whole Iteration action (x fuse).  The band
         plus 8-row halo blocks land in ONE contiguous (by+16)-row buffer
         per stack, so every extended-row access below is a single slice;
@@ -439,6 +464,9 @@ def make_pallas_iterate(model: Model, shape, dtype=jnp.float32,
         flags_full = bufa[slot, 0].astype(jnp.int32)
         zonal_full = {nm: bufa[slot, 1 + j]
                       for j, nm in enumerate(zonal_names)}
+        dt_full = {nm: bufa[slot, 1 + len(zonal_names) + j]
+                   for j, nm in enumerate(zonal_names)} if with_dt else {}
+        g_acc: dict = {}
 
         n_per_rep = len(model.actions["Iteration"])
         for st_i, (stage_name, out_ext) in enumerate(plan):
@@ -463,8 +491,18 @@ def make_pallas_iterate(model: Model, shape, dtype=jnp.float32,
                 model, planes, loader,
                 flags_full[lo:lo + n_i, :],
                 {nm: p[lo:lo + n_i, :] for nm, p in zonal_full.items()},
-                sett, dtype, it_ref[0] + rep, nt_present)
+                sett, dtype, it_ref[0] + rep, nt_present,
+                dt_planes={nm: p[lo:lo + n_i, :]
+                           for nm, p in dt_full.items()},
+                compute_globals=g_ref is not None)
             res = stage_fns[stage_name](ctx)
+            if g_ref is not None:
+                # SUM Globals accumulate across the action's stages; only
+                # the band rows count (extended rows are recomputed by
+                # the neighboring band)
+                for nm, plane in ctx._globals.items():
+                    part = plane[out_ext:out_ext + by, :]
+                    g_acc[nm] = part if nm not in g_acc else g_acc[nm] + part
 
             if isinstance(res, dict):
                 updates: dict[int, jnp.ndarray] = {}
@@ -488,11 +526,40 @@ def make_pallas_iterate(model: Model, shape, dtype=jnp.float32,
         for k in range(n_storage):
             out_ref[k] = work[k][_HALO:_HALO + by, :]
 
+        if g_ref is not None:
+            @pl.when(i == 0)
+            def _():
+                g_ref[...] = jnp.zeros((8, 128), dtype)
+            if pad:
+                # ghost rows must not contribute (mirror rows would
+                # double-count, wall rows are unphysical)
+                rows = jax.lax.broadcasted_iota(jnp.int32, (by, nx), 0) \
+                    + i * jnp.int32(by)
+                gmask = (rows < jnp.int32(ny_phys)).astype(dtype)
+            for gi, g in enumerate(model.globals_):
+                if g.name not in g_acc:
+                    continue
+                plane = g_acc[g.name]
+                if pad:
+                    plane = plane * gmask
+                part = plane.reshape((by * (nx // 128), 128)).sum(axis=0)
+                g_ref[gi] = g_ref[gi] + part
+
     grid = (ny // by,)
 
-    def _mk_call(plan_n):
+    def _mk_call(plan_n, with_dt=False, with_globals=False):
+        n_aux_k = 1 + (2 if with_dt else 1) * len(zonal_names)
+        out_specs = pl.BlockSpec((n_storage, by, nx), lambda i: (0, i, 0),
+                                 memory_space=pltpu.VMEM)
+        out_shape = jax.ShapeDtypeStruct((n_storage, ny, nx), dtype)
+        if with_globals:
+            out_specs = [out_specs,
+                         pl.BlockSpec((8, 128), lambda i: (0, 0),
+                                      memory_space=pltpu.VMEM)]
+            out_shape = [out_shape,
+                         jax.ShapeDtypeStruct((8, 128), dtype)]
         return pl.pallas_call(
-            _mk_kernel(plan_n),
+            _mk_kernel(plan_n, with_dt, with_globals),
             grid=grid,
             in_specs=[
                 pl.BlockSpec(memory_space=pltpu.SMEM),
@@ -500,12 +567,11 @@ def make_pallas_iterate(model: Model, shape, dtype=jnp.float32,
                 pl.BlockSpec(memory_space=pl.ANY),
                 pl.BlockSpec(memory_space=pl.ANY),
             ],
-            out_specs=pl.BlockSpec((n_storage, by, nx), lambda i: (0, i, 0),
-                                   memory_space=pltpu.VMEM),
-            out_shape=jax.ShapeDtypeStruct((n_storage, ny, nx), dtype),
+            out_specs=out_specs,
+            out_shape=out_shape,
             scratch_shapes=[
                 pltpu.VMEM((2, n_storage, by + 2 * _HALO, nx), dtype),
-                pltpu.VMEM((2, n_aux, by + 2 * _HALO, nx), dtype),
+                pltpu.VMEM((2, n_aux_k, by + 2 * _HALO, nx), dtype),
                 pltpu.SemaphoreType.DMA((2, 6)),
             ],
             interpret=interpret,
@@ -516,8 +582,21 @@ def make_pallas_iterate(model: Model, shape, dtype=jnp.float32,
     if ext_halo:
         return call, by, zonal_names
 
-    call1 = call if fuse == 1 \
-        else _mk_call(action_plan(model, "Iteration", fuse=1)[0])
+    plan1 = plan if fuse == 1 \
+        else action_plan(model, "Iteration", fuse=1)[0]
+    call1 = call if fuse == 1 else _mk_call(plan1)
+    # in-kernel globals flavor (final step of an iterate call): SUM only —
+    # MAX would need max-combining across bands/stages (no model uses MAX)
+    can_globals = (nx % 128 == 0
+                   and model.n_globals <= 8   # the (8, 128) partials block
+                   and all(g.op == "SUM" for g in model.globals_))
+    call_g = _mk_call(plan1, with_globals=True) \
+        if can_globals and model.n_globals else None
+    # Control-series flavors: per-iteration zonal + _DT planes, fuse=1
+    # (fused steps would reuse iteration t's settings at t+1)
+    call_s = _mk_call(plan1, with_dt=True)
+    call_sg = _mk_call(plan1, with_dt=True, with_globals=True) \
+        if can_globals and model.n_globals else None
     # one action rep advances the iteration counter iff any stage streams
     adv = int(any(model.stages[s].load_densities
                   for s in model.actions["Iteration"]))
@@ -544,10 +623,36 @@ def make_pallas_iterate(model: Model, shape, dtype=jnp.float32,
             fields = jnp.concatenate([fields, fields[:, init_src, :]],
                                      axis=1)
         zones = flags_i32 >> zshift
-        aux = jnp.stack(
-            [flags_i32.astype(dtype)]
-            + [params.zone_table[k].astype(dtype)[zones] for k in zonal_si])
         sett = params.settings.astype(dtype)
+        has_series = params.time_series is not None
+
+        # loop-invariant pieces (XLA hoists them out of the step scan):
+        # the base zonal planes and the affected-zone masks.  Per step
+        # only scalar masked selects remain — a zone-table re-gather
+        # inside the scan is ~25 ms/step at 1024^2 (unhoistable gather)
+        flags_f = flags_i32.astype(dtype)
+        base_planes = [params.zone_table[k].astype(dtype)[zones]
+                       for k in zonal_si]
+
+        def aux_of(it):
+            """The aux stack: flags + per-node zonal planes, plus (series
+            runs) the per-iteration values and _DT planes — the SAME
+            override scalars NodeCtx.setting/setting_dt use
+            (core.lattice.series_overrides/series_dt_overrides)."""
+            planes = [flags_f]
+            if not has_series:
+                return jnp.stack(planes + base_planes)
+            for j, k in enumerate(zonal_si):
+                p = base_planes[j]
+                for z, v in series_overrides(params, k, it):
+                    p = jnp.where(zones == z, v.astype(dtype), p)
+                planes.append(p)
+            for k in zonal_si:
+                p = jnp.zeros_like(base_planes[0])
+                for z, v in series_dt_overrides(params, k, it):
+                    p = jnp.where(zones == z, v.astype(dtype), p)
+                planes.append(p)
+            return jnp.stack(planes)
 
         def refresh(fields):
             if not pad:
@@ -557,36 +662,62 @@ def make_pallas_iterate(model: Model, shape, dtype=jnp.float32,
             return f.at[:, ny - mirror:, :].set(
                 fields[:, ny_phys - mirror:ny_phys, :])
 
-        def body(carry, _):
-            fields, it = carry
-            out = call(sett, it[None], refresh(fields), aux)
-            return (out, it + adv * fuse), None
+        final_g = call_sg if has_series else call_g
+        if niter <= 0:
+            return state
+        main = niter - (1 if final_g is not None else 0)
 
-        (fields, it), _ = jax.lax.scan(
-            body, (fields, state.iteration), None, length=niter // fuse)
+        if has_series:
+            def body_s(carry, _):
+                fields, it = carry
+                out = call_s(sett, it[None], refresh(fields), aux_of(it))
+                return (out, it + adv), None
 
-        def body1(carry, _):
-            fields, it = carry
-            out = call1(sett, it[None], refresh(fields), aux)
-            return (out, it + adv), None
+            (fields, it), _ = jax.lax.scan(
+                body_s, (fields, state.iteration), None, length=main)
+        else:
+            aux = aux_of(state.iteration)
 
-        (fields, it), _ = jax.lax.scan(
-            body1, (fields, it), None, length=niter % fuse)
+            def body(carry, _):
+                fields, it = carry
+                out = call(sett, it[None], refresh(fields), aux)
+                return (out, it + adv * fuse), None
+
+            (fields, it), _ = jax.lax.scan(
+                body, (fields, state.iteration), None, length=main // fuse)
+
+            def body1(carry, _):
+                fields, it = carry
+                out = call1(sett, it[None], refresh(fields), aux)
+                return (out, it + adv), None
+
+            (fields, it), _ = jax.lax.scan(
+                body1, (fields, it), None, length=main % fuse)
+
+        globals_ = jnp.zeros_like(state.globals_)
+        if final_g is not None:
+            fields, gpart = final_g(sett, it[None], refresh(fields),
+                                    aux_of(it))
+            it = it + adv
+            globals_ = gpart[:model.n_globals].sum(axis=1).astype(
+                state.globals_.dtype)
+
         if pad:
             fields = fields[:, :ny_phys, :]
         return LatticeState(
             fields=fields,
             flags=state.flags,
-            globals_=jnp.zeros_like(state.globals_),
+            globals_=globals_,
             iteration=it,
         )
 
     def iterate(state: LatticeState, params: SimParams, niter: int
                 ) -> LatticeState:
-        if params.time_series is not None:
-            raise ValueError(
-                "pallas_generic iterate does not support Control time "
-                "series; the XLA path handles time-dependent settings")
         return _iterate_jit(state, params, niter)
 
+    # contract flags the Lattice dispatch keys on: the engine handles
+    # Control time series itself, and (when the globals flavor exists)
+    # returns the LAST step's Globals — no trailing XLA step needed
+    iterate.supports_series = True
+    iterate.full_globals = bool(model.n_globals == 0 or call_g is not None)
     return iterate

@@ -156,6 +156,130 @@ def test_supports_structure():
                                        (16, 16, 64), jnp.float32)
 
 
+def test_inkernel_globals_match_xla():
+    """The generic engine's full contract: iterate() returns the LAST
+    step's SUM Globals from the in-kernel accumulation (no trailing XLA
+    step), matching the XLA engine's reductions (nx=128 — the partial-
+    sums output needs whole lanes)."""
+    name, ny, nx, niter = "d2q9", 16, 128, 6
+    m = get_model(name)
+    lat = Lattice(m, (ny, nx), dtype=jnp.float32,
+                  settings=_SETTINGS[name])
+    flags = _paint(m, ny, nx)
+    flags[1:-1, 2] = m.flag_for("MRT", "Inlet")
+    flags[1:-1, -3] = m.flag_for("MRT", "Outlet")
+    lat.set_flags(flags)
+    lat.init()
+    present = present_types(m, flags)
+
+    it_p = pallas_generic.make_pallas_iterate(
+        m, (ny, nx), jnp.float32, interpret=True, present=present)
+    assert it_p.full_globals
+    s_p = it_p(jax.tree.map(jnp.copy, lat.state), lat.params, niter)
+
+    it_x = jax.jit(make_iterate(m, present=present),
+                   static_argnames=("niter",))
+    s_x = it_x(lat.state, lat.params, niter)
+    np.testing.assert_allclose(np.asarray(s_p.fields),
+                               np.asarray(s_x.fields), rtol=1e-5, atol=1e-5)
+    np.testing.assert_allclose(np.asarray(s_p.globals_),
+                               np.asarray(s_x.globals_),
+                               rtol=1e-4, atol=1e-6)
+    assert float(np.abs(np.asarray(s_x.globals_)).sum()) > 0.0, \
+        "vacuous: the case must actually accumulate globals"
+
+
+def test_inkernel_globals_padded_height():
+    """Ghost-row padding must not leak mirror/wall rows into the Globals
+    (the in-kernel row mask)."""
+    name, ny, nx, niter = "d2q9", 20, 128, 5
+    m = get_model(name)
+    lat = Lattice(m, (ny, nx), dtype=jnp.float32, settings=_SETTINGS[name])
+    flags = _paint(m, ny, nx)
+    flags[1:-1, 2] = m.flag_for("MRT", "Inlet")
+    flags[1:-1, -3] = m.flag_for("MRT", "Outlet")
+    lat.set_flags(flags)
+    lat.init()
+    present = present_types(m, flags)
+    it_p = pallas_generic.make_pallas_iterate(
+        m, (ny, nx), jnp.float32, interpret=True, present=present)
+    s_p = it_p(jax.tree.map(jnp.copy, lat.state), lat.params, niter)
+    it_x = jax.jit(make_iterate(m, present=present),
+                   static_argnames=("niter",))
+    s_x = it_x(lat.state, lat.params, niter)
+    np.testing.assert_allclose(np.asarray(s_p.globals_),
+                               np.asarray(s_x.globals_),
+                               rtol=1e-4, atol=1e-6)
+
+
+def test_control_series_on_fast_path(monkeypatch):
+    """A <Control> time series (per-iteration zonal settings) now runs
+    the generic engine (the series kernel flavor gathers value + _DT
+    planes per step) and matches the XLA path exactly."""
+    ny, nx, niter = 16, 64, 7
+    m = get_model("d2q9")
+    series = 0.02 + 0.005 * np.sin(np.arange(11) * 0.7)
+
+    def build():
+        lat = Lattice(m, (ny, nx), dtype=jnp.float32,
+                      settings=_SETTINGS["d2q9"])
+        lat.set_flags(_paint(m, ny, nx))
+        lat.init()
+        lat.set_setting_series("Velocity", series, zone=0)
+        return lat
+
+    monkeypatch.setenv("TCLB_FASTPATH", "0")
+    ref = build()
+    ref.iterate(niter)
+
+    monkeypatch.setenv("TCLB_FASTPATH", "force")
+    fast = build()
+    fast.iterate(niter)
+    assert fast._fast_name is not None and "pallas_generic" in fast._fast_name
+    np.testing.assert_allclose(np.asarray(fast.state.fields),
+                               np.asarray(ref.state.fields),
+                               rtol=1e-5, atol=1e-6)
+    assert int(fast.state.iteration) == int(ref.state.iteration)
+
+
+def test_control_series_with_inkernel_globals(monkeypatch):
+    """The combined series + globals kernel flavor (call_sg): at nx=128
+    the engine runs the full contract under a Control series — fields
+    AND last-step Globals must match the XLA path."""
+    ny, nx, niter = 16, 128, 6
+    m = get_model("d2q9")
+    series = 0.02 + 0.004 * np.sin(np.arange(9) * 0.9)
+
+    def build():
+        lat = Lattice(m, (ny, nx), dtype=jnp.float32,
+                      settings=_SETTINGS["d2q9"])
+        flags = _paint(m, ny, nx)
+        flags[1:-1, 2] = m.flag_for("MRT", "Inlet")
+        flags[1:-1, -3] = m.flag_for("MRT", "Outlet")
+        lat.set_flags(flags)
+        lat.init()
+        lat.set_setting_series("Velocity", series, zone=0)
+        return lat
+
+    monkeypatch.setenv("TCLB_FASTPATH", "0")
+    ref = build()
+    ref.iterate(niter)
+
+    monkeypatch.setenv("TCLB_FASTPATH", "force")
+    fast = build()
+    fast.iterate(niter)
+    assert "pallas_generic" in (fast._fast_name or "")
+    assert getattr(fast._fast, "full_globals", False)
+    np.testing.assert_allclose(np.asarray(fast.state.fields),
+                               np.asarray(ref.state.fields),
+                               rtol=1e-5, atol=1e-6)
+    g_ref, g_fast = ref.get_globals(), fast.get_globals()
+    for k in g_ref:
+        np.testing.assert_allclose(g_fast[k], g_ref[k], rtol=1e-4,
+                                   atol=1e-6, err_msg=k)
+    assert sum(abs(v) for v in g_ref.values()) > 0.0
+
+
 def test_action_plan_reach():
     """Stage plan arithmetic: kuper's Run (pull 1 + phi stencil 1) then
     CalcPhi (pointwise) needs a 1-row input halo with CalcPhi running on

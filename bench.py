@@ -244,6 +244,61 @@ def bench_baseline_cases(results):
     return checks
 
 
+def bench_adjoint(results):
+    """Unsteady adjoint wall-clock: the Pallas primal+adjoint kernels
+    (ops/pallas_adjoint custom_vjp step — the reference's tuned ``Run_b``
+    analogue) vs the XLA reverse-mode, 1000-step horizon on d2q9_adj at
+    512x1024.  Reported as MLUPS-primal-equivalents (nodes*niter/time —
+    a gradient costs ~3 primal sweeps, so ~1/3 of the primal rate is the
+    engine-quality bar)."""
+    import jax
+    import jax.numpy as jnp
+    from tclb_tpu.adjoint import InternalTopology, make_unsteady_gradient
+    from tclb_tpu.core.lattice import Lattice
+    from tclb_tpu.models import get_model
+
+    on_tpu = jax.default_backend() == "tpu"
+    if not on_tpu:
+        return []
+    m = get_model("d2q9_adj")
+    ny, nx = 512, 1024
+    niter = int(os.environ.get("TCLB_BENCH_ITERS_ADJ", 1000))
+    lat = Lattice(m, (ny, nx), dtype=jnp.float32,
+                  settings={"nu": 0.1, "Velocity": 0.05, "Porocity": 0.5,
+                            "DragInObj": 1.0})
+    flags = np.full((ny, nx), m.flag_for("MRT"), dtype=np.uint16)
+    flags[:, 0] = m.flag_for("WVelocity", "MRT")
+    flags[:, -1] = m.flag_for("EPressure", "MRT")
+    flags[0, :] = flags[-1, :] = m.flag_for("Wall")
+    flags[128:384, 300:700] |= m.flag_for("DesignSpace")
+    lat.set_flags(flags)
+    lat.init()
+    design = InternalTopology(m)
+    theta0 = design.get(lat.state, lat.params)
+
+    def timed_grad(engine):
+        gf = make_unsteady_gradient(m, design, niter, levels=2,
+                                    engine=engine, shape=(ny, nx))
+        obj, g, _ = gf(theta0, lat.state, lat.params)
+        float(obj)
+        t0 = time.perf_counter()
+        obj, g, _ = gf(theta0, lat.state, lat.params)
+        s = float(obj) + float(jnp.sum(g))
+        dt = time.perf_counter() - t0
+        assert np.isfinite(s)
+        return ny * nx * niter / dt / 1e6
+
+    try:
+        results["adjoint_pallas_mlups"] = round(timed_grad("pallas"), 1)
+        results["adjoint_xla_mlups"] = round(timed_grad("xla"), 1)
+        results["adjoint_speedup"] = round(
+            results["adjoint_pallas_mlups"]
+            / results["adjoint_xla_mlups"], 2)
+    except Exception as e:      # never let the adjoint probe kill bench
+        results["adjoint_error"] = str(e)[:200]
+    return []
+
+
 def bench_d3q27(results):
     """d3q27_cumulant forced channel (the BASELINE north-star case,
     reference example/3d_channel_test_periodic_force_driven.xml geometry
@@ -294,7 +349,8 @@ def main():
 
     results = {}
     shape2d, bytes_d2q9, checks2d = bench_d2q9(results)
-    checks3d = bench_d3q27(results) + bench_baseline_cases(results)
+    checks3d = bench_d3q27(results) + bench_baseline_cases(results) \
+        + bench_adjoint(results)
 
     dev = jax.devices()[0]
     hbm = HBM_GBS.get(dev.device_kind)

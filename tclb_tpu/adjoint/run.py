@@ -84,17 +84,28 @@ def nested_checkpoint_scan(body: Callable, state: Any, niter: int,
 
 def make_objective_run(model: Model, niter: int, action: str = "Iteration",
                        streaming: Optional[Streaming] = None,
-                       levels: int = 2) -> Callable:
+                       levels: int = 2,
+                       step: Optional[Callable] = None) -> Callable:
     """``run(state, params) -> (objective, final_state)``: iterate ``niter``
     steps accumulating the InObj-weighted globals each step (time-integrated
-    objective — what the reference's recorded-horizon adjoint measures)."""
-    step = make_action_step(model, action, streaming)
+    objective — what the reference's recorded-horizon adjoint measures).
+
+    ``step`` overrides the engine: any differentiable
+    ``(state, params) -> state`` with the per-step-globals contract (the
+    Pallas diff step from :mod:`tclb_tpu.ops.pallas_adjoint` plugs in
+    here)."""
+    if step is None:
+        step = make_action_step(model, action, streaming)
 
     def run(state: LatticeState, params: SimParams):
         w = objective_weights(model, params)
+        # engines with a prepare() hook bind their loop-invariant inputs
+        # here, outside the scan (see pallas_adjoint.make_diff_step)
+        step_fn = step.prepare(state, params) \
+            if hasattr(step, "prepare") else step
 
         def body(s):
-            s2 = step(s, params)
+            s2 = step_fn(s, params)
             return s2, jnp.sum(w * s2.globals_)
 
         final, obj = nested_checkpoint_scan(body, state, niter, levels)
@@ -106,7 +117,9 @@ def make_objective_run(model: Model, niter: int, action: str = "Iteration",
 def make_unsteady_gradient(model: Model, design, niter: int,
                            action: str = "Iteration",
                            streaming: Optional[Streaming] = None,
-                           levels: int = 2) -> Callable:
+                           levels: int = 2,
+                           engine: str = "xla",
+                           shape: Optional[tuple] = None) -> Callable:
     """``grad_fn(theta, state, params) -> (objective, grads, final_state)``
     — reverse-mode sensitivity of the time-integrated objective with respect
     to the design vector (reference unsteady adjoint + parameter gather,
@@ -114,8 +127,31 @@ def make_unsteady_gradient(model: Model, design, niter: int,
 
     ``design`` is a :class:`tclb_tpu.adjoint.design.Design`: ``theta`` is
     injected into (state, params) inside the differentiated function, so the
-    gradient flows to exactly the declared degrees of freedom."""
-    run = make_objective_run(model, niter, action, streaming, levels)
+    gradient flows to exactly the declared degrees of freedom.
+
+    ``engine="pallas"`` (with ``shape``) runs BOTH sweeps on the fused
+    Pallas kernels (forward = the generic engine's globals flavor,
+    backward = the dedicated adjoint band kernel — the TPU analogue of the
+    reference's Tapenade-generated ``Run_b`` device kernel,
+    src/cuda.cu.Rt:240-256).  Restricted to storage-plane designs
+    (InternalTopology): settings/series cotangents are zero on this
+    engine — use the XLA engine for Control-gradient runs."""
+    step = None
+    if engine == "pallas":
+        if shape is None:
+            raise ValueError("engine='pallas' needs the lattice shape")
+        from tclb_tpu.adjoint.design import InternalTopology
+        from tclb_tpu.ops.pallas_adjoint import make_diff_step
+        if not isinstance(design, InternalTopology):
+            raise ValueError(
+                "engine='pallas' differentiates storage-plane designs "
+                "only (InternalTopology); settings/Control-series "
+                "designs need engine='xla'")
+        step = make_diff_step(model, shape)
+    elif engine != "xla":
+        raise ValueError(f"unknown adjoint engine {engine!r}")
+    run = make_objective_run(model, niter, action, streaming, levels,
+                             step=step)
 
     def loss(theta, state: LatticeState, params: SimParams):
         state, params = design.put(theta, state, params)

@@ -720,4 +720,9 @@ def make_pallas_iterate(model: Model, shape, dtype=jnp.float32,
     # returns the LAST step's Globals — no trailing XLA step needed
     iterate.supports_series = True
     iterate.full_globals = bool(model.n_globals == 0 or call_g is not None)
+    # internals for make_diff_step (the differentiable single-step path
+    # reuses the forward globals kernel verbatim)
+    iterate._impl = dict(call1=call1, call_g=call_g, by=by, pad=pad,
+                         zonal_si=zonal_si, zshift=zshift,
+                         nt_present=nt_present)
     return iterate

@@ -837,8 +837,13 @@ class Lattice:
                     present = present_types(self.model, self._flags_host())
                     fz0, _ = self._fast_cfg
                     ladder = [(fz0, 16), (fz0, 8)]
-                    if fz0 == 2:
+                    if fz0 == 2 and self.model.ndim == 2:
                         ladder += [(1, 16), (1, 8)]
+                    if self.model.ndim == 3:
+                        # last resort: raised scoped-vmem ceiling
+                        # (negative cap encodes it; ~2x slower codegen,
+                        # still ~3x the XLA path)
+                        ladder += [(fz0, -16), (fz0, -8)]
                     ladder = [c for c in ladder if c != self._fast_cfg]
                     for fz, cap in ladder:
                         try:

@@ -30,7 +30,7 @@ def run(ctx: NodeCtx) -> jnp.ndarray:
     u = tuple(lbm.edot(E[:, a], f) / rho
               for a in range(3))
     feq = lbm.equilibrium(E, W, rho, u)
-    om_eff = lbm.smagorinsky_omega(E, f, feq, rho, ctx.setting("omega"),
+    om_eff = lbm.smagorinsky_omega_unrolled(E, f, feq, rho, ctx.setting("omega"),
                                    ctx.setting("Smag"))
     fc = f + om_eff[None] * (feq - f)
     g = family.gravity_of(ctx)

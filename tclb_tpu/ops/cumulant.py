@@ -120,6 +120,41 @@ def _moment_tensor(entries: dict, like: jnp.ndarray, ndim: int) -> jnp.ndarray:
          for p in range(3)])
 
 
+def _low_moments_d3(F: jnp.ndarray):
+    """rho, the first moments and the six second-order raw moments —
+    the ONLY forward moments the cumulant collision consumes (all higher
+    post-collision moments are rebuilt from the relaxed covariance).
+    Computing just these (10 of 27 outputs, with the stage-2/3
+    contractions restricted to total order <= 2) drops ~a third of the
+    forward-transform work vs the full tensor transform; exact algebra.
+
+    Returns (rho, (ux_num, uy_num, uz_num), dict of m_pqr) with the
+    first-moment NUMERATORS (caller divides by rho once)."""
+    # contract x (T rows: [1,1,1], [-1,0,1], [1,0,1])
+    x0, x1, x2 = F[0], F[1], F[2]
+    s0 = x0 + x1 + x2
+    s1 = x2 - x0
+    s2 = x2 + x0
+    out = {}
+    # contract y then z for each needed (p, q, r), order <= 2
+    for p, sx in ((0, s0), (1, s1), (2, s2)):
+        y0, y1, y2 = sx[0], sx[1], sx[2]
+        t0 = y0 + y1 + y2
+        t1 = y2 - y0
+        t2 = y2 + y0
+        for q, sy in ((0, t0), (1, t1), (2, t2)):
+            if p + q > 2:
+                continue
+            z0, z1, z2 = sy[0], sy[1], sy[2]
+            out[(p, q, 0)] = z0 + z1 + z2
+            if p + q <= 1:
+                out[(p, q, 1)] = z2 - z0
+            if p + q == 0:
+                out[(p, q, 2)] = z2 + z0
+    rho = out[(0, 0, 0)]
+    return rho, (out[(1, 0, 0)], out[(0, 1, 0)], out[(0, 0, 1)]), out
+
+
 def collide_d3q27(F: jnp.ndarray, omega, omega_bulk=1.0,
                   force=(0.0, 0.0, 0.0), correlated: bool = True,
                   galilean=None):
@@ -139,20 +174,21 @@ def collide_d3q27(F: jnp.ndarray, omega, omega_bulk=1.0,
     (reference src/d3q27_cumulant/Dynamics.c.Rt:299-319, the
     ``GalileanCorrection`` setting that round-1 declared but never read).
     Returns (F', rho, (ux, uy, uz))."""
-    m = _raw_moments(F, 3)
-    rho = m[0, 0, 0]
+    rho, (jx, jy, jz), m = _low_moments_d3(F)
     inv = 1.0 / rho
-    ux = m[1, 0, 0] * inv
-    uy = m[0, 1, 0] * inv
-    uz = m[0, 0, 1] * inv
+    ux = jx * inv
+    uy = jy * inv
+    uz = jz * inv
 
-    k = _centralize(m, ux, 0)
-    k = _centralize(k, uy, 1)
-    k = _centralize(k, uz, 2)
-
-    # second-order central moments (== second-order cumulants)
-    kxx, kyy, kzz = k[2, 0, 0], k[0, 2, 0], k[0, 0, 2]
-    kxy, kxz, kyz = k[1, 1, 0], k[1, 0, 1], k[0, 1, 1]
+    # second-order central moments (== second-order cumulants) via the
+    # exact shift identities mu_ab = m_ab - rho u_a u_b (the first
+    # central moments vanish) — no full-tensor centralization needed
+    kxx = m[(2, 0, 0)] - jx * ux
+    kyy = m[(0, 2, 0)] - jy * uy
+    kzz = m[(0, 0, 2)] - jz * uz
+    kxy = m[(1, 1, 0)] - jx * uy
+    kxz = m[(1, 0, 1)] - jx * uz
+    kyz = m[(0, 1, 1)] - jy * uz
 
     # relax: trace with omega_bulk toward rho (cs2 = 1/3 per axis),
     # deviatoric + off-diagonal with omega (reference cumulant relaxation,
@@ -214,21 +250,32 @@ def collide_d3q27(F: jnp.ndarray, omega, omega_bulk=1.0,
                          + kzz_p * kxy_p * kxy_p)
                 + 8.0 * kxy_p * kxz_p * kyz_p) * inv * inv
 
-    # assemble post-collision central-moment tensor: zero-mean Gaussian =>
-    # moments with any odd axis power vanish (missing entries = 0)
-    kp = _moment_tensor({
-        (0, 0, 0): rho,
-        (2, 0, 0): kxx_p, (0, 2, 0): kyy_p, (0, 0, 2): kzz_p,
-        (1, 1, 0): kxy_p, (1, 0, 1): kxz_p, (0, 1, 1): kyz_p,
-        (2, 2, 0): g220, (2, 0, 2): g202, (0, 2, 2): g022,
-        (2, 1, 1): g211, (1, 2, 1): g121, (1, 1, 2): g112,
-        (2, 2, 2): g222,
-    }, rho, 3)
-
     ux2 = ux + force[0]
     uy2 = uy + force[1]
     uz2 = uz + force[2]
-    mp = _decentralize(kp, ux2, 0)
+    # first (x-axis) decentralize pass evaluated SPARSELY on the 14
+    # nonzero post-collision central moments (zero-mean Gaussian: any
+    # odd axis power vanishes): m0 = k0; m1 = k1 + u k0;
+    # m2 = k2 + 2u k1 + u^2 k0 with the known-zero k's dropped — the
+    # dense pass spends ~4x the multiply-adds shifting zero planes
+    u, uu = ux2, ux2 * ux2
+    mx = {
+        (0, 0, 0): rho, (1, 0, 0): u * rho,
+        (2, 0, 0): kxx_p + uu * rho,
+        (1, 1, 0): kxy_p, (2, 1, 0): 2.0 * u * kxy_p,
+        (1, 0, 1): kxz_p, (2, 0, 1): 2.0 * u * kxz_p,
+        (0, 1, 1): kyz_p, (1, 1, 1): u * kyz_p,
+        (2, 1, 1): g211 + uu * kyz_p,
+        (0, 2, 0): kyy_p, (1, 2, 0): u * kyy_p,
+        (2, 2, 0): g220 + uu * kyy_p,
+        (0, 0, 2): kzz_p, (1, 0, 2): u * kzz_p,
+        (2, 0, 2): g202 + uu * kzz_p,
+        (1, 2, 1): g121, (2, 2, 1): 2.0 * u * g121,
+        (1, 1, 2): g112, (2, 1, 2): 2.0 * u * g112,
+        (0, 2, 2): g022, (1, 2, 2): u * g022,
+        (2, 2, 2): g222 + uu * g022,
+    }
+    mp = _moment_tensor(mx, rho, 3)
     mp = _decentralize(mp, uy2, 1)
     mp = _decentralize(mp, uz2, 2)
     return _from_raw_moments(mp, 3), rho, (ux, uy, uz)

@@ -291,37 +291,6 @@ def nebb_boundary(E: np.ndarray, W: np.ndarray, OPP: np.ndarray,
         for k in range(q)])
 
 
-def smagorinsky_omega(E: np.ndarray, f: jnp.ndarray, feq: jnp.ndarray,
-                      rho: jnp.ndarray, omega0, smag):
-    """Effective relaxation rate with the Smagorinsky eddy viscosity closed
-    in terms of the non-equilibrium stress (Hou et al.): the reference's
-    LES models compute the same closed form in-kernel
-    (src/d2q9_les/Dynamics.c.Rt, src/d3q19_les).
-
-    tau_eff = (tau0 + sqrt(tau0^2 + 18 sqrt(2) Cs^2 |Pi|/rho)) / 2,
-    with tau0 = 1/omega0 and |Pi| the Frobenius norm of the non-equilibrium
-    momentum flux.  Returns omega_eff = 1/tau_eff.
-    """
-    dt = f.dtype
-    d = E.shape[1]
-    nd = f.ndim - 1
-    sh = (len(E),) + (1,) * nd
-    fneq = f - feq
-    pi2 = None
-    for a in range(d):
-        for b in range(a, d):
-            ee = (E[:, a] * E[:, b]).astype(np.float64)
-            pab = jnp.sum(jnp.asarray(ee, dt).reshape(sh) * fneq, axis=0)
-            term = pab * pab * (1.0 if a == b else 2.0)
-            pi2 = term if pi2 is None else pi2 + term
-    pi_norm = jnp.sqrt(pi2)
-    tau0 = 1.0 / omega0
-    tau_eff = 0.5 * (tau0 + jnp.sqrt(tau0 * tau0
-                                     + 18.0 * math.sqrt(2.0) * smag * smag
-                                     * pi_norm / rho))
-    return 1.0 / tau_eff
-
-
 def _unrolled_matvec(mat: np.ndarray, f) -> jnp.ndarray:
     """mat @ f over the leading axis, unrolled with SCALAR coefficients.
 
@@ -345,10 +314,14 @@ def _unrolled_matvec(mat: np.ndarray, f) -> jnp.ndarray:
 
 
 def smagorinsky_omega_unrolled(E: np.ndarray, f, feq, rho, omega0, smag):
-    """Mosaic-safe form of :func:`smagorinsky_omega`: the |Pi| contraction
+    """Smagorinsky eddy-viscosity relaxation rate (Hou et al.):
+    ``tau_eff = (tau0 + sqrt(tau0^2 + 18 sqrt(2) Cs^2 |Pi|/rho)) / 2``
+    with ``|Pi|`` the Frobenius norm of the non-equilibrium momentum
+    flux — the closed form the reference's LES models compute in-kernel
+    (src/d2q9_les/Dynamics.c.Rt, src/d3q19_les).  The contraction is
     unrolled with SCALAR coefficients (Pallas rejects materialized
-    constant coefficient vectors).  Identical algebra — the Pallas LES
-    branches (2D and 3D) share this one implementation."""
+    constant coefficient vectors) — the one implementation every LES
+    user (XLA models and Pallas kernels, 2D and 3D) shares."""
     d = E.shape[1]
     pi2 = None
     for a in range(d):

@@ -95,6 +95,8 @@ def supports_diff(model: Model, shape, dtype) -> bool:
     factorization) and a write set covering every moving plane (an
     unmentioned streamed plane would pass through RAW in the forward
     kernel but PULLED in the backward factorization)."""
+    if model.ndim != 2 or len(shape) != 2:
+        return False   # the backward factorization is 2D-only for now
     if not pallas_generic.supports(model, shape, dtype, probe=False):
         return False
     ny, nx = (int(s) for s in shape)

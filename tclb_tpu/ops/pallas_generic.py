@@ -62,15 +62,23 @@ HALO = _HALO  # public: max per-action reach a caller can plan against
 
 
 def _stage_reach(model: Model, stage_name: str) -> int:
-    """y-reach of one stage's reads: pull distance of streamed densities
-    (when the stage streams) and the declared Field stencil extents.
-    x-reach is free (lane rolls wrap the whole row)."""
+    """Band-axis reach of one stage's reads: pull distance of streamed
+    densities (when the stage streams) and the declared Field stencil
+    extents, along the banded axis (y rows in 2D, z slabs in 3D).
+    x-reach is free (lane rolls wrap the whole row), and in 3D the whole
+    (ny, nx) plane rides the band so y is free too."""
     stage = model.stages[stage_name]
     r = 0
-    if stage.load_densities:
-        r = max((abs(int(d.dy)) for d in model.densities), default=0)
-    for f in model.fields:
-        r = max(r, abs(f.dy_range[0]), abs(f.dy_range[1]))
+    if model.ndim == 2:
+        if stage.load_densities:
+            r = max((abs(int(d.dy)) for d in model.densities), default=0)
+        for f in model.fields:
+            r = max(r, abs(f.dy_range[0]), abs(f.dy_range[1]))
+    else:
+        if stage.load_densities:
+            r = max((abs(int(d.dz)) for d in model.densities), default=0)
+        for f in model.fields:
+            r = max(r, abs(f.dz_range[0]), abs(f.dz_range[1]))
     return r
 
 
@@ -210,8 +218,7 @@ class KernelCtx(NodeCtx):
 
     def load(self, name: str, dx: int = 0, dy: int = 0, dz: int = 0
              ) -> jnp.ndarray:
-        assert dz == 0
-        return self._loader_fn(self.model.storage_index[name], dx, dy)
+        return self._loader_fn(self.model.storage_index[name], dx, dy, dz)
 
     # -- settings ------------------------------------------------------ #
 
@@ -280,6 +287,8 @@ def supports(model: Model, shape, dtype, probe: bool = True) -> bool:
     trace of one band-kernel call — the capability test that replaces the
     old per-model name allowlist.  Mosaic lowering failures (TPU compile)
     are caught later by the Lattice's compile probe."""
+    if model.ndim == 3:
+        return supports_3d(model, shape, dtype, probe=probe)
     if model.ndim != 2 or len(shape) != 2 or dtype != jnp.float32:
         return False
     if "Iteration" not in model.actions:
@@ -344,6 +353,12 @@ def make_pallas_iterate(model: Model, shape, dtype=jnp.float32,
     domain is one device's y-block carrying 8 exchanged halo rows at each
     end); returns ``(call, by, zonal_names)`` for
     :mod:`tclb_tpu.parallel.halo` to compose with ``ppermute``."""
+    if model.ndim == 3:
+        if ext_halo:
+            raise ValueError("3d generic engine has no ext_halo mode")
+        return make_pallas_iterate_3d(model, shape, dtype,
+                                      interpret=interpret, present=present,
+                                      fuse=fuse, by_cap=by_cap)
     if not supports(model, shape, dtype, probe=False):
         raise ValueError(f"pallas_generic unsupported: {model.name} {shape}")
     plan, reach = action_plan(model, "Iteration", fuse=fuse)
@@ -483,7 +498,8 @@ def make_pallas_iterate(model: Model, shape, dtype=jnp.float32,
             else:
                 planes = [w[lo:lo + n_i, :] for w in work]
 
-            def loader(index, dx, dy, _lo=lo, _n=n_i):
+            def loader(index, dx, dy, dz=0, _lo=lo, _n=n_i):
+                assert dz == 0, "2D band kernel: no z loads"
                 sl = work[index][_lo + dy:_lo + dy + _n, :]
                 return _roll(sl, -dx)
 
@@ -725,4 +741,366 @@ def make_pallas_iterate(model: Model, shape, dtype=jnp.float32,
     iterate._impl = dict(call1=call1, call_g=call_g, by=by, pad=pad,
                          zonal_si=zonal_si, zshift=zshift,
                          nt_present=nt_present)
+    return iterate
+
+
+# --------------------------------------------------------------------------- #
+# 3D: z-slab bands (the generic counterpart of ops/pallas_d3q's block kernel)
+# --------------------------------------------------------------------------- #
+
+
+def _slab_depth_gen(model: Model, nz: int, ny: int, nx: int,
+                    reach: int, cap: Optional[int] = None) -> Optional[int]:
+    """Largest slab depth BZ dividing nz whose double-slotted scratch
+    (state + aux, band + ``reach`` halo slabs each side) fits the budget.
+    Unlike the 2D rows, z is NOT a tiled axis, so halos are exactly
+    ``reach`` slabs — no 8-alignment games."""
+    n_aux = 1 + 2 * len(model.zonal_settings)   # series flavor's aux
+    per_slab = (model.n_storage + n_aux) * ny * nx * 4
+    best = None
+    for bz in range(1, (nz if cap is None else min(nz, cap)) + 1):
+        if nz % bz:
+            continue
+        # double-slotted scratch; compute temporaries live in the rest of
+        # VMEM (the same ~15 MB working budget the tuned 3D kernel uses)
+        if 2 * (bz + 2 * reach) * per_slab > 12 * 1024 * 1024:
+            break
+        best = bz
+    return best
+
+
+def supports_3d(model: Model, shape, dtype, probe: bool = True) -> bool:
+    """3D eligibility: same registry checks as 2D, z-banded."""
+    if model.ndim != 3 or len(shape) != 3 or dtype != jnp.float32:
+        return False
+    if "Iteration" not in model.actions:
+        return False
+    for s in model.actions["Iteration"]:
+        st = model.stages.get(s)
+        if st is None or st.fixed_point \
+                or model.stage_fns.get(st.main) is None:
+            return False
+    plan, reach = action_plan(model, "Iteration", fuse=1)
+    nz, ny, nx = (int(v) for v in shape)
+    if nz < 2 * max(reach, 1):
+        return False
+    if jax.default_backend() == "tpu" and (nx % 128 or ny % 8):
+        return False  # (ny, nx) is the (sublane, lane) tile
+    if _slab_depth_gen(model, nz, ny, nx, max(reach, 1)) is None:
+        return False
+    if not probe:
+        return True
+    key = (model.name, "3d", ny, nx)
+    if key not in _probe_cache:
+        try:
+            it = make_pallas_iterate_3d(model, (4 * max(reach, 1), ny, nx),
+                                        dtype, interpret=True)
+            shp = (4 * max(reach, 1), ny, nx)
+            state = LatticeState(
+                fields=jax.ShapeDtypeStruct((model.n_storage,) + shp, dtype),
+                flags=jax.ShapeDtypeStruct(shp, jnp.uint16),
+                globals_=jax.ShapeDtypeStruct((model.n_globals,), dtype),
+                iteration=jax.ShapeDtypeStruct((), jnp.int32))
+            params = SimParams(
+                settings=jax.ShapeDtypeStruct((len(model.settings),), dtype),
+                zone_table=jax.ShapeDtypeStruct(
+                    (len(model.settings), model.zone_max), dtype))
+            jax.eval_shape(partial(it, niter=2), state, params)
+            _probe_cache[key] = True
+        except Exception as e:  # noqa: BLE001
+            from tclb_tpu.utils import log
+            log.debug(f"pallas_generic 3d: {model.name} probe failed: "
+                      f"{type(e).__name__}: {str(e)[:200]}")
+            _probe_cache[key] = False
+    return _probe_cache[key]
+
+
+def make_pallas_iterate_3d(model: Model, shape, dtype=jnp.float32,
+                           interpret: Optional[bool] = None,
+                           present: Optional[set] = None,
+                           fuse: int = 1,
+                           by_cap: Optional[int] = None):
+    """3D generic engine: the model's full Iteration action per z-slab
+    band pass, with the same registry-driven machinery as the 2D builder
+    (multi-stage extension plan, zonal aux planes, in-kernel SUM globals
+    flavor, Control-series flavor).  ``fuse``/``by_cap`` accepted for
+    dispatch-signature parity; temporal fusion is not implemented in 3D
+    (the kernels are VPU-compute-bound — halving traffic buys nothing)."""
+    if not supports_3d(model, shape, dtype, probe=False):
+        raise ValueError(f"pallas_generic 3d unsupported: {model.name} "
+                         f"{shape}")
+    plan, reach = action_plan(model, "Iteration", fuse=1)
+    R = max(reach, 1)
+    nz, ny, nx = (int(s) for s in shape)
+    # the Lattice probe ladder passes row-oriented caps (16, 8); for
+    # z-slabs interpret them as a slab-depth cap (8 rows ~ 1 slab) so the
+    # retry actually shrinks the scoped-VMEM working set.  NEGATIVE caps
+    # are the last-resort rungs: |cap| plus a raised scoped-vmem ceiling
+    # (the big ceiling costs ~2x in Mosaic codegen quality, so it is
+    # never the default — only what rescues temporaries-heavy models
+    # like d3q19_kuper that OOM even at bz=1)
+    vmem_ceiling = by_cap is not None and by_cap < 0
+    cap = None if by_cap is None else max(1, abs(by_cap) // 8)
+    bz = _slab_depth_gen(model, nz, ny, nx, R, cap)
+    if interpret is None:
+        interpret = jax.default_backend() != "tpu"
+
+    ns = model.n_storage
+    zonal_names = list(model.zonal_settings)
+    ei = model.ei
+    stage_fns = {nm: model.stage_fns[model.stages[nm].main]
+                 for nm, _ in plan}
+    loads_density = {nm: model.stages[nm].load_densities for nm, _ in plan}
+    nt_present = set(model.node_types) if present is None else set(present)
+
+    def _mk_kernel(with_dt=False, with_globals=False):
+        n_aux_k = 1 + (2 if with_dt else 1) * len(zonal_names)
+
+        def kern(sett, it_ref, f_hbm, aux_hbm, *refs):
+            if with_globals:
+                out_ref, g_ref, buff, bufa, sems = refs
+            else:
+                (out_ref, buff, bufa, sems), g_ref = refs, None
+            i = pl.program_id(0)
+            n = pl.num_programs(0)
+
+            def band_dmas(slot, band):
+                # halo slabs are copied ONE AT A TIME with individual
+                # modular indices: a block copy of R slabs starting at
+                # (base - R) mod nz would straddle the periodic boundary
+                # whenever that start lands within R of the top (e.g.
+                # bz=1, R=2, band 1), reading out of bounds
+                base = band * jnp.int32(bz)
+                out = []
+                n_sem = 1 + 2 * R
+                for si_, (hbm, buf, nplanes) in enumerate((
+                        (f_hbm, buff, ns), (aux_hbm, bufa, n_aux_k))):
+                    out.append(pltpu.make_async_copy(
+                        hbm.at[pl.ds(0, nplanes), pl.ds(base, bz)],
+                        buf.at[slot, :, pl.ds(R, bz)],
+                        sems.at[slot, n_sem * si_]))
+                    for r in range(R):
+                        zm_r = jax.lax.rem(
+                            base - jnp.int32(R - r) + jnp.int32(nz),
+                            jnp.int32(nz))
+                        zp_r = jax.lax.rem(base + jnp.int32(bz + r),
+                                           jnp.int32(nz))
+                        out.append(pltpu.make_async_copy(
+                            hbm.at[pl.ds(0, nplanes), pl.ds(zm_r, 1)],
+                            buf.at[slot, :, pl.ds(r, 1)],
+                            sems.at[slot, n_sem * si_ + 1 + r]))
+                        out.append(pltpu.make_async_copy(
+                            hbm.at[pl.ds(0, nplanes), pl.ds(zp_r, 1)],
+                            buf.at[slot, :, pl.ds(R + bz + r, 1)],
+                            sems.at[slot, n_sem * si_ + 1 + R + r]))
+                return out
+
+            slot = jax.lax.rem(i, jnp.int32(2))
+            nxt = jax.lax.rem(i + jnp.int32(1), jnp.int32(2))
+
+            @pl.when(i == 0)
+            def _():
+                for d in band_dmas(jnp.int32(0), i):
+                    d.start()
+
+            @pl.when(i + 1 < n)
+            def _():
+                for d in band_dmas(nxt, i + jnp.int32(1)):
+                    d.start()
+
+            for d in band_dmas(slot, i):
+                d.wait()
+
+            def _rollyx(sl, dy, dx):
+                if dy:
+                    sl = jnp.roll(sl, dy, axis=1)
+                if dx % nx:
+                    sl = pltpu.roll(sl, dx % nx, axis=2)
+                return sl
+
+            work = [buff[slot, k] for k in range(ns)]
+            flags_full = bufa[slot, 0].astype(jnp.int32)
+            zonal_full = {nm: bufa[slot, 1 + j]
+                          for j, nm in enumerate(zonal_names)}
+            dt_full = {nm: bufa[slot, 1 + len(zonal_names) + j]
+                       for j, nm in enumerate(zonal_names)} \
+                if with_dt else {}
+            g_acc: dict = {}
+
+            n_per_rep = len(model.actions["Iteration"])
+            for st_i, (stage_name, out_ext) in enumerate(plan):
+                n_i = bz + 2 * out_ext
+                lo = R - out_ext
+                rep = st_i // n_per_rep
+
+                if loads_density[stage_name]:
+                    planes = []
+                    for k in range(ns):
+                        dxk, dyk, dzk = (int(v) for v in ei[k])
+                        sl = work[k][lo - dzk:lo - dzk + n_i]
+                        planes.append(_rollyx(sl, dyk, dxk))
+                else:
+                    planes = [w[lo:lo + n_i] for w in work]
+
+                def loader(index, dx, dy, dz=0, _lo=lo, _n=n_i):
+                    sl = work[index][_lo + dz:_lo + dz + _n]
+                    return _rollyx(sl, -dy, -dx)
+
+                ctx = KernelCtx(
+                    model, planes, loader,
+                    flags_full[lo:lo + n_i],
+                    {nm: p[lo:lo + n_i] for nm, p in zonal_full.items()},
+                    sett, dtype, it_ref[0] + rep, nt_present,
+                    dt_planes={nm: p[lo:lo + n_i]
+                               for nm, p in dt_full.items()},
+                    compute_globals=g_ref is not None)
+                res = stage_fns[stage_name](ctx)
+                if g_ref is not None:
+                    for nm, plane in ctx._globals.items():
+                        part = plane[out_ext:out_ext + bz]
+                        g_acc[nm] = part if nm not in g_acc \
+                            else g_acc[nm] + part
+
+                if isinstance(res, dict):
+                    updates: dict[int, jnp.ndarray] = {}
+                    for name, stack in res.items():
+                        if name in model.groups:
+                            idx = model.groups[name]
+                            if len(idx) == 1 and stack.ndim == 3:
+                                updates[idx[0]] = stack
+                            else:
+                                for j, k in enumerate(idx):
+                                    updates[k] = stack[j]
+                        else:
+                            updates[model.storage_index[name]] = stack
+                else:
+                    updates = {k: res[k] for k in range(ns)}
+                for k, new in updates.items():
+                    w = work[k]
+                    work[k] = jnp.concatenate(
+                        [w[:lo], new, w[lo + n_i:]], axis=0)
+
+            for k in range(ns):
+                out_ref[k] = work[k][R:R + bz]
+
+            if g_ref is not None:
+                @pl.when(i == 0)
+                def _():
+                    g_ref[...] = jnp.zeros((8, 128), dtype)
+                for gi, g in enumerate(model.globals_):
+                    if g.name not in g_acc:
+                        continue
+                    part = g_acc[g.name].reshape(
+                        (bz * ny * (nx // 128), 128)).sum(axis=0)
+                    g_ref[gi] = g_ref[gi] + part
+
+        return kern, n_aux_k
+
+    def _mk_call(with_dt=False, with_globals=False):
+        kern, n_aux_k = _mk_kernel(with_dt, with_globals)
+        out_specs = pl.BlockSpec((ns, bz, ny, nx), lambda i: (0, i, 0, 0),
+                                 memory_space=pltpu.VMEM)
+        out_shape = jax.ShapeDtypeStruct((ns, nz, ny, nx), dtype)
+        if with_globals:
+            out_specs = [out_specs,
+                         pl.BlockSpec((8, 128), lambda i: (0, 0),
+                                      memory_space=pltpu.VMEM)]
+            out_shape = [out_shape,
+                         jax.ShapeDtypeStruct((8, 128), dtype)]
+        return pl.pallas_call(
+            kern,
+            grid=(nz // bz,),
+            in_specs=[
+                pl.BlockSpec(memory_space=pltpu.SMEM),
+                pl.BlockSpec(memory_space=pltpu.SMEM),
+                pl.BlockSpec(memory_space=pl.ANY),
+                pl.BlockSpec(memory_space=pl.ANY),
+            ],
+            out_specs=out_specs,
+            out_shape=out_shape,
+            scratch_shapes=[
+                pltpu.VMEM((2, ns, bz + 2 * R, ny, nx), dtype),
+                pltpu.VMEM((2, n_aux_k, bz + 2 * R, ny, nx), dtype),
+                pltpu.SemaphoreType.DMA((2, 2 * (1 + 2 * R))),
+            ],
+            compiler_params=pltpu.CompilerParams(
+                vmem_limit_bytes=100 * 1024 * 1024)
+            if vmem_ceiling else None,
+            interpret=interpret,
+        )
+
+    call = _mk_call()
+    can_globals = (nx % 128 == 0 and model.n_globals <= 8
+                   and all(g.op == "SUM" for g in model.globals_))
+    call_g = _mk_call(with_globals=True) \
+        if can_globals and model.n_globals else None
+    call_s = _mk_call(with_dt=True)
+    call_sg = _mk_call(with_dt=True, with_globals=True) \
+        if can_globals and model.n_globals else None
+    adv = int(any(model.stages[s].load_densities
+                  for s in model.actions["Iteration"]))
+    zshift = model.zone_shift
+    si = model.setting_index
+    zonal_si = [si[nm] for nm in zonal_names]
+
+    @partial(jax.jit, static_argnames=("niter",), donate_argnums=0)
+    def _iterate_jit(state: LatticeState, params: SimParams, niter: int
+                     ) -> LatticeState:
+        flags_i32 = state.flags.astype(jnp.int32)
+        fields = state.fields
+        zones = flags_i32 >> zshift
+        sett = params.settings.astype(dtype)
+        has_series = params.time_series is not None
+        flags_f = flags_i32.astype(dtype)
+        base_planes = [params.zone_table[k].astype(dtype)[zones]
+                       for k in zonal_si]
+
+        def aux_of(it):
+            planes = [flags_f]
+            if not has_series:
+                return jnp.stack(planes + base_planes)
+            for j, k in enumerate(zonal_si):
+                p = base_planes[j]
+                for z, v in series_overrides(params, k, it):
+                    p = jnp.where(zones == z, v.astype(dtype), p)
+                planes.append(p)
+            for k in zonal_si:
+                p = jnp.zeros_like(base_planes[0])
+                for z, v in series_dt_overrides(params, k, it):
+                    p = jnp.where(zones == z, v.astype(dtype), p)
+                planes.append(p)
+            return jnp.stack(planes)
+
+        final_g = call_sg if has_series else call_g
+        if niter <= 0:
+            return state
+        main = niter - (1 if final_g is not None else 0)
+
+        body_call = call_s if has_series else call
+        aux_static = None if has_series else aux_of(state.iteration)
+
+        def body(carry, _):
+            fields, it = carry
+            aux = aux_of(it) if has_series else aux_static
+            out = body_call(sett, it[None], fields, aux)
+            return (out, it + adv), None
+
+        (fields, it), _ = jax.lax.scan(
+            body, (fields, state.iteration), None, length=main)
+
+        globals_ = jnp.zeros_like(state.globals_)
+        if final_g is not None:
+            fields, gpart = final_g(sett, it[None], fields, aux_of(it))
+            it = it + adv
+            globals_ = gpart[:model.n_globals].sum(axis=1).astype(
+                state.globals_.dtype)
+        return LatticeState(fields=fields, flags=state.flags,
+                            globals_=globals_, iteration=it)
+
+    def iterate(state: LatticeState, params: SimParams, niter: int
+                ) -> LatticeState:
+        return _iterate_jit(state, params, niter)
+
+    iterate.supports_series = True
+    iterate.full_globals = bool(model.n_globals == 0 or call_g is not None)
     return iterate

@@ -152,8 +152,11 @@ def test_supports_structure():
     assert pallas_generic.supports(m, (16, 64), jnp.float32)
     assert not pallas_generic.supports(m, (16, 64), jnp.float64)
     assert not pallas_generic.supports(m, (4, 64), jnp.float32)
+    # 3D models route to the z-slab engine (since round 4)
+    assert pallas_generic.supports(get_model("d3q27_cumulant"),
+                                   (16, 16, 64), jnp.float32)
     assert not pallas_generic.supports(get_model("d3q27_cumulant"),
-                                       (16, 16, 64), jnp.float32)
+                                       (16, 16, 64), jnp.float64)
 
 
 def test_inkernel_globals_match_xla():
@@ -297,3 +300,78 @@ def test_action_plan_reach():
     plan2, reach2 = pallas_generic.action_plan(m2, "Iteration", fuse=1)
     assert plan2 == [("BaseIteration", 0)]
     assert reach2 == 1
+
+
+# ------------------------------------------------------------------------- #
+# 3D generic engine
+# ------------------------------------------------------------------------- #
+
+_3D_SETTINGS = {
+    "d3q19_heat": {"nu": 0.05, "Velocity": 0.02, "FluidAlfa": 0.05},
+    "d3q19_heat_adj": {"nu": 0.05, "Velocity": 0.02, "FluidAlfa": 0.05},
+    "d3q19_heat_adj_art": {"nu": 0.05, "Velocity": 0.02, "FluidAlfa": 0.05},
+    "d3q19_heat_adj_prop": {"nu": 0.05, "Velocity": 0.02,
+                            "FluidAlfa": 0.05},
+    "d3q19_kuper": {"nu": 0.1, "Temperature": 0.9, "Magic": 0.01},
+    "d3q19_adj": {"nu": 0.1, "Velocity": 0.02, "Porocity": 0.5},
+    "d3q19_les": {"nu": 0.01, "Smag": 0.16},
+    "d3q27_cumulant": {"nu": 0.01, "ForceX": 1e-5},
+    "d3q27_viscoplastic": {"nu": 0.1},
+}
+
+
+def _eligible_3d(shape=(6, 16, 128)):
+    out = []
+    for name in list_models():
+        m = get_model(name)
+        if m.ndim == 3 and pallas_generic.supports_3d(m, shape, jnp.float32):
+            out.append(name)
+    return out
+
+
+def _parity_3d(name, shape=(6, 16, 128), niter=4):
+    m = get_model(name)
+    lat = Lattice(m, shape, dtype=jnp.float32,
+                  settings=_3D_SETTINGS.get(name, {}))
+    coll = "MRT" if "MRT" in m.node_types else "BGK"
+    flags = np.full(shape, m.flag_for(coll), dtype=np.uint16)
+    flags[:, 0, :] = flags[:, -1, :] = m.flag_for("Wall")
+    lat.set_flags(flags)
+    lat.init()
+    present = present_types(m, flags)
+    it_p = pallas_generic.make_pallas_iterate(
+        m, shape, jnp.float32, interpret=True, present=present)
+    s_p = it_p(jax.tree.map(jnp.copy, lat.state), lat.params, niter)
+    it_x = jax.jit(make_iterate(m, present=present),
+                   static_argnames=("niter",))
+    s_x = it_x(lat.state, lat.params, niter)
+    b = np.asarray(s_x.fields)
+    assert np.isfinite(b).all(), f"{name}: XLA reference went non-finite"
+    np.testing.assert_allclose(np.asarray(s_p.fields), b,
+                               rtol=1e-5, atol=1e-5, err_msg=name)
+    np.testing.assert_allclose(np.asarray(s_p.globals_),
+                               np.asarray(s_x.globals_),
+                               rtol=1e-3, atol=1e-5, err_msg=name)
+
+
+@pytest.mark.parametrize("name", ["d3q19_heat", "d3q19_kuper"])
+def test_generic3d_parity_key_models(name):
+    """Fast-lap pin: 3D multi-lattice (heat) and Field-stencil (kuper)
+    models on the z-slab generic engine."""
+    _parity_3d(name)
+
+
+@pytest.mark.slow
+@pytest.mark.parametrize("name", [n for n in _eligible_3d()
+                                  if n not in ("d3q19_heat", "d3q19_kuper")])
+def test_generic3d_parity_all(name):
+    """Every trace-eligible 3D model matches the XLA engine."""
+    _parity_3d(name)
+
+
+def test_generic3d_halo_straddle():
+    """bz=1 with reach 2 (kuper's field stencil under a fused plan): the
+    per-slab halo copies must wrap the periodic boundary slab by slab —
+    a block copy starting at (base - R) mod nz would read out of bounds
+    (the bug that NaN'd d3q19_kuper at 48x48x256 on TPU)."""
+    _parity_3d("d3q19_kuper", shape=(12, 16, 128), niter=4)

@@ -341,6 +341,22 @@ def bench_d3q27(results):
     results["d3q19_mlups"] = round(mlups19, 1)
     results["d3q19_engine"] = lat19._fast_name or "xla"
     checks.append(("d3q19_solver", mlups19, 1.0, 2 * m19.n_storage * 4 + 2))
+
+    # a model with NO hand-tuned kernel: the registry-driven generic 3D
+    # engine (multi-lattice d3q19_heat, 26 planes) — was XLA-only
+    mh = get_model("d3q19_heat")
+    lath = Lattice(mh, (nz, ny, nx), dtype=jnp.float32,
+                   settings={"nu": 0.05, "Velocity": 0.02,
+                             "FluidAlfa": 0.05})
+    fh = np.full((nz, ny, nx), mh.flag_for("MRT"), dtype=np.uint16)
+    fh[:, 0, :] = fh[:, -1, :] = mh.flag_for("Wall")
+    lath.set_flags(fh)
+    lath.init()
+    mlupsh = timed_solver(lath, iters)
+    results["d3q19_heat_mlups"] = round(mlupsh, 1)
+    results["d3q19_heat_engine"] = lath._fast_name or "xla"
+    checks.append(("d3q19_heat_solver", mlupsh, 1.0,
+                   2 * mh.n_storage * 4 + 2))
     return checks
 
 

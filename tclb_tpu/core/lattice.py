@@ -731,6 +731,8 @@ class Lattice:
                 self.model, self.mesh, self.shape, self.dtype,
                 present=present_types(self.model, self._flags_host()))
             if it is not None:
+                if getattr(it, "uses_generic", False):
+                    self._fast_probing = True
                 return it, f"pallas_sharded[{dict(self.mesh.shape)}]"
             return None, None
         if (not has_series
@@ -830,21 +832,26 @@ class Lattice:
                 try:
                     self.state = attempt(fast)
                 except Exception as e:  # noqa: BLE001
-                    log.debug(f"engine: {self._fast_name} first compile "
-                              f"failed ({type(e).__name__}); trying "
-                              "smaller bands")
-                    from tclb_tpu.ops.lbm import present_types
-                    present = present_types(self.model, self._flags_host())
-                    fz0, _ = self._fast_cfg
-                    ladder = [(fz0, 16), (fz0, 8)]
-                    if fz0 == 2 and self.model.ndim == 2:
-                        ladder += [(1, 16), (1, 8)]
-                    if self.model.ndim == 3:
-                        # last resort: raised scoped-vmem ceiling
-                        # (negative cap encodes it; ~2x slower codegen,
-                        # still ~3x the XLA path)
-                        ladder += [(fz0, -16), (fz0, -8)]
-                    ladder = [c for c in ladder if c != self._fast_cfg]
+                    if self.mesh is not None:
+                        ladder = []   # sharded engine: no per-cap rebuild
+                    else:
+                        log.debug(f"engine: {self._fast_name} first "
+                                  f"compile failed ({type(e).__name__}); "
+                                  "trying smaller bands")
+                        from tclb_tpu.ops.lbm import present_types
+                        present = present_types(self.model,
+                                                self._flags_host())
+                        fz0, _ = self._fast_cfg
+                        ladder = [(fz0, 16), (fz0, 8)]
+                        if fz0 == 2 and self.model.ndim == 2:
+                            ladder += [(1, 16), (1, 8)]
+                        if self.model.ndim == 3:
+                            # last resort: raised scoped-vmem ceiling
+                            # (negative cap encodes it; ~2x slower
+                            # codegen, still ~3x the XLA path)
+                            ladder += [(fz0, -16), (fz0, -8)]
+                        ladder = [c for c in ladder
+                                  if c != self._fast_cfg]
                     for fz, cap in ladder:
                         try:
                             it2 = pallas_generic.make_pallas_iterate(
@@ -863,17 +870,24 @@ class Lattice:
                         log.info(f"engine: {self._fast_name} failed to "
                                  f"compile ({type(e).__name__}); XLA "
                                  "fallback")
-                        pallas_generic.set_mosaic_ok(self.model,
-                                                     self.shape, False)
+                        if self.mesh is None:
+                            # the sharded probe exercised a DIFFERENT
+                            # kernel (local shard shape) — never poison
+                            # the single-device caches from it
+                            pallas_generic.set_mosaic_ok(self.model,
+                                                         self.shape,
+                                                         False)
                         self._fast = fast = None
                         self._fast_name = None
                         self._fast_probing = False
                         self.state = self._iterate(self.state, self.params,
                                                    niter)
                         return
-                pallas_generic.set_mosaic_ok(self.model, self.shape, True)
-                pallas_generic.set_build_cfg(self.model, self.shape,
-                                             *self._fast_cfg)
+                if self.mesh is None:
+                    pallas_generic.set_mosaic_ok(self.model, self.shape,
+                                                 True)
+                    pallas_generic.set_build_cfg(self.model, self.shape,
+                                                 *self._fast_cfg)
                 self._fast_probing = False
             else:
                 self.state = fast(self.state, self.params, nfast)

@@ -205,12 +205,31 @@ def make_sharded_pallas_iterate(model: Model, mesh: Mesh, shape,
         return None
     local = (shape[0] // n,) + tuple(shape[1:])
 
+    mode = None
     if model.ndim == 2:
-        if local[0] % 8 or not pallas_d2q9.supports(model, local, dtype):
+        if local[0] % 8:
             return None
-        call1, call2, by, by2 = pallas_d2q9.make_pallas_iterate(
-            model, local, dtype, interpret=interpret, fuse=2,
-            present=present, ext_halo=True)
+        if pallas_d2q9.supports(model, local, dtype):
+            call1, call2, by, by2 = pallas_d2q9.make_pallas_iterate(
+                model, local, dtype, interpret=interpret, fuse=2,
+                present=present, ext_halo=True)
+            mode = "tuned2d"
+        else:
+            # registry-driven generic kernel as the sharded building
+            # block: same 8-row halo contract, per-step aux stack
+            from tclb_tpu.ops import pallas_generic
+            if not pallas_generic.supports(model, local, dtype):
+                return None
+            callg, byg, gz_names = pallas_generic.make_pallas_iterate(
+                model, local, dtype, interpret=interpret, fuse=1,
+                present=present, ext_halo=True)
+            si = model.setting_index
+            gz_si = [si[nm] for nm in gz_names]
+            # iteration advances per action rep iff any stage streams —
+            # the same rule the single-device generic engine applies
+            g_adv = int(any(model.stages[st].load_densities
+                            for st in model.actions["Iteration"]))
+            mode = "generic2d"
         width = 8
     else:
         if not pallas_d3q.supports(model, local, dtype, ext_halo=True):
@@ -241,7 +260,20 @@ def make_sharded_pallas_iterate(model: Model, mesh: Mesh, shape,
             zones = flags_i32 >> zshift
             sett = params.settings.astype(dtype)
             fields = state.fields
-            if model.ndim == 2:
+            if mode == "generic2d":
+                aux_ext = exch(jnp.stack(
+                    [flags_i32.astype(dtype)]
+                    + [params.zone_table[j].astype(dtype)[zones]
+                       for j in gz_si]))
+
+                def bodyg(carry, _):
+                    f, it = carry
+                    out = callg(sett, it[None], exch(f), aux_ext)
+                    return (out, it + g_adv), None
+
+                (fields, _), _ = lax.scan(
+                    bodyg, (fields, state.iteration), None, length=niter)
+            elif model.ndim == 2:
                 vel, den = pallas_d2q9.gather_zonal_planes(
                     model, params, zones, dtype)
                 aux_ext = exch(jnp.stack(
@@ -281,6 +313,10 @@ def make_sharded_pallas_iterate(model: Model, mesh: Mesh, shape,
                 "pallas iterate does not support Control time series")
         return _for_niter(int(niter))(state, params)
 
+    # the generic-kernel building block is capability-probed, not proven:
+    # the Lattice dispatch probes its first call and falls back to the
+    # sharded XLA engine on a Mosaic lowering failure
+    iterate.uses_generic = (mode == "generic2d")
     return iterate
 
 

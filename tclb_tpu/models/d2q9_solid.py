@@ -133,21 +133,43 @@ def _angle(dx_, dy_):
     return jnp.where(d2 > 0.0, theta, jnp.zeros_like(d2))
 
 
-def _curvature_theta(dx_, dy_, dxx, dyy, dxy):
+def _curvature(dx_, dy_, dxx, dyy, dxy):
+    """Interface curvature from the fi_s derivatives, zero where the
+    gradient vanishes (shared by the growth term and the K quantity)."""
     d2 = dx_ * dx_ + dy_ * dy_
     safe = jnp.where(d2 > 0.0, d2, 1.0)
     k = (2.0 * dx_ * dy_ * dxy - dx_ * dx_ * dyy
          - dy_ * dy_ * dxx) * safe ** -1.5
-    return jnp.where(d2 > 0.0, k, jnp.zeros_like(d2)), _angle(dx_, dy_)
+    return jnp.where(d2 > 0.0, k, jnp.zeros_like(d2)), d2, safe
+
+
+def _curvature_theta(dx_, dy_, dxx, dyy, dxy):
+    k, _, _ = _curvature(dx_, dy_, dxx, dyy, dxy)
+    return k, _angle(dx_, dy_)
 
 
 def _cl_eq(ctx: NodeCtx, T):
     """Equilibrium interface concentration with Gibbs-Thomson curvature
-    undercooling + 4-fold anisotropy (reference getCl_eq)."""
+    undercooling + 4-fold anisotropy (reference getCl_eq).
+
+    ``cos(4(theta - Theta0))`` is evaluated through the double-angle
+    identities on the gradient components (``cos theta = dx/|grad|``)
+    instead of the angle itself: exact same value, and no ``arccos`` —
+    the one primitive Mosaic cannot lower, which kept this model off the
+    fused engine."""
     _, dx_, dy_, dxx, dyy, dxy = _fi_derivs(ctx)
-    k, theta = _curvature_theta(dx_, dy_, dxx, dyy, dxy)
-    aniso = 1.0 - 15.0 * ctx.setting("SurfaceAnisotropy") * jnp.cos(
-        4.0 * (theta - ctx.setting("Theta0")))
+    k, d2, safe = _curvature(dx_, dy_, dxx, dyy, dxy)
+    c2 = (dx_ * dx_ - dy_ * dy_) / safe
+    s2 = 2.0 * dx_ * dy_ / safe
+    c4 = c2 * c2 - s2 * s2
+    s4 = 2.0 * s2 * c2
+    # vanishing gradient: theta := 0 (the reference's convention), so
+    # cos(4(theta - Theta0)) reduces to cos(4 Theta0)
+    c4 = jnp.where(d2 > 0.0, c4, jnp.ones_like(d2))
+    s4 = jnp.where(d2 > 0.0, s4, jnp.zeros_like(d2))
+    th0 = 4.0 * ctx.setting("Theta0")
+    cos4 = c4 * jnp.cos(th0) + s4 * jnp.sin(th0)
+    aniso = 1.0 - 15.0 * ctx.setting("SurfaceAnisotropy") * cos4
     return ctx.setting("C0") + ((T - ctx.setting("Teq"))
                                 + ctx.setting("GTCoef") * k * aniso
                                 ) / ctx.setting("LiquidusSlope")

@@ -78,11 +78,18 @@ def _band_rows(model: Model, ny: int, nx: int) -> Optional[int]:
     return best
 
 
-def _fused_band(by: int, ny: int) -> int:
+def _fused_band(by: int, ny: int, nx: int) -> int:
     """Band height of the temporally-fused kernel (its VMEM working set
-    holds two full intermediate stacks, so the band is capped lower)."""
+    holds two full intermediate stacks, so the band is capped lower than
+    the single-step kernel's).  The cap scales inversely with the row
+    width so the fused working set stays at the level measured safe on
+    v5e: 48 rows at nx=1024 (beats the old 32 by ~14% on the karman
+    1024x100 geometry — fewer bands, less 16-halo-row DMA amplification
+    — and ~2% at 1024^2; 56+ shows no further gain and crowds the
+    scoped-VMEM budget), halving for each doubling of nx."""
+    cap = max(8, min(48, ((64 * 1024 // max(nx, 1) - 16) // 8) * 8))
     by2 = by
-    while by2 > 8 and (ny % by2 or by2 > 32):
+    while by2 > 8 and (ny % by2 or by2 > cap):
         by2 -= 8
     return by2
 
@@ -114,7 +121,7 @@ def _pad_rows(model: Model, ny: int, nx: int) -> Optional[int]:
         by = _band_rows(model, ny_pad, nx)
         if by is None:
             continue
-        by2 = _fused_band(by, ny_pad)
+        by2 = _fused_band(by, ny_pad, nx)
         score = ny_pad * (1.0 + (by2 + 16.0) / by2)
         if best_score is None or score < best_score:
             best, best_score = ny_pad - ny, score
@@ -233,7 +240,7 @@ def make_pallas_iterate(model: Model, shape, dtype=jnp.float32,
             raise ValueError(f"no valid band height for shape {shape}")
     ny = ny_phys + pad
     by = _band_rows(model, ny, nx)
-    by2 = _fused_band(by, ny)
+    by2 = _fused_band(by, ny, nx)
     assert ny % by2 == 0   # _band_rows guarantees multiple-of-8 divisors
     if interpret is None:
         interpret = jax.default_backend() != "tpu"

@@ -201,7 +201,11 @@ def bench_baseline_cases(results):
     results["karman_mlups"] = round(v, 1)
     results["karman_engine"] = lat._fast_name or "xla"
     results["karman_shape"] = f"{nx}x{ny}"
-    checks.append(("karman_solver", v, 2.0, 2 * m.n_storage * 4 + 2))
+    # the resident engine runs 8 steps per kernel call (per-step HBM
+    # traffic (1R+1W)/8 -> credible ceiling 8x the streaming roofline);
+    # the band/XLA paths stay capped at 2x/1x-class ceilings
+    cap_k = 8.0 if "resident" in results["karman_engine"] else 2.0
+    checks.append(("karman_solver", v, cap_k, 2 * m.n_storage * 4 + 2))
 
     # ---- drop.xml physics at the reference's original 512^2 ----------- #
     n = 512 if on_tpu else 32

@@ -736,6 +736,20 @@ class Lattice:
                 return it, f"pallas_sharded[{dict(self.mesh.shape)}]"
             return None, None
         if (not has_series
+                and pallas_d2q9.supports_resident(self.model, self.shape,
+                                                  self.dtype)):
+            # small domains: whole lattice VMEM-resident, 8 steps per
+            # kernel call — (1R+1W)/8 HBM traffic per step.  First call
+            # is probed (the budget cannot see Mosaic's temporaries);
+            # on failure the probe falls back — for the resident engine
+            # the ladder is empty, so straight to the band/XLA path
+            present = pallas_d2q9.present_types(
+                self.model, self._flags_host())
+            self._fast_probing = True
+            return (pallas_d2q9.make_resident_iterate(
+                self.model, self.shape, self.dtype, present=present),
+                f"pallas_resident[{self.model.name},fuse=8]")
+        if (not has_series
                 and pallas_d2q9.supports(self.model, self.shape,
                                          self.dtype)):
             present = pallas_d2q9.present_types(
@@ -829,11 +843,36 @@ class Lattice:
                     probe = jax.tree.map(jnp.copy, self.state)
                     return it_fn(probe, self.params, nfast)
 
+                was_resident = (self._fast_name or "").startswith(
+                    "pallas_resident")
                 try:
                     self.state = attempt(fast)
                 except Exception as e:  # noqa: BLE001
+                    if was_resident:
+                        # resident probe failed (its budget can't see
+                        # Mosaic temporaries): the band engine is the
+                        # proven fallback for these models — swap it in
+                        # and continue this very call
+                        from tclb_tpu.ops import pallas_d2q9
+                        log.info(f"engine: {self._fast_name} failed to "
+                                 f"compile ({type(e).__name__}); band "
+                                 "engine fallback")
+                        present = pallas_d2q9.present_types(
+                            self.model, self._flags_host())
+                        self._fast = fast = \
+                            pallas_d2q9.make_pallas_iterate(
+                                self.model, self.shape, self.dtype,
+                                fuse=2, present=present)
+                        self._fast_name = (f"pallas_2d"
+                                           f"[{self.model.name},fuse=2]")
+                        self._fast_probing = False
+                        self.state = fast(self.state, self.params, nfast)
+                        if not full:
+                            self.state = self._iterate(
+                                self.state, self.params, 1)
+                        return
                     if self.mesh is not None:
-                        ladder = []   # sharded engine: no per-cap rebuild
+                        ladder = []   # sharded engine: no cap ladder
                     else:
                         log.debug(f"engine: {self._fast_name} first "
                                   f"compile failed ({type(e).__name__}); "
@@ -883,7 +922,8 @@ class Lattice:
                         self.state = self._iterate(self.state, self.params,
                                                    niter)
                         return
-                if self.mesh is None:
+                if self.mesh is None and not was_resident:
+                    # verdict caches belong to the generic engine only
                     pallas_generic.set_mosaic_ok(self.model, self.shape,
                                                  True)
                     pallas_generic.set_build_cfg(self.model, self.shape,

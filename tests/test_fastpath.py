@@ -63,7 +63,8 @@ def test_engine_dispatch_matches_xla(monkeypatch):
     monkeypatch.setenv("TCLB_FASTPATH", "force")
     _, lat_f = _karman_lattice()
     lat_f.iterate(niter)
-    assert lat_f._fast_name == "pallas_2d[d2q9,fuse=2]"
+    # small domains select the VMEM-resident deep-fusion engine
+    assert lat_f._fast_name == "pallas_resident[d2q9,fuse=8]"
 
     np.testing.assert_allclose(np.asarray(lat_f.state.fields),
                                np.asarray(lat_x.state.fields),

@@ -281,16 +281,21 @@ def bench_adjoint(results):
     theta0 = design.get(lat.state, lat.params)
 
     def timed_grad(engine):
-        gf = make_unsteady_gradient(m, design, niter, levels=2,
+        # production defaults: levels auto (no-recompute when the chunk
+        # inputs fit HBM), chunked fused kernels on the pallas engine
+        gf = make_unsteady_gradient(m, design, niter, levels=None,
                                     engine=engine, shape=(ny, nx))
         obj, g, _ = gf(theta0, lat.state, lat.params)
         float(obj)
-        t0 = time.perf_counter()
-        obj, g, _ = gf(theta0, lat.state, lat.params)
-        s = float(obj) + float(jnp.sum(g))
-        dt = time.perf_counter() - t0
-        assert np.isfinite(s)
-        return ny * nx * niter / dt / 1e6
+        best = 0.0
+        for _ in range(2):   # first post-compile call pays one-time costs
+            t0 = time.perf_counter()
+            obj, g, _ = gf(theta0, lat.state, lat.params)
+            s = float(obj) + float(jnp.sum(g))
+            dt = time.perf_counter() - t0
+            assert np.isfinite(s)
+            best = max(best, ny * nx * niter / dt / 1e6)
+        return best
 
     try:
         results["adjoint_pallas_mlups"] = round(timed_grad("pallas"), 1)
@@ -300,6 +305,11 @@ def bench_adjoint(results):
             / results["adjoint_xla_mlups"], 2)
     except Exception as e:      # never let the adjoint probe kill bench
         results["adjoint_error"] = str(e)[:200]
+        return []
+    # wall-clock regression guard (round-4 weak #8), OUTSIDE the probe's
+    # try so a silent fallback to the XLA path actually fails the bench
+    assert results["adjoint_speedup"] > 1.5, \
+        f"pallas adjoint regressed to XLA-class: {results}"
     return []
 
 

@@ -93,9 +93,18 @@ def make_objective_run(model: Model, niter: int, action: str = "Iteration",
     ``step`` overrides the engine: any differentiable
     ``(state, params) -> state`` with the per-step-globals contract (the
     Pallas diff step from :mod:`tclb_tpu.ops.pallas_adjoint` plugs in
-    here)."""
+    here).  A step advertising ``step.chunk = k`` advances ``k``
+    iterations per call, so the scan runs ``niter // k`` bodies
+    (``niter`` must divide); with ``step.returns_inc`` the step returns
+    ``(state, chunk_globals)`` and the objective integrates the second
+    value (state.globals_ keeps last-iteration semantics)."""
     if step is None:
         step = make_action_step(model, action, streaming)
+    chunk = int(getattr(step, "chunk", 1))
+    returns_inc = bool(getattr(step, "returns_inc", False))
+    if niter % chunk:
+        raise ValueError(f"niter={niter} not divisible by the engine "
+                         f"chunk {chunk}")
 
     def run(state: LatticeState, params: SimParams):
         w = objective_weights(model, params)
@@ -105,21 +114,126 @@ def make_objective_run(model: Model, niter: int, action: str = "Iteration",
             if hasattr(step, "prepare") else step
 
         def body(s):
+            if returns_inc:
+                s2, ginc = step_fn(s, params)
+                return s2, jnp.sum(w * ginc)
             s2 = step_fn(s, params)
             return s2, jnp.sum(w * s2.globals_)
 
-        final, obj = nested_checkpoint_scan(body, state, niter, levels)
+        final, obj = nested_checkpoint_scan(body, state, niter // chunk,
+                                            levels)
         return obj, final
 
     return run
 
 
+def design_needs(design) -> Optional[set]:
+    """What a design's ``put`` touches: a subset of
+    ``{"state", "series"}``, or None for design types this classifier
+    does not know (auto engine selection then falls back to XLA)."""
+    from tclb_tpu.adjoint.design import (CompositeDesign, InternalTopology,
+                                         OptimalControl, Reparam)
+    if isinstance(design, InternalTopology):
+        return {"state"}
+    if isinstance(design, OptimalControl):
+        return {"series"}
+    if isinstance(design, Reparam):
+        return design_needs(design.inner)
+    if isinstance(design, CompositeDesign):
+        out: set = set()
+        for d in design.designs:
+            n = design_needs(d)
+            if n is None:
+                return None
+            out |= n
+        return out
+    return None
+
+
+def _pick_engine(model: Model, design, niter: int, engine: str,
+                 shape: Optional[tuple], action: str,
+                 streaming, dtype=jnp.float32,
+                 has_series: bool = False) -> Optional[object]:
+    """Resolve ``engine`` ("auto"/"pallas"/"xla") to a diff step (or None
+    for the XLA path).  The production auto-selection: the fused Pallas
+    adjoint runs whenever it covers the configuration — the reference's
+    adjoint is ALWAYS its tuned ``Run_b`` kernel (src/cuda.cu.Rt:240-256);
+    XLA is the fallback, not the default."""
+    import jax as _jax
+    from tclb_tpu.ops import pallas_adjoint
+    from tclb_tpu.utils import log
+    if engine == "xla":
+        return None
+    if engine not in ("auto", "pallas"):
+        raise ValueError(f"unknown adjoint engine {engine!r}")
+    if shape is None:
+        if engine == "pallas":
+            raise ValueError("engine='pallas' needs the lattice shape")
+        return None
+    needs = design_needs(design)
+    reasons = []
+    if action != "Iteration":
+        reasons.append(f"action {action!r}")
+    if streaming is not None:
+        reasons.append("custom streaming")
+    if needs is None:
+        reasons.append(f"unknown design type {type(design).__name__}")
+    if _jax.default_backend() != "tpu" and engine != "pallas":
+        # cheap check FIRST: skip the interpret-mode supports probe when
+        # auto would fall back anyway
+        reasons.append("not on TPU (interpret-mode kernels are slower "
+                       "than XLA)")
+    # series-mode kernels whenever the DESIGN differentiates the series
+    # OR the params carry a fixed <Control> schedule (the per-step aux
+    # must follow it either way); aux cotangents only for the former
+    design_series = bool(needs and "series" in needs)
+    series = design_series or has_series
+    if not reasons and not pallas_adjoint.supports_diff(
+            model, shape, dtype, series=series):
+        # supports_diff rejects non-f32 dtypes, so double-precision
+        # lattices fall back to the XLA engine here
+        reasons.append(f"model/shape/dtype unsupported "
+                       f"({model.name} {shape} {jnp.dtype(dtype).name})")
+    k = 1 if series else pallas_adjoint.max_chunk(model)
+    while k > 1 and niter % k:
+        k -= 1
+    if reasons:
+        if engine == "pallas":
+            raise ValueError("pallas adjoint unavailable: "
+                             + "; ".join(reasons))
+        log.debug("adjoint engine: XLA (" + "; ".join(reasons) + ")")
+        return None
+    step = pallas_adjoint.make_diff_step(model, shape, dtype, k=k,
+                                         series=series,
+                                         aux_grad=design_series)
+    log.info(f"adjoint engine: {step.engine_name}")
+    return step
+
+
+def auto_levels(model: Model, shape, niter: int, chunk: int = 1,
+                budget_bytes: float = 6e9, dtype=jnp.float32) -> int:
+    """Pick the remat depth FOR THE CHUNKED PALLAS STEP: levels=1 (store
+    every chunk input — NO recompute in the reverse sweep) whenever the
+    stored states fit the budget, else nested remat.  The reference makes
+    the same trade with its snapshot hierarchy (SnapLevel,
+    src/Lattice.cu.Rt:34-49): disk is the fallback, full storage the
+    fast path.  (The XLA step keeps levels=2: its un-remat'd reverse
+    stores every stage temporary, far more than one state per body.)"""
+    per = jnp.dtype(dtype).itemsize * model.n_storage * int(np.prod(shape))
+    n_bodies = max(niter // max(chunk, 1), 1)
+    if per * n_bodies <= budget_bytes:
+        return 1
+    return 2
+
+
 def make_unsteady_gradient(model: Model, design, niter: int,
                            action: str = "Iteration",
                            streaming: Optional[Streaming] = None,
-                           levels: int = 2,
-                           engine: str = "xla",
-                           shape: Optional[tuple] = None) -> Callable:
+                           levels: Optional[int] = None,
+                           engine: str = "auto",
+                           shape: Optional[tuple] = None,
+                           dtype=jnp.float32,
+                           has_series: bool = False) -> Callable:
     """``grad_fn(theta, state, params) -> (objective, grads, final_state)``
     — reverse-mode sensitivity of the time-integrated objective with respect
     to the design vector (reference unsteady adjoint + parameter gather,
@@ -129,27 +243,26 @@ def make_unsteady_gradient(model: Model, design, niter: int,
     injected into (state, params) inside the differentiated function, so the
     gradient flows to exactly the declared degrees of freedom.
 
-    ``engine="pallas"`` (with ``shape``) runs BOTH sweeps on the fused
-    Pallas kernels (forward = the generic engine's globals flavor,
-    backward = the dedicated adjoint band kernel — the TPU analogue of the
-    reference's Tapenade-generated ``Run_b`` device kernel,
-    src/cuda.cu.Rt:240-256).  Restricted to storage-plane designs
-    (InternalTopology): settings/series cotangents are zero on this
-    engine — use the XLA engine for Control-gradient runs."""
-    step = None
-    if engine == "pallas":
-        if shape is None:
-            raise ValueError("engine='pallas' needs the lattice shape")
-        from tclb_tpu.adjoint.design import InternalTopology
-        from tclb_tpu.ops.pallas_adjoint import make_diff_step
-        if not isinstance(design, InternalTopology):
-            raise ValueError(
-                "engine='pallas' differentiates storage-plane designs "
-                "only (InternalTopology); settings/Control-series "
-                "designs need engine='xla'")
-        step = make_diff_step(model, shape)
-    elif engine != "xla":
-        raise ValueError(f"unknown adjoint engine {engine!r}")
+    ``engine`` selects the step implementation: ``"auto"`` (default) runs
+    BOTH sweeps on the fused Pallas kernels whenever they cover the
+    model/shape/design — forward = the generic engine's in-kernel-globals
+    flavor fused ``k`` steps per band pass, backward = the in-band VJP of
+    the same chain (the TPU analogue of the reference's Tapenade-generated
+    ``Run_b`` device kernel, src/cuda.cu.Rt:240-256, including its
+    settings tape ``DynamicsS_b`` for Control-series designs) — and falls
+    back to the XLA step otherwise.  ``"pallas"`` insists (raising when
+    unsupported, ``shape`` required); ``"xla"`` forces the fallback.
+
+    ``levels=None`` picks the remat depth automatically: no-recompute
+    (levels=1) when the stored chunk inputs fit in HBM."""
+    step = _pick_engine(model, design, niter, engine, shape, action,
+                        streaming, dtype, has_series)
+    if levels is None:
+        # no-recompute tape only for the custom_vjp chunk step (its
+        # backward stores nothing beyond the chunk inputs); the XLA step
+        # keeps the nested-remat default
+        levels = auto_levels(model, shape, niter, step.chunk,
+                             dtype=dtype) if step is not None else 2
     run = make_objective_run(model, niter, action, streaming, levels,
                              step=step)
 
@@ -164,7 +277,13 @@ def make_unsteady_gradient(model: Model, design, niter: int,
         (obj, final), g = vg(theta, state, params)
         return obj, g, final
 
-    return jax.jit(grad_fn)
+    jitted = jax.jit(grad_fn)
+
+    def wrapped(theta, state, params):
+        return jitted(theta, state, params)
+
+    wrapped.engine_name = getattr(step, "engine_name", "xla")
+    return wrapped
 
 
 def make_spilled_gradient(model: Model, design, niter: int, segment: int,
@@ -280,7 +399,10 @@ def make_spilled_gradient(model: Model, design, niter: int, segment: int,
 def make_steady_gradient(model: Model, design, n_adjoint: int = 100,
                          action: str = "Iteration",
                          streaming: Optional[Streaming] = None,
-                         tol: float = 1e-10, strict: bool = False) -> Callable:
+                         tol: float = 1e-10, strict: bool = False,
+                         engine: str = "auto",
+                         shape: Optional[tuple] = None,
+                         dtype=jnp.float32) -> Callable:
     """Fixed-point (steady) adjoint: with the primal converged, solve
     ``lambda = A^T lambda + dJ/ds`` by ``n_adjoint`` adjoint iterations
     (the Neumann series of VJPs of one step) and return
@@ -297,14 +419,26 @@ def make_steady_gradient(model: Model, design, n_adjoint: int = 100,
     instead of returning a silently wrong gradient (the reference leaves
     the iteration count to the user's XML loop,
     src/Handlers.cpp.Rt:1664-1707 — here convergence is reported).
+
+    ``engine="auto"`` (with ``shape``) runs each adjoint pass on the
+    fused Pallas kernels at chunk 1 (the Neumann series applies ONE
+    step's transpose per pass); XLA otherwise.
     """
-    step = make_action_step(model, action, streaming)
+    step = _pick_engine(model, design, 1, engine, shape, action, streaming,
+                        dtype)
+    # (steady runs hold a converged primal: Control series do not apply)
+    returns_inc = bool(getattr(step, "returns_inc", False))
+    if step is None:
+        step = make_action_step(model, action, streaming)
 
     def one_step(theta, fields, state, params):
         state, params = design.put(theta, state.replace(fields=fields),
                                    params)
-        s2 = step(state, params)
         w = objective_weights(model, params)
+        if returns_inc:
+            s2, ginc = step(state, params)
+            return s2.fields, jnp.sum(w * ginc)
+        s2 = step(state, params)
         return s2.fields, jnp.sum(w * s2.globals_)
 
     def _tree_norm(t) -> jnp.ndarray:

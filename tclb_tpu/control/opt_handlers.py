@@ -165,13 +165,20 @@ class acAdjoint(GenericAction):
         theta = design.get(s.lattice.state, s.lattice.params)
         if kind == "steady":
             n_adj = int(round(s.units.alt(self.node.get("NAdjoint", "100"))))
-            grad_fn = make_steady_gradient(s.model, design, n_adjoint=n_adj)
+            grad_fn = make_steady_gradient(s.model, design, n_adjoint=n_adj,
+                                           shape=s.lattice.shape,
+                                           dtype=s.lattice.dtype)
             obj, g = grad_fn(theta, s.lattice.state, s.lattice.params)
         else:
             niter = int(round(s.units.alt(self.node.get("Iterations", "0"))))
             if niter <= 0:
                 raise ValueError("unsteady <Adjoint> needs Iterations=")
-            grad_fn = make_unsteady_gradient(s.model, design, niter)
+            grad_fn = make_unsteady_gradient(s.model, design, niter,
+                                             shape=s.lattice.shape,
+                                             dtype=s.lattice.dtype,
+                                             has_series=s.lattice.params
+                                             .time_series is not None)
+            s.adjoint_engine = grad_fn.engine_name
             obj, g, final = grad_fn(theta, s.lattice.state, s.lattice.params)
             s.lattice.state = final
             s.iter += niter
@@ -195,7 +202,12 @@ class acFDTest(GenericAction):
         checks = int(self.node.get("Checks", "5"))
         eps = float(self.node.get("Epsilon", "1e-6"))
         theta = design.get(s.lattice.state, s.lattice.params)
-        grad_fn = make_unsteady_gradient(s.model, design, niter)
+        grad_fn = make_unsteady_gradient(s.model, design, niter,
+                                         shape=s.lattice.shape,
+                                         dtype=s.lattice.dtype,
+                                         has_series=s.lattice.params
+                                         .time_series is not None)
+        s.adjoint_engine = grad_fn.engine_name
         obj, g, _ = grad_fn(theta, s.lattice.state, s.lattice.params)
         run = make_objective_run(s.model, niter)
 
@@ -269,7 +281,12 @@ class acOptimize(GenericAction):
         method = self.node.get("Method", "MMA")
         max_eval = int(self.node.get("MaxEvaluations", "20"))
         step = float(self.node.get("Step", "1.0"))
-        grad_full = make_unsteady_gradient(s.model, design, niter)
+        grad_full = make_unsteady_gradient(s.model, design, niter,
+                                           shape=s.lattice.shape,
+                                           dtype=s.lattice.dtype,
+                                           has_series=s.lattice.params
+                                           .time_series is not None)
+        s.adjoint_engine = grad_full.engine_name
 
         def grad_fn(theta):
             obj, g, _ = grad_full(theta, s.lattice.state, s.lattice.params)
@@ -344,7 +361,12 @@ class acOptSolve(GenericAction):
         step = float(self.node.get("Step", "1.0"))
         if niter <= 0:
             raise ValueError("<OptSolve> needs Iterations=")
-        grad_fn = make_unsteady_gradient(s.model, design, chunk)
+        grad_fn = make_unsteady_gradient(s.model, design, chunk,
+                                         shape=s.lattice.shape,
+                                         dtype=s.lattice.dtype,
+                                         has_series=s.lattice.params
+                                         .time_series is not None)
+        s.adjoint_engine = grad_fn.engine_name
         lo, hi = _design_bounds(design)
         done = 0
         while done < niter:

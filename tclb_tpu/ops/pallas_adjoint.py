@@ -1,38 +1,33 @@
-"""Differentiable Pallas fast path: ``custom_vjp`` around the fused step
-with a Pallas BACKWARD kernel.
+"""Differentiable Pallas fast path: ``custom_vjp`` around the fused
+action chunk with a Pallas BACKWARD band kernel.
 
 The reference's adjoint is itself a tuned device kernel: Tapenade emits
 ``Run_b`` and the generated adjoint streaming scatters through the margins
 (reference src/cuda.cu.Rt:240-256 ``RunKernel<..., adjoint>``, transpose
-access in src/LatticeAccess.inc.cpp.Rt:227-261).  Round 3 only
-differentiated the XLA step, so every ``<Adjoint>``/``<Optimize>`` run paid
-~10x the engine rate in both sweeps.  Here the same structure as the
-reference's falls out of two observations:
+access in src/LatticeAccess.inc.cpp.Rt:227-261), with a dedicated settings
+tape for control gradients (src/cuda.cu.Rt:216 ``DynamicsS_b``,
+tools/makeAD:24).  Here BOTH sweeps run the registry-driven band machinery
+of the generic engine (ops/pallas_generic):
 
-* the transpose of pull-streaming is pull-streaming with NEGATED vectors:
-  ``out_i(x) = in_i(x - e_i)`` transposes to
-  ``lambda_in_i(x) = lambda_pre_i(x + e_i)`` — no scatter needed, the
-  backward kernel re-uses the band/halo machinery of the forward one;
-* the collide (boundaries + collision + Globals contributions) is
-  POINTWISE in the streamed state for the pure-streaming models, so its
-  VJP is obtained by ``jax.vjp`` of the model's own stage function traced
-  INSIDE the backward kernel — the transposed operations (adds, selects,
-  broadcast-of-reductions) lower through Mosaic exactly like the primal.
+* the FORWARD is the generic kernel's in-kernel-globals flavor, fused
+  ``k`` iterations per band pass (one HBM round trip per ``k`` steps);
+* the BACKWARD band kernel re-traces the SAME action chain
+  (``run_action_plan`` — the exact collide semantics of the forward
+  kernel) on a band extended by the chain's total reach ``R`` and takes
+  ``jax.vjp`` of it in-band.  A band of ``lambda_in`` rows ``[a, b)``
+  receives cotangent only from output rows within ``R``; computing the
+  chain on ``[a-R, b+R)`` from inputs on ``[a-2R, b+2R)`` (all inside the
+  8-row DMA halo blocks) covers that cone exactly, so no cross-band
+  scatter is needed — the transposed streaming falls out of the VJP of
+  the in-band pull slices.
 
-One backward band pass computes
-``lambda_in_i(x) = G_i(x + e_i)`` with
-``G_i(y) = sum_j dC_j/dp_i (p(y)) . lambda_out_j(y)
-          + sum_g dg/dp_i (p(y)) . lambda_globals_g``
-on a 1-row-extended band (G of a boundary row is recomputed by the
-neighboring band — recompute instead of cross-band accumulation, the same
-trade the forward halo bands make).
-
-Scope (checked by :func:`supports_diff`): single-stage Iteration, pull
-reach 1, no Field stencils, SUM Globals, f32, aligned shapes.  The
-cotangents for settings/zone tables are ZERO by contract — the design must
-live in storage planes (InternalTopology — the reference's adjoint
-optimizes exactly those) — and :func:`make_diff_step` is opt-in via
-``make_unsteady_gradient(engine="pallas")``.
+Because the VJP differentiates the full traced chain, the scope is the
+generic engine's own: multi-stage actions, Field stencils, zonal
+settings, and — unlike round 4 — cotangents for SETTINGS (accumulated
+in-kernel across bands, the ``DynamicsS_b`` analogue) and for the aux
+stack (zonal planes + Control ``_DT`` planes), which chain to
+``params.time_series`` so OptimalControl/Fourier/BSpline control
+gradients run fused too (``series=True`` flavor, one step per chunk).
 """
 
 from __future__ import annotations
@@ -49,105 +44,136 @@ from jax.experimental.pallas import tpu as pltpu
 from tclb_tpu.core.lattice import LatticeState, SimParams
 from tclb_tpu.core.registry import Model
 from tclb_tpu.ops import pallas_generic
-from tclb_tpu.ops.pallas_generic import _HALO, KernelCtx, action_plan
+from tclb_tpu.ops.pallas_generic import (_HALO, action_plan, run_action_plan)
+
+_probe_cache: dict = {}
 
 
-def _stored_planes(model: Model, shape, dtype) -> Optional[set]:
-    """Indices of storage planes the Run stage writes, discovered by an
-    abstract trace of the stage function (the write set is the dict the
-    stage returns — registry metadata doesn't carry it)."""
-    stage = model.stages[model.actions["Iteration"][0]]
-    fn = model.stage_fns[stage.main]
-    ns = model.n_storage
-    ny, nx = 8, int(shape[1])
-
-    def wrapper(planes, sett, zone_table):
-        zonal = {nm: planes[0] * 0.0 for nm in model.zonal_settings}
-        ctx = KernelCtx(model, list(planes), lambda *a: None,
-                        jnp.zeros((ny, nx), jnp.int32), zonal, sett,
-                        dtype, 0, set(model.node_types))
-        return fn(ctx)
-
-    try:
-        res = jax.eval_shape(
-            wrapper,
-            [jax.ShapeDtypeStruct((ny, nx), dtype)] * ns,
-            jax.ShapeDtypeStruct((len(model.settings),), dtype),
-            jax.ShapeDtypeStruct((len(model.settings), model.zone_max),
-                                 dtype))
-    except Exception:  # noqa: BLE001 — untraceable stage: not eligible
-        return None
-    if not isinstance(res, dict):
-        return set(range(ns))
-    out = set()
-    for name in res:
-        if name in model.groups:
-            out.update(model.groups[name])
-        else:
-            out.add(model.storage_index[name])
-    return out
+def max_chunk(model: Model, cap: int = 4) -> int:
+    """Largest per-chunk iteration count ``k`` whose fused chain reach
+    fits the backward kernel's halo budget (``2*R <= 8``: the in-band
+    chain needs inputs ``2R`` beyond the band)."""
+    best = 0
+    for k in range(1, cap + 1):
+        _, reach = action_plan(model, "Iteration", fuse=k)
+        if 2 * max(reach, 1) <= _HALO:
+            best = k
+    return best
 
 
-def supports_diff(model: Model, shape, dtype) -> bool:
-    """Whether the differentiable Pallas step covers this configuration:
-    everything the forward generic kernel needs, PLUS single-stage /
-    reach-1 / no-Fields (the backward kernel's pointwise-collide
-    factorization) and a write set covering every moving plane (an
-    unmentioned streamed plane would pass through RAW in the forward
-    kernel but PULLED in the backward factorization)."""
+def supports_diff(model: Model, shape, dtype, series: bool = False) -> bool:
+    """Whether the differentiable Pallas chunk covers this configuration:
+    everything the forward generic kernel needs, plus aligned unpadded
+    shapes (the backward band kernel has no ghost-row machinery), chain
+    reach within the halo budget, and SUM Globals (the objective)."""
     if model.ndim != 2 or len(shape) != 2:
-        return False   # the backward factorization is 2D-only for now
+        return False
     if not pallas_generic.supports(model, shape, dtype, probe=False):
         return False
     ny, nx = (int(s) for s in shape)
     if ny % 8 or nx % 128:
         return False
-    if model.fields:
+    if pallas_generic._pad_rows(model, ny, nx, 1) != 0:
         return False
-    plan, reach = action_plan(model, "Iteration", fuse=1)
-    if len(plan) != 1 or reach > 1:
+    if max_chunk(model) < 1:
         return False
-    # the forward flavor with in-kernel globals is the diff step's primal
-    # (objectives come from Globals); a model without Globals has no
-    # differentiable objective here
     if not (1 <= model.n_globals <= 8) \
             or any(g.op != "SUM" for g in model.globals_):
         return False
-    stored = _stored_planes(model, shape, dtype)
-    if stored is None:
+    if len(model.settings) > 1024:
+        return False   # the (8, 128) in-kernel settings-tape accumulator
+    if series and not model.zonal_settings:
         return False
-    for k in range(model.n_storage):
-        dxk, dyk = int(model.ei[k, 0]), int(model.ei[k, 1])
-        if (dxk or dyk) and k not in stored:
-            return False
-    return True
+    key = (id(model), model.name, nx, series)
+    if key not in _probe_cache:
+        try:
+            step = make_diff_step(model, (16, nx), dtype, interpret=True,
+                                  series=series, k=1)
+            n_aux = 1 + (2 if series else 1) * len(model.zonal_settings)
+            fields = jax.ShapeDtypeStruct((model.n_storage, 16, nx), dtype)
+            sett = jax.ShapeDtypeStruct((len(model.settings),), dtype)
+            aux = jax.ShapeDtypeStruct((n_aux, 16, nx), dtype)
+
+            def loss(f, s, a):
+                out, g, g_last = step.arrays(f, s, a,
+                                             jnp.zeros((1,), jnp.int32))
+                return jnp.sum(out) + jnp.sum(g) + jnp.sum(g_last)
+
+            jax.eval_shape(jax.grad(loss, argnums=(0, 1, 2)),
+                           fields, sett, aux)
+            _probe_cache[key] = True
+        except Exception as e:  # noqa: BLE001 — untraceable = ineligible
+            from tclb_tpu.utils import log
+            log.debug(f"pallas_adjoint: {model.name} diff probe failed: "
+                      f"{type(e).__name__}: {str(e)[:200]}")
+            _probe_cache[key] = False
+    return _probe_cache[key]
 
 
 def make_diff_step(model: Model, shape, dtype=jnp.float32,
                    interpret: Optional[bool] = None,
                    present: Optional[set] = None,
+                   k: Optional[int] = None,
+                   series: bool = False,
+                   aux_grad: Optional[bool] = None,
                    by_bwd: Optional[int] = None):
-    """Build ``step(state, params) -> state`` running ONE iteration on the
-    fused Pallas kernel, differentiable end-to-end: the forward is the
-    generic engine's globals flavor, the backward a dedicated Pallas band
-    kernel (module docstring).  Drop-in for ``make_action_step`` inside
-    the adjoint drivers (same state contract: globals_ = this step's)."""
-    if not supports_diff(model, shape, dtype):
-        raise ValueError(f"pallas diff step unsupported: {model.name} "
-                         f"{shape}")
+    """Build ``step(state, params) -> (state, chunk_globals)`` advancing
+    ``step.chunk`` iterations on the fused Pallas kernels,
+    differentiable end-to-end: forward = the generic engine's
+    in-kernel-globals flavor at ``fuse=k``, backward = the in-band VJP
+    of the same chain (module docstring).  Plugs into
+    :func:`tclb_tpu.adjoint.run.make_objective_run` via the
+    ``returns_inc`` protocol: ``state.globals_`` keeps LAST-iteration
+    semantics (matching the per-step engines) while ``chunk_globals``
+    is the k-step sum the time-integrated objective accumulates.
+
+    ``series=True`` builds the Control-series flavor: one step per
+    chunk, per-iteration zonal + ``_DT`` aux planes rebuilt (and
+    differentiated) each step, cotangents flowing to
+    ``params.time_series`` — the reference's control-gradient tape.
+    ``aux_grad`` (default = ``series``) controls whether the backward
+    kernel emits the aux-stack cotangent at all (an extra HBM write)."""
     ny, nx = (int(s) for s in shape)
+    if series:
+        k = 1
+    if k is None:
+        k = max_chunk(model)
+    if aux_grad is None:
+        aux_grad = series
+    plan_k, reach = action_plan(model, "Iteration", fuse=k)
+    R = max(reach, 1)
+    if 2 * R > _HALO:
+        raise ValueError(f"chunk k={k} reach {reach} exceeds halo budget")
+    if ny % 8 or nx % 128:
+        raise ValueError(f"diff step needs aligned shape, got {shape}")
+
+    # full_band: all-aligned stage windows — measurably faster at fuse=k
+    # and REQUIRED for the backward chain (the VJP cone arithmetic below
+    # assumes full-height stages)
     base = pallas_generic.make_pallas_iterate(
-        model, shape, dtype, interpret=interpret, fuse=1, present=present)
+        model, shape, dtype, interpret=interpret, fuse=1, present=present,
+        full_band=True)
     impl = base._impl
-    call_g, by_f = impl["call_g"], impl["by"]
+    if impl["pad"] != 0:
+        raise ValueError("diff step requires an unpadded band layout")
+    mk_call = impl["mk_call"]
+    call_f = mk_call(plan_k, with_dt=series, with_globals="split")
     zonal_si, zshift = impl["zonal_si"], impl["zshift"]
     nt_present = impl["nt_present"]
-    assert impl["pad"] == 0 and call_g is not None
-    # the backward band holds TWO input stacks plus the VJP's doubled
-    # temporaries — size its band separately (~1/2 the forward band),
-    # kept a multiple of 8 (sublane tile) that divides ny
-    by = by_bwd if by_bwd is not None else max(8, (by_f // 16) * 8)
-    by = max(8, (by // 8) * 8)
+    # backward bands default WIDER than the forward's (64 vs 32): the
+    # halo margin is pure compute waste for the in-band chain, and the
+    # k=4/by=64 point measured fastest on v5e (raised vmem ceiling
+    # below).  The default scales down with nx so the three
+    # double-buffered scratch stacks stay within ~1/4 of the raised
+    # ceiling, leaving room for the VJP chain's live temporaries.
+    if by_bwd is None:
+        n_aux_b = 1 + (2 if series else 1) * len(model.zonal_settings)
+        per_row = (2 * model.n_storage + n_aux_b) * nx * 4
+        by_bwd = 64
+        while by_bwd > 8 and 2 * (by_bwd + 2 * _HALO) * per_row \
+                > 24 * 1024 * 1024:
+            by_bwd -= 8
+    by = max(8, (by_bwd // 8) * 8)
     while by > 8 and ny % by:
         by -= 8
     if ny % by:
@@ -157,20 +183,23 @@ def make_diff_step(model: Model, shape, dtype=jnp.float32,
 
     ns = model.n_storage
     n_globals = model.n_globals
-    ei = model.ei
+    n_sett = len(model.settings)
     zonal_names = list(model.zonal_settings)
-    n_aux = 1 + len(zonal_names)
-    stage = model.stages[model.actions["Iteration"][0]]
-    stage_fn = model.stage_fns[stage.main]
+    n_aux = 1 + (2 if series else 1) * len(zonal_names)
+    n_per_rep = len(model.actions["Iteration"])
+    adv = int(any(model.stages[s].load_densities
+                  for s in model.actions["Iteration"]))
 
-    def _roll(sl, shift):
-        return pltpu.roll(sl, shift % nx, axis=1) if shift % nx else sl
-
-    def bwd_kernel(sett, lg_ref, p_hbm, l_hbm, aux_hbm, out_ref,
-                   bufp, bufl, bufa, sems):
-        """lambda_in band pass: pulled primal + lambda_out on a 1-row
-        extended band, pointwise collide-VJP via jax.vjp of the model's
-        stage function, then the negated-pull shift."""
+    def bwd_kernel(sett, lg_ref, it_ref, p_hbm, l_hbm, aux_hbm, *refs):
+        """One band pass of the reverse sweep: pulled primal chunk-input
+        + lambda_out + aux on 8-row-haloed bands, in-band VJP of the
+        traced action chain, emitting the band's lambda_in rows plus the
+        accumulated settings tape (and optionally the aux cotangent)."""
+        if aux_grad:
+            out_lam, out_sett, out_laux, bufp, bufl, bufa, sems = refs
+        else:
+            (out_lam, out_sett, bufp, bufl, bufa, sems), out_laux = \
+                refs, None
         i = pl.program_id(0)
         n = pl.num_programs(0)
 
@@ -216,60 +245,95 @@ def make_diff_step(model: Model, shape, dtype=jnp.float32,
         for d in band_dmas(slot, i):
             d.wait()
 
-        n_e = by + 2
-        lo = _HALO - 1
-        # pulled primal on the extended rows (reach 2 into the 8-row halo)
-        p = []
-        for k in range(ns):
-            dxk, dyk = int(ei[k, 0]), int(ei[k, 1])
-            sl = bufp[slot, k][lo - dyk:lo - dyk + n_e, :]
-            p.append(_roll(sl, dxk))
-        pst = jnp.stack(p)
-        lam_out = jnp.stack([bufl[slot, k][lo:lo + n_e, :]
-                             for k in range(ns)])
-        flags_e = bufa[slot, 0][lo:lo + n_e, :].astype(jnp.int32)
-        zonal_e = {nm: bufa[slot, 1 + j][lo:lo + n_e, :]
-                   for j, nm in enumerate(zonal_names)}
+        sv = jnp.stack([sett[j] for j in range(n_sett)])
+        it0 = it_ref[0]
+        H = by + 2 * _HALO
+        # settings enter the trace PER ROW: the cotangent seeds below span
+        # the R-extended window, which overlaps the neighboring bands'
+        # windows — a scalar settings cotangent would double-count the
+        # margin rows across bands.  Row-resolved cotangents can be
+        # band-masked before the cross-band accumulation.
+        sv_rows = jnp.broadcast_to(sv[None, :], (H, n_sett))
 
-        def C(pstack):
-            ctx = KernelCtx(model, [pstack[k] for k in range(ns)],
-                            lambda *a: None, flags_e, zonal_e, sett,
-                            dtype, 0, nt_present, compute_globals=True)
-            res = stage_fn(ctx)
-            outs = list(pstack)
-            if isinstance(res, dict):
-                for name, stack in res.items():
-                    if name in model.groups:
-                        idx = model.groups[name]
-                        if len(idx) == 1 and stack.ndim == 2:
-                            outs[idx[0]] = stack
-                        else:
-                            for j, k in enumerate(idx):
-                                outs[k] = stack[j]
-                    else:
-                        outs[model.storage_index[name]] = stack
-            else:
-                outs = [res[k] for k in range(ns)]
-            gpl = [ctx._globals.get(g.name, jnp.zeros_like(pstack[0]))
+        class _RowSett:
+            def __init__(self, rows):
+                self._rows = rows
+
+            def __getitem__(self, i):
+                return self._rows[:, i][:, None]
+
+        def C(work, aux_pl, sv_rows_):
+            """The forward chunk traced full-band from this band's
+            buffers — run_action_plan is the SAME function the forward
+            kernel executes, so the VJP transposes exactly the physics
+            that ran.  full_band keeps every op tile-aligned; the edge
+            rows beyond the chain's reach hold garbage, which the
+            WINDOW-MASKED seeds below exclude from the cotangent."""
+            flags_full = aux_pl[0].astype(jnp.int32)
+            zonal_full = {nm: aux_pl[1 + j]
+                          for j, nm in enumerate(zonal_names)}
+            dt_full = {nm: aux_pl[1 + len(zonal_names) + j]
+                       for j, nm in enumerate(zonal_names)} if series else {}
+            work, g_acc, g_lst = run_action_plan(
+                model, plan_k, list(work), flags_full, zonal_full,
+                dt_full, _RowSett(sv_rows_), it0, nt_present, _HALO, nx,
+                dtype, n_per_rep=n_per_rep, collect_globals=True,
+                full_band=True)
+            gpl = [g_acc.get(g.name, jnp.zeros((H, nx), dtype))
                    for g in model.globals_]
-            return jnp.stack(outs), (jnp.stack(gpl) if gpl
-                                     else jnp.zeros((1,) + pstack[0].shape,
-                                                    dtype))
+            gll = [g_lst.get(g.name, jnp.zeros((H, nx), dtype))
+                   for g in model.globals_]
+            return jnp.stack(work), jnp.stack(gpl), jnp.stack(gll)
 
-        _, vjp_fn = jax.vjp(C, pst)
-        if n_globals:
-            lgpl = jnp.stack([
-                jnp.full((n_e, nx), lg_ref[gi], dtype)
-                for gi in range(n_globals)])
-        else:
-            lgpl = jnp.zeros((1, n_e, nx), dtype)
-        (lam_p,) = vjp_fn((lam_out, lgpl))
+        pst = [bufp[slot, j] for j in range(ns)]
+        apl = [bufa[slot, j] for j in range(n_aux)]
+        _, vjp_fn = jax.vjp(C, pst, apl, sv_rows)
+        # cotangent seeds live on the R-extended output window
+        # [band - R, band + by + R): rows beyond it either belong to the
+        # neighboring bands' lambda_in (they own those output rows) or
+        # hold full-band garbage — both masked to zero
+        rows = jax.lax.broadcasted_iota(jnp.int32, (H, nx), 0)
+        win = (rows >= _HALO - R) & (rows < _HALO + by + R)
+        lam_win = jnp.stack(
+            [jnp.where(win, bufl[slot, j], jnp.zeros((H, nx), dtype))
+             for j in range(ns)])
+        zero_pl = jnp.zeros((H, nx), dtype)
+        lgpl = jnp.stack(
+            [jnp.where(win, jnp.full((H, nx), lg_ref[0, gi], dtype),
+                       zero_pl) for gi in range(n_globals)])
+        lgll = jnp.stack(
+            [jnp.where(win, jnp.full((H, nx), lg_ref[1, gi], dtype),
+                       zero_pl) for gi in range(n_globals)])
+        lam_p, lam_aux, lam_sv_rows = vjp_fn((lam_win, lgpl, lgll))
 
-        # negated-pull shift: lambda_in_i(x) = G_i(x + e_i)
-        for k in range(ns):
-            dxk, dyk = int(ei[k, 0]), int(ei[k, 1])
-            sl = lam_p[k][1 + dyk:1 + dyk + by, :]
-            out_ref[k] = _roll(sl, -dxk)
+        for j in range(ns):
+            out_lam[j] = lam_p[j][_HALO:_HALO + by, :]
+        if out_laux is not None:
+            for j in range(n_aux):
+                out_laux[j] = lam_aux[j][_HALO:_HALO + by, :]
+
+        @pl.when(i == 0)
+        def _():
+            out_sett[...] = jnp.zeros((8, 128), dtype)
+        # band rows only: margin rows belong to the neighboring bands
+        lam_sv = lam_sv_rows[_HALO:_HALO + by, :].sum(axis=0)
+        pad_s = jnp.concatenate(
+            [lam_sv, jnp.zeros((1024 - n_sett,), dtype)]).reshape((8, 128))
+        out_sett[...] = out_sett[...] + pad_s
+
+    out_specs = [
+        pl.BlockSpec((ns, by, nx), lambda i: (0, i, 0),
+                     memory_space=pltpu.VMEM),
+        pl.BlockSpec((8, 128), lambda i: (0, 0), memory_space=pltpu.VMEM),
+    ]
+    out_shape = [
+        jax.ShapeDtypeStruct((ns, ny, nx), dtype),
+        jax.ShapeDtypeStruct((8, 128), dtype),
+    ]
+    if aux_grad:
+        out_specs.append(pl.BlockSpec((n_aux, by, nx), lambda i: (0, i, 0),
+                                      memory_space=pltpu.VMEM))
+        out_shape.append(jax.ShapeDtypeStruct((n_aux, ny, nx), dtype))
 
     call_bwd = pl.pallas_call(
         bwd_kernel,
@@ -277,75 +341,119 @@ def make_diff_step(model: Model, shape, dtype=jnp.float32,
         in_specs=[
             pl.BlockSpec(memory_space=pltpu.SMEM),
             pl.BlockSpec(memory_space=pltpu.SMEM),
+            pl.BlockSpec(memory_space=pltpu.SMEM),
             pl.BlockSpec(memory_space=pl.ANY),
             pl.BlockSpec(memory_space=pl.ANY),
             pl.BlockSpec(memory_space=pl.ANY),
         ],
-        out_specs=pl.BlockSpec((ns, by, nx), lambda i: (0, i, 0),
-                               memory_space=pltpu.VMEM),
-        out_shape=jax.ShapeDtypeStruct((ns, ny, nx), dtype),
+        out_specs=out_specs,
+        out_shape=out_shape,
         scratch_shapes=[
             pltpu.VMEM((2, ns, by + 2 * _HALO, nx), dtype),
             pltpu.VMEM((2, ns, by + 2 * _HALO, nx), dtype),
             pltpu.VMEM((2, n_aux, by + 2 * _HALO, nx), dtype),
             pltpu.SemaphoreType.DMA((2, 9)),
         ],
+        compiler_params=pltpu.CompilerParams(
+            vmem_limit_bytes=100 * 1024 * 1024),
         interpret=interpret,
     )
 
-    def _aux_of(zone_table, flags16):
-        flags_i32 = flags16.astype(jnp.int32)
-        zones = flags_i32 >> zshift
-        return jnp.stack(
-            [flags_i32.astype(dtype)]
-            + [zone_table[k].astype(dtype)[zones] for k in zonal_si])
-
     @jax.custom_vjp
-    def step_arrays(fields, sett, aux):
-        # aux (flags + gathered zonal planes) is an ARGUMENT, not
-        # recomputed here: custom_vjp is opaque to XLA's loop-invariant
-        # code motion, so a zone-table gather inside it would run every
-        # scan step (~7 ms/step at 512x1024) instead of hoisting
-        out, gpart = call_g(sett, jnp.zeros((1,), jnp.int32), fields, aux)
-        return out, gpart[:n_globals].sum(axis=1)
+    def step_arrays(fields, sett, aux, itv):
+        out, gpart = call_f(sett, itv, fields, aux)
+        # [0] chunk-summed globals (the objective increment over the k
+        # fused steps), [1] last-iteration globals (state.globals_ —
+        # same semantics as the per-step engines)
+        return (out, gpart[0, :n_globals].sum(axis=1),
+                gpart[1, :n_globals].sum(axis=1))
 
-    def step_f(fields, sett, aux):
-        out = step_arrays(fields, sett, aux)
-        return out, (fields, sett, aux)
+    def step_f(fields, sett, aux, itv):
+        out = step_arrays(fields, sett, aux, itv)
+        return out, (fields, sett, aux, itv)
 
     def step_b(res, cot):
-        fields, sett, aux = res
-        lam_f, lam_g = cot
-        lam_in = call_bwd(sett, lam_g.astype(dtype), fields, lam_f, aux)
-        # design lives in storage planes (supports_diff's contract):
-        # settings/zonal cotangents are zero by construction here —
-        # differentiate via the XLA engine for Control-series gradients
-        return (lam_in, jnp.zeros_like(sett), jnp.zeros_like(aux))
+        fields, sett, aux, itv = res
+        lam_f, lam_g, lam_gl = cot
+        lg = jnp.stack([lam_g.astype(dtype), lam_gl.astype(dtype)])
+        outs = call_bwd(sett, lg, itv, fields, lam_f, aux)
+        if aux_grad:
+            lam_fields, sett_acc, lam_aux = outs
+        else:
+            lam_fields, sett_acc = outs
+            lam_aux = jnp.zeros_like(aux)
+        lam_sett = sett_acc.reshape(-1)[:n_sett]
+        return (lam_fields, lam_sett, lam_aux,
+                np.zeros((1,), jax.dtypes.float0))
 
     step_arrays.defvjp(step_f, step_b)
 
-    def _mk_step(sett, aux):
-        def step(state: LatticeState, params: SimParams) -> LatticeState:
-            new_fields, g = step_arrays(state.fields, sett, aux)
-            return LatticeState(fields=new_fields, flags=state.flags,
-                                globals_=g.astype(state.globals_.dtype),
-                                iteration=state.iteration + 1)
+    def _aux_base(params: SimParams, flags):
+        flags_i32 = flags.astype(jnp.int32)
+        zones = flags_i32 >> zshift
+        base = [params.zone_table[j].astype(dtype)[zones] for j in zonal_si]
+        return flags_i32.astype(dtype), zones, base
+
+    def _aux_series(params: SimParams, flags_f, zones, base, it):
+        return pallas_generic.assemble_aux(params, zones, flags_f, base,
+                                           zonal_si, it, dtype,
+                                           with_dt=True)
+
+    def _mk_step(params: SimParams, flags):
+        sett = params.settings.astype(dtype)
+        flags_f, zones, base = _aux_base(params, flags)
+        if series:
+            def step(state: LatticeState, p2: SimParams):
+                it = state.iteration
+                aux = _aux_series(p2, flags_f, zones, base, it)
+                new_fields, g, g_last = step_arrays(
+                    state.fields, sett, aux,
+                    it[None].astype(jnp.int32) if it.ndim == 0 else it)
+                return LatticeState(
+                    fields=new_fields, flags=state.flags,
+                    globals_=g_last.astype(state.globals_.dtype),
+                    iteration=state.iteration + adv * k), g
+            return step
+        if params.time_series is not None:
+            raise ValueError(
+                "this diff step was built without Control-series support "
+                "(series=False) but params carry a time series — the "
+                "schedule would be silently dropped; build with "
+                "series=True (auto engine: pass has_series=True to "
+                "make_unsteady_gradient) or use engine='xla'")
+        aux = jnp.stack([flags_f] + base)
+
+        def step(state: LatticeState, p2: SimParams):
+            it = state.iteration
+            new_fields, g, g_last = step_arrays(
+                state.fields, sett, aux,
+                it[None].astype(jnp.int32) if it.ndim == 0 else it)
+            return LatticeState(
+                fields=new_fields, flags=state.flags,
+                globals_=g_last.astype(state.globals_.dtype),
+                iteration=state.iteration + adv * k), g
         return step
 
-    def step(state: LatticeState, params: SimParams) -> LatticeState:
-        # slow path (aux re-gathered per call) — drivers use prepare()
-        return _mk_step(params.settings.astype(dtype),
-                        _aux_of(params.zone_table, state.flags))(
-            state, params)
+    def step(state: LatticeState, params: SimParams):
+        # slow path (loop invariants re-derived per call) — drivers bind
+        # them once via prepare().  Returns (state, chunk_globals): the
+        # state carries LAST-iteration globals (per-step engine
+        # semantics); the second value is the k-step objective increment.
+        return _mk_step(params, state.flags)(state, params)
 
     def prepare(state: LatticeState, params: SimParams):
         """Bind the loop-invariant inputs ONCE per (jitted) gradient
-        call: the zonal gather and settings cast must happen OUTSIDE the
-        step scan — as scan-carry derived values they would re-run every
-        step (flags ride the carry, so XLA cannot hoist them), costing
-        more than the kernels themselves."""
-        return _mk_step(params.settings.astype(dtype),
-                        _aux_of(params.zone_table, state.flags))
+        call: the zonal gather, settings cast and aux assembly must
+        happen OUTSIDE the step scan — as scan-carry derived values they
+        would re-run every step (flags ride the carry, so XLA cannot
+        hoist them).  Called INSIDE the differentiated trace, so
+        cotangents still flow to ``params`` through the bindings."""
+        return _mk_step(params, state.flags)
 
     step.prepare = prepare
+    step.chunk = k
+    step.returns_inc = True
+    step.arrays = step_arrays
+    step.engine_name = (f"pallas_adjoint[{model.name},k={k}"
+                        + (",series" if series else "") + f",by={by}]")
     return step

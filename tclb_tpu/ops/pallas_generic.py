@@ -177,6 +177,180 @@ class _DtypeShim:
         self.dtype = dtype
 
 
+def assemble_aux(params, zones, flags_f, base_planes, zonal_si, it, dtype,
+                 with_dt: bool):
+    """The aux plane stack: flags + per-node zonal-setting planes, with
+    any registered <Control> series overrides applied at iteration ``it``
+    (+ the per-iteration ``_DT`` planes when ``with_dt``).  ONE
+    implementation shared by the 2D/3D generic engines and the
+    differentiable step — the override scalars come from the same
+    series_overrides/series_dt_overrides the XLA NodeCtx uses, so the
+    engines cannot drift."""
+    has = params.time_series is not None
+    planes = [flags_f]
+    for j, k in enumerate(zonal_si):
+        p = base_planes[j]
+        if has:
+            for z, v in series_overrides(params, k, it):
+                p = jnp.where(zones == z, v.astype(dtype), p)
+        planes.append(p)
+    if with_dt:
+        for k in zonal_si:
+            p = jnp.zeros_like(flags_f)
+            if has:
+                for z, v in series_dt_overrides(params, k, it):
+                    p = jnp.where(zones == z, v.astype(dtype), p)
+            planes.append(p)
+    return jnp.stack(planes)
+
+
+@partial(jax.custom_vjp, nondiff_argnums=(1, 2))
+def _roll_prim(x, s, nx):
+    return pltpu.roll(x, s, axis=1)
+
+
+def _roll_fwd(x, s, nx):
+    return _roll_prim(x, s, nx), None
+
+
+def _roll_bwd(s, nx, _res, ct):
+    # roll is linear: out[i] = x[i - s], so the transpose is the
+    # opposite roll (the adjoint band kernel differentiates through the
+    # streaming slices; pltpu.roll itself has no AD rule)
+    return (_roll_prim(ct, (nx - s) % nx, nx),)
+
+
+_roll_prim.defvjp(_roll_fwd, _roll_bwd)
+
+
+def _lane_roll(sl, shift, nx):
+    s = shift % nx
+    return _roll_prim(sl, s, nx) if s else sl
+
+
+def run_action_plan(model: Model, plan, work: list, flags_full, zonal_full,
+                    dt_full, sett, it0, nt_present, halo: int, nx: int,
+                    dtype, n_per_rep: int, collect_globals: bool = False,
+                    extra: int = 0, full_band: bool = False):
+    """Execute ``plan``'s stages over band-buffer VALUE arrays (2D).
+
+    ``work`` is one ``(H, nx)`` array per storage plane with the output
+    band at rows ``[halo, H - halo)``; the list is updated in place so
+    later stages read earlier stages' writes.  ``extra`` widens every
+    stage's output window by that many rows: the adjoint band kernel
+    (ops/pallas_adjoint) computes the action on a band extended by the
+    plan's total reach so the VJP dependency cone of the band rows is
+    fully covered; the forward kernel uses ``extra=0``.
+
+    Returns ``(work, g_planes, g_last_planes)`` where ``g_planes`` maps
+    each Global's name to its ``(by + 2*extra, nx)`` contribution plane
+    over the extended output window (stages with larger extents are
+    trimmed to the window — rows beyond it lie outside the band's
+    dependency cone) summed over ALL fused repetitions, and
+    ``g_last_planes`` holds the LAST repetition's contributions only
+    (the last-iteration globals the per-step engines report).
+
+    ``full_band=True`` computes EVERY stage over the whole (tile-aligned)
+    buffer height instead of progressively-shrinking windows: the pull
+    becomes a sublane roll (whose wrap lands garbage only in the outermost
+    rows, which stay within the ``halo`` margin callers discard), stage
+    updates replace whole planes (no row-concats), and every op keeps the
+    aligned ``(H, nx)`` shape — much friendlier Mosaic tiling.  Globals
+    planes then come back full-height and the CALLER must mask rows
+    outside its valid window.
+
+    This is THE collide semantics of the 2D generic engine — the forward
+    band kernel and the adjoint's in-band chain both trace it, so the
+    two can never drift apart.
+    """
+    ns = model.n_storage
+    ei = model.ei
+    by = work[0].shape[0] - 2 * halo
+    n_reps = max(len(plan) // max(n_per_rep, 1), 1)
+    g_acc: dict = {}
+    g_last: dict = {}
+    for st_i, (stage_name, out_ext) in enumerate(plan):
+        stage = model.stages[stage_name]
+        fn = model.stage_fns[stage.main]
+        eff = halo if full_band else out_ext + extra
+        n_i = by + 2 * eff
+        lo = halo - eff                # first row of this stage's window
+        rep = st_i // n_per_rep        # fused action repetition index
+
+        if stage.load_densities:
+            planes = []
+            for k in range(ns):
+                dxk, dyk = int(ei[k, 0]), int(ei[k, 1])
+                if full_band:
+                    sl = jnp.roll(work[k], dyk, axis=0) if dyk else work[k]
+                else:
+                    sl = work[k][lo - dyk:lo - dyk + n_i, :]
+                planes.append(_lane_roll(sl, dxk, nx))
+        else:
+            planes = [w[lo:lo + n_i, :] for w in work]
+
+        if full_band:
+            def loader(index, dx, dy, dz=0):
+                assert dz == 0, "2D band kernel: no z loads"
+                sl = work[index]
+                if dy:
+                    sl = jnp.roll(sl, -dy, axis=0)
+                return _lane_roll(sl, -dx, nx)
+        else:
+            def loader(index, dx, dy, dz=0, _lo=lo, _n=n_i):
+                assert dz == 0, "2D band kernel: no z loads"
+                sl = work[index][_lo + dy:_lo + dy + _n, :]
+                return _lane_roll(sl, -dx, nx)
+
+        ctx = KernelCtx(
+            model, planes, loader,
+            flags_full[lo:lo + n_i, :],
+            {nm: p[lo:lo + n_i, :] for nm, p in zonal_full.items()},
+            sett, dtype, it0 + rep, nt_present,
+            dt_planes={nm: p[lo:lo + n_i, :] for nm, p in dt_full.items()},
+            compute_globals=collect_globals)
+        res = fn(ctx)
+        if collect_globals:
+            # SUM Globals accumulate across the action's stages, trimmed
+            # to the output window (rows beyond it belong to other bands
+            # or lie outside the band's dependency cone); in full_band
+            # mode the caller masks invalid rows instead
+            for nm, plane in ctx._globals.items():
+                if not full_band:
+                    plane = plane[out_ext:out_ext + by + 2 * extra, :]
+                g_acc[nm] = plane if nm not in g_acc else g_acc[nm] + plane
+                if rep == n_reps - 1:
+                    # last-repetition-only accumulation: the chunked diff
+                    # step reports these as state.globals_ so the final
+                    # state matches the per-step engines' last-iteration
+                    # semantics (the chunk SUM would be ~k-fold inflated)
+                    g_last[nm] = plane if nm not in g_last \
+                        else g_last[nm] + plane
+
+        if isinstance(res, dict):
+            updates: dict[int, jnp.ndarray] = {}
+            for name, stack in res.items():
+                if name in model.groups:
+                    idx = model.groups[name]
+                    if len(idx) == 1 and stack.ndim == 2:
+                        updates[idx[0]] = stack
+                    else:
+                        for j, k in enumerate(idx):
+                            updates[k] = stack[j]
+                else:
+                    updates[model.storage_index[name]] = stack
+        else:
+            updates = {k: res[k] for k in range(ns)}
+        for k, new in updates.items():
+            if full_band:
+                work[k] = new
+            else:
+                w = work[k]
+                work[k] = jnp.concatenate([w[:lo], new, w[lo + n_i:]],
+                                          axis=0)
+    return work, g_acc, g_last
+
+
 class KernelCtx(NodeCtx):
     """A :class:`NodeCtx` whose world is one VMEM row band.
 
@@ -345,7 +519,8 @@ def make_pallas_iterate(model: Model, shape, dtype=jnp.float32,
                         fuse: int = 1,
                         present: Optional[set] = None,
                         ext_halo: bool = False,
-                        by_cap: Optional[int] = None):
+                        by_cap: Optional[int] = None,
+                        full_band: Optional[bool] = None):
     """Build ``iterate(state, params, niter) -> state`` running the model's
     full Iteration action as one fused Pallas band kernel per step.
 
@@ -381,34 +556,34 @@ def make_pallas_iterate(model: Model, shape, dtype=jnp.float32,
 
     n_storage = model.n_storage
     zonal_names = list(model.zonal_settings)
-    ei = model.ei
-    stage_fns = {nm: model.stage_fns[model.stages[nm].main]
-                 for nm, _ in plan}
-    loads_density = {nm: model.stages[nm].load_densities for nm, _ in plan}
     nt_present = set(model.node_types) if present is None else set(present)
     if pad > 2 * mirror:
         nt_present = nt_present | {"Wall"}   # middle ghost rows are walls
-
-    def _roll(sl, shift):
-        return pltpu.roll(sl, shift % nx, axis=1) if shift % nx else sl
+    if full_band is None:
+        import os
+        full_band = os.environ.get("TCLB_FULLBAND", "0") == "1"
 
     def _mk_kernel(plan, with_dt=False, with_globals=False):
         """Kernel flavor factory: ``with_dt`` adds per-iteration _DT
         planes to the aux stack (the Control-series flavor), and
         ``with_globals`` accumulates the model's SUM Globals in-kernel
         into an extra (8, 128) partial-sums output (the reference's
-        in-kernel Globals accumulation, src/cuda.cu.Rt:176-202)."""
+        in-kernel Globals accumulation, src/cuda.cu.Rt:176-202);
+        ``with_globals="split"`` emits a (2, 8, 128) block instead —
+        [0] the whole fused chunk's sums (the objective increment), [1]
+        the LAST repetition's only (last-iteration globals semantics,
+        used by the chunked diff step)."""
         def kern(sett, it_ref, f_hbm, aux_hbm, *refs):
             if with_globals:
                 out_ref, g_ref, buff, bufa, sems = refs
             else:
                 (out_ref, buff, bufa, sems), g_ref = refs, None
-            kernel(plan, with_dt, sett, it_ref, f_hbm, aux_hbm,
-                   out_ref, g_ref, buff, bufa, sems)
+            kernel(plan, with_dt, with_globals, sett, it_ref, f_hbm,
+                   aux_hbm, out_ref, g_ref, buff, bufa, sems)
         return kern
 
-    def kernel(plan, with_dt, sett, it_ref, f_hbm, aux_hbm, out_ref,
-               g_ref, buff, bufa, sems):
+    def kernel(plan, with_dt, with_globals, sett, it_ref, f_hbm, aux_hbm,
+               out_ref, g_ref, buff, bufa, sems):
         """One band pass = the whole Iteration action (x fuse).  The band
         plus 8-row halo blocks land in ONE contiguous (by+16)-row buffer
         per stack, so every extended-row access below is a single slice;
@@ -481,85 +656,45 @@ def make_pallas_iterate(model: Model, shape, dtype=jnp.float32,
                       for j, nm in enumerate(zonal_names)}
         dt_full = {nm: bufa[slot, 1 + len(zonal_names) + j]
                    for j, nm in enumerate(zonal_names)} if with_dt else {}
-        g_acc: dict = {}
 
-        n_per_rep = len(model.actions["Iteration"])
-        for st_i, (stage_name, out_ext) in enumerate(plan):
-            n_i = by + 2 * out_ext
-            lo = _HALO - out_ext          # first W-row of the compute band
-            rep = st_i // n_per_rep       # fused action repetition index
-
-            if loads_density[stage_name]:
-                planes = []
-                for k in range(n_storage):
-                    dxk, dyk = int(ei[k, 0]), int(ei[k, 1])
-                    sl = work[k][lo - dyk:lo - dyk + n_i, :]
-                    planes.append(_roll(sl, dxk))
-            else:
-                planes = [w[lo:lo + n_i, :] for w in work]
-
-            def loader(index, dx, dy, dz=0, _lo=lo, _n=n_i):
-                assert dz == 0, "2D band kernel: no z loads"
-                sl = work[index][_lo + dy:_lo + dy + _n, :]
-                return _roll(sl, -dx)
-
-            ctx = KernelCtx(
-                model, planes, loader,
-                flags_full[lo:lo + n_i, :],
-                {nm: p[lo:lo + n_i, :] for nm, p in zonal_full.items()},
-                sett, dtype, it_ref[0] + rep, nt_present,
-                dt_planes={nm: p[lo:lo + n_i, :]
-                           for nm, p in dt_full.items()},
-                compute_globals=g_ref is not None)
-            res = stage_fns[stage_name](ctx)
-            if g_ref is not None:
-                # SUM Globals accumulate across the action's stages; only
-                # the band rows count (extended rows are recomputed by
-                # the neighboring band)
-                for nm, plane in ctx._globals.items():
-                    part = plane[out_ext:out_ext + by, :]
-                    g_acc[nm] = part if nm not in g_acc else g_acc[nm] + part
-
-            if isinstance(res, dict):
-                updates: dict[int, jnp.ndarray] = {}
-                for name, stack in res.items():
-                    if name in model.groups:
-                        idx = model.groups[name]
-                        if len(idx) == 1 and stack.ndim == 2:
-                            updates[idx[0]] = stack
-                        else:
-                            for j, k in enumerate(idx):
-                                updates[k] = stack[j]
-                    else:
-                        updates[model.storage_index[name]] = stack
-            else:
-                updates = {k: res[k] for k in range(n_storage)}
-            for k, new in updates.items():
-                w = work[k]
-                work[k] = jnp.concatenate(
-                    [w[:lo], new, w[lo + n_i:]], axis=0)
+        work, g_acc, g_last = run_action_plan(
+            model, plan, work, flags_full, zonal_full, dt_full, sett,
+            it_ref[0], nt_present, _HALO, nx, dtype,
+            n_per_rep=len(model.actions["Iteration"]),
+            collect_globals=g_ref is not None, full_band=full_band)
 
         for k in range(n_storage):
             out_ref[k] = work[k][_HALO:_HALO + by, :]
 
         if g_ref is not None:
+            split = with_globals == "split"
+
             @pl.when(i == 0)
             def _():
-                g_ref[...] = jnp.zeros((8, 128), dtype)
+                g_ref[...] = jnp.zeros((2, 8, 128) if split else (8, 128),
+                                       dtype)
             if pad:
                 # ghost rows must not contribute (mirror rows would
                 # double-count, wall rows are unphysical)
                 rows = jax.lax.broadcasted_iota(jnp.int32, (by, nx), 0) \
                     + i * jnp.int32(by)
                 gmask = (rows < jnp.int32(ny_phys)).astype(dtype)
-            for gi, g in enumerate(model.globals_):
-                if g.name not in g_acc:
-                    continue
-                plane = g_acc[g.name]
-                if pad:
-                    plane = plane * gmask
-                part = plane.reshape((by * (nx // 128), 128)).sum(axis=0)
-                g_ref[gi] = g_ref[gi] + part
+            for blk, acc in enumerate((g_acc, g_last) if split
+                                      else (g_acc,)):
+                for gi, g in enumerate(model.globals_):
+                    if g.name not in acc:
+                        continue
+                    plane = acc[g.name]
+                    if full_band:
+                        plane = plane[_HALO:_HALO + by, :]
+                    if pad:
+                        plane = plane * gmask
+                    part = plane.reshape((by * (nx // 128),
+                                          128)).sum(axis=0)
+                    if split:
+                        g_ref[blk, gi] = g_ref[blk, gi] + part
+                    else:
+                        g_ref[gi] = g_ref[gi] + part
 
     grid = (ny // by,)
 
@@ -569,11 +704,16 @@ def make_pallas_iterate(model: Model, shape, dtype=jnp.float32,
                                  memory_space=pltpu.VMEM)
         out_shape = jax.ShapeDtypeStruct((n_storage, ny, nx), dtype)
         if with_globals:
+            gshape = (2, 8, 128) if with_globals == "split" else (8, 128)
             out_specs = [out_specs,
-                         pl.BlockSpec((8, 128), lambda i: (0, 0),
+                         pl.BlockSpec(gshape,
+                                      (lambda i: (0, 0, 0))
+                                      if with_globals == "split"
+                                      else (lambda i: (0, 0)),
                                       memory_space=pltpu.VMEM)]
-            out_shape = [out_shape,
-                         jax.ShapeDtypeStruct((8, 128), dtype)]
+            out_shape = [out_shape, jax.ShapeDtypeStruct(gshape, dtype)]
+        import os
+        vmem_mb = int(os.environ.get("TCLB_VMEM_LIMIT_MB", "0"))
         return pl.pallas_call(
             _mk_kernel(plan_n, with_dt, with_globals),
             grid=grid,
@@ -590,6 +730,9 @@ def make_pallas_iterate(model: Model, shape, dtype=jnp.float32,
                 pltpu.VMEM((2, n_aux_k, by + 2 * _HALO, nx), dtype),
                 pltpu.SemaphoreType.DMA((2, 6)),
             ],
+            compiler_params=pltpu.CompilerParams(
+                vmem_limit_bytes=vmem_mb * 1024 * 1024)
+            if vmem_mb else None,
             interpret=interpret,
         )
 
@@ -651,24 +794,8 @@ def make_pallas_iterate(model: Model, shape, dtype=jnp.float32,
                        for k in zonal_si]
 
         def aux_of(it):
-            """The aux stack: flags + per-node zonal planes, plus (series
-            runs) the per-iteration values and _DT planes — the SAME
-            override scalars NodeCtx.setting/setting_dt use
-            (core.lattice.series_overrides/series_dt_overrides)."""
-            planes = [flags_f]
-            if not has_series:
-                return jnp.stack(planes + base_planes)
-            for j, k in enumerate(zonal_si):
-                p = base_planes[j]
-                for z, v in series_overrides(params, k, it):
-                    p = jnp.where(zones == z, v.astype(dtype), p)
-                planes.append(p)
-            for k in zonal_si:
-                p = jnp.zeros_like(base_planes[0])
-                for z, v in series_dt_overrides(params, k, it):
-                    p = jnp.where(zones == z, v.astype(dtype), p)
-                planes.append(p)
-            return jnp.stack(planes)
+            return assemble_aux(params, zones, flags_f, base_planes,
+                                zonal_si, it, dtype, with_dt=has_series)
 
         def refresh(fields):
             if not pad:
@@ -740,7 +867,7 @@ def make_pallas_iterate(model: Model, shape, dtype=jnp.float32,
     # reuses the forward globals kernel verbatim)
     iterate._impl = dict(call1=call1, call_g=call_g, by=by, pad=pad,
                          zonal_si=zonal_si, zshift=zshift,
-                         nt_present=nt_present)
+                         nt_present=nt_present, mk_call=_mk_call)
     return iterate
 
 
@@ -1056,20 +1183,8 @@ def make_pallas_iterate_3d(model: Model, shape, dtype=jnp.float32,
                        for k in zonal_si]
 
         def aux_of(it):
-            planes = [flags_f]
-            if not has_series:
-                return jnp.stack(planes + base_planes)
-            for j, k in enumerate(zonal_si):
-                p = base_planes[j]
-                for z, v in series_overrides(params, k, it):
-                    p = jnp.where(zones == z, v.astype(dtype), p)
-                planes.append(p)
-            for k in zonal_si:
-                p = jnp.zeros_like(base_planes[0])
-                for z, v in series_dt_overrides(params, k, it):
-                    p = jnp.where(zones == z, v.astype(dtype), p)
-                planes.append(p)
-            return jnp.stack(planes)
+            return assemble_aux(params, zones, flags_f, base_planes,
+                                zonal_si, it, dtype, with_dt=has_series)
 
         final_g = call_sg if has_series else call_g
         if niter <= 0:

@@ -1,16 +1,19 @@
 """Differentiable Pallas fast path (ops/pallas_adjoint): the custom_vjp
-step whose backward is itself a Pallas band kernel — the TPU analogue of
-the reference's Tapenade-generated ``Run_b`` device kernel
-(reference src/cuda.cu.Rt:240-256).  Pinned against the XLA adjoint (the
-reference pins Tapenade against <FDTest>), plus an FD check."""
+chunk whose backward is the in-band VJP of the SAME traced action chain
+the forward kernel runs — the TPU analogue of the reference's
+Tapenade-generated ``Run_b`` device kernel (reference
+src/cuda.cu.Rt:240-256) including its settings tape (``DynamicsS_b``,
+tools/makeAD:24).  Pinned against the XLA adjoint (the reference pins
+Tapenade against <FDTest>), plus an FD check."""
 
 import jax
 import jax.numpy as jnp
 import numpy as np
 import pytest
 
-from tclb_tpu.adjoint import (InternalTopology, fd_test,
+from tclb_tpu.adjoint import (InternalTopology, OptimalControl, fd_test,
                               make_unsteady_gradient)
+from tclb_tpu.adjoint.run import design_needs
 from tclb_tpu.core.lattice import Lattice
 from tclb_tpu.models import get_model
 from tclb_tpu.ops import pallas_adjoint
@@ -18,8 +21,8 @@ from tclb_tpu.ops import pallas_adjoint
 pytestmark = pytest.mark.slow
 
 
-def _setup(ny=16, nx=128):
-    m = get_model("d2q9_adj")
+def _setup(ny=16, nx=128, model="d2q9_adj"):
+    m = get_model(model)
     lat = Lattice(m, (ny, nx), dtype=jnp.float32,
                   settings={"nu": 0.1, "Velocity": 0.05, "Porocity": 0.5,
                             "DragInObj": 1.0})
@@ -38,12 +41,33 @@ def test_supports_diff():
     assert pallas_adjoint.supports_diff(m, (16, 128), jnp.float32)
     assert not pallas_adjoint.supports_diff(m, (15, 128), jnp.float32)
     assert not pallas_adjoint.supports_diff(m, (16, 96), jnp.float32)
-    # Field-stencil models are out of the pointwise-collide scope
-    assert not pallas_adjoint.supports_diff(get_model("d2q9_kuper"),
-                                            (16, 128), jnp.float32)
-    # multi-lattice single-stage IS in scope
+    # Field-stencil + multi-stage models ARE in scope now (the backward
+    # kernel VJPs the full traced chain; round-4's pointwise-collide
+    # restriction is gone) — kuper at reduced chunk k=2
+    assert pallas_adjoint.supports_diff(get_model("d2q9_kuper_adj"),
+                                        (16, 128), jnp.float32)
+    assert pallas_adjoint.max_chunk(get_model("d2q9_kuper_adj")) == 2
+    assert pallas_adjoint.max_chunk(m) == 4
+    # multi-lattice single-stage
     assert pallas_adjoint.supports_diff(get_model("d2q9_heat"),
                                         (16, 128), jnp.float32)
+    # the heat_adj BASELINE config runs the fused adjoint (round-4 gap)
+    assert pallas_adjoint.supports_diff(get_model("d2q9_heat_adj"),
+                                        (16, 128), jnp.float32)
+    # series flavor (control gradients)
+    assert pallas_adjoint.supports_diff(m, (16, 128), jnp.float32,
+                                        series=True)
+
+
+def test_design_needs_classifier():
+    m = get_model("d2q9_adj")
+    assert design_needs(InternalTopology(m)) == {"state"}
+    assert design_needs(OptimalControl(m, "Velocity")) == {"series"}
+
+    class Weird:
+        pass
+
+    assert design_needs(Weird()) is None
 
 
 def test_pallas_gradient_matches_xla():
@@ -52,12 +76,13 @@ def test_pallas_gradient_matches_xla():
     m, lat = _setup()
     design = InternalTopology(m)
     theta0 = design.get(lat.state, lat.params)
-    niter = 6
+    niter = 8   # divisible by the k=4 chunk
 
-    g_x = make_unsteady_gradient(m, design, niter, levels=1)
+    g_x = make_unsteady_gradient(m, design, niter, levels=1, engine="xla")
     obj_x, gx, fin_x = g_x(theta0, lat.state, lat.params)
     g_p = make_unsteady_gradient(m, design, niter, levels=1,
                                  engine="pallas", shape=lat.shape)
+    assert g_p.engine_name.startswith("pallas_adjoint")
     obj_p, gp, fin_p = g_p(theta0, lat.state, lat.params)
 
     assert float(obj_x) == pytest.approx(float(obj_p), rel=1e-5)
@@ -69,13 +94,96 @@ def test_pallas_gradient_matches_xla():
                                rtol=1e-5, atol=1e-6)
 
 
+def test_pallas_series_gradient_matches_xla():
+    """Control-series (settings-tape) cotangents: an OptimalControl design
+    differentiates through params.time_series on the fused kernels —
+    round 4 returned ZERO here by contract (the reference's control
+    gradients always ran the tuned adjoint kernel via DynamicsS_b)."""
+    m, lat = _setup()
+    niter = 8
+    lat.set_setting_series("Velocity",
+                           0.05 + 0.01 * np.sin(np.arange(niter)), zone=0)
+    design = OptimalControl(m, "Velocity", zone=0)
+    theta0 = design.get(lat.state, lat.params)
+    g_x = make_unsteady_gradient(m, design, niter, levels=1, engine="xla")
+    obj_x, gx, _ = g_x(theta0, lat.state, lat.params)
+    g_p = make_unsteady_gradient(m, design, niter, levels=1,
+                                 engine="pallas", shape=lat.shape)
+    assert "series" in g_p.engine_name
+    obj_p, gp, _ = g_p(theta0, lat.state, lat.params)
+    gx, gp = np.asarray(gx), np.asarray(gp)
+    assert float(obj_x) == pytest.approx(float(obj_p), rel=1e-5)
+    assert np.abs(gx).max() > 0.0
+    np.testing.assert_allclose(gp, gx, rtol=2e-4, atol=1e-6)
+
+
+def test_pallas_heat_adj_gradient():
+    """The d2q9_heat_adj BASELINE gradient (heat_adj.xml's physics) runs
+    the fused adjoint and matches XLA — round-4 Missing #1."""
+    m = get_model("d2q9_heat_adj")
+    ny, nx = 16, 128
+    lat = Lattice(m, (ny, nx), dtype=jnp.float32,
+                  settings={"nu": 0.05, "InletVelocity": 0.02,
+                            "FluidAlfa": 0.05, "HeatFluxInObj": 1.0,
+                            "DragInObj": 0.3})
+    flags = np.full((ny, nx), m.flag_for("MRT"), dtype=np.uint16)
+    flags[:, 0] = m.flag_for("WVelocity", "MRT")
+    flags[:, -1] = m.flag_for("EPressure", "MRT")
+    flags[0, :] = flags[-1, :] = m.flag_for("Wall")
+    flags[1:-1, -3] = m.flag_for("MRT", "Outlet")
+    flags[4:12, 40:80] |= m.flag_for("DesignSpace")
+    lat.set_flags(flags)
+    lat.init()
+    design = InternalTopology(m)
+    theta0 = jnp.clip(design.get(lat.state, lat.params) * 0.7 + 0.1, 0, 1)
+    g_x = make_unsteady_gradient(m, design, 8, levels=1, engine="xla")
+    obj_x, gx, _ = g_x(theta0, lat.state, lat.params)
+    g_p = make_unsteady_gradient(m, design, 8, levels=1,
+                                 engine="pallas", shape=lat.shape)
+    obj_p, gp, _ = g_p(theta0, lat.state, lat.params)
+    gx, gp = np.asarray(gx), np.asarray(gp)
+    assert float(obj_x) == pytest.approx(float(obj_p), rel=1e-5)
+    assert np.abs(gx).max() > 0.0
+    np.testing.assert_allclose(gp, gx, rtol=1e-4, atol=1e-7)
+
+
+def test_pallas_kuper_gradient():
+    """Multi-stage + Field-stencil chain (d2q9_kuper_adj: BaseIteration +
+    CalcPhi, psi stencil): the generalized backward covers it at k=2."""
+    m = get_model("d2q9_kuper_adj")
+    ny, nx = 16, 128
+    lat = Lattice(m, (ny, nx), dtype=jnp.float32,
+                  settings={"omega": 1.0, "Temperature": 0.56, "FAcc": 1.0,
+                            "Magic": 0.01, "MagicA": -0.152,
+                            "MagicF": -2.0 / 3.0, "Density": 3.26,
+                            "WallForceXInObj": 1.0})
+    lat.set_setting("Density", 0.0145, zone=1)
+    flags = np.full((ny, nx), m.flag_for("MRT"), dtype=np.uint16)
+    yy, xx = np.mgrid[0:ny, 0:nx]
+    flags[((yy - 8) ** 2 + (xx - 50) ** 2) < 36] = m.flag_for("MRT", zone=1)
+    flags[0, :] = flags[-1, :] = m.flag_for("Wall")
+    flags[4:12, 40:80] |= m.flag_for("DesignSpace")
+    lat.set_flags(flags)
+    lat.init()
+    design = InternalTopology(m)
+    theta0 = design.get(lat.state, lat.params)
+    g_x = make_unsteady_gradient(m, design, 4, levels=1, engine="xla")
+    obj_x, gx, _ = g_x(theta0, lat.state, lat.params)
+    g_p = make_unsteady_gradient(m, design, 4, levels=1,
+                                 engine="pallas", shape=lat.shape)
+    obj_p, gp, _ = g_p(theta0, lat.state, lat.params)
+    gx, gp = np.asarray(gx), np.asarray(gp)
+    assert np.abs(gx).max() > 0.0
+    np.testing.assert_allclose(gp, gx, rtol=1e-3, atol=2e-6)
+
+
 def test_pallas_gradient_vs_fd():
     """FDTest on the Pallas engine (reference acFDTest,
     src/Handlers.cpp.Rt:1944): central differences at f32 tolerance."""
     m, lat = _setup()
     design = InternalTopology(m)
     theta0 = design.get(lat.state, lat.params)
-    niter = 5
+    niter = 4
     grad_fn = make_unsteady_gradient(m, design, niter, levels=1,
                                      engine="pallas", shape=lat.shape)
     obj, g, _ = grad_fn(theta0, lat.state, lat.params)
@@ -93,17 +201,65 @@ def test_pallas_gradient_vs_fd():
 
 
 def test_pallas_gradient_with_checkpoint_levels():
-    """The custom_vjp step composes with the nested remat scan (the
+    """The custom_vjp chunk composes with the nested remat scan (the
     SnapLevel analogue) — levels=1 and levels=2 agree."""
     m, lat = _setup()
     design = InternalTopology(m)
     theta0 = design.get(lat.state, lat.params)
-    g1 = make_unsteady_gradient(m, design, 9, levels=1,
+    g1 = make_unsteady_gradient(m, design, 8, levels=1,
                                 engine="pallas", shape=lat.shape)
-    g2 = make_unsteady_gradient(m, design, 9, levels=2,
+    g2 = make_unsteady_gradient(m, design, 8, levels=2,
                                 engine="pallas", shape=lat.shape)
     o1, gr1, _ = g1(theta0, lat.state, lat.params)
     o2, gr2, _ = g2(theta0, lat.state, lat.params)
     assert float(o1) == pytest.approx(float(o2), rel=1e-6)
     np.testing.assert_allclose(np.asarray(gr1), np.asarray(gr2),
                                rtol=1e-5, atol=1e-8)
+
+
+def test_iteration_counter_threaded():
+    """The in-kernel iteration counter follows state.iteration (advisor
+    round-4 finding: it was hardwired to 0) — gradients from a shifted
+    start match the XLA engine exactly."""
+    m, lat = _setup()
+    design = InternalTopology(m)
+    theta0 = design.get(lat.state, lat.params)
+    import dataclasses
+    state7 = dataclasses.replace(lat.state,
+                                 iteration=jnp.asarray(12, jnp.int32))
+    g_x = make_unsteady_gradient(m, design, 4, levels=1, engine="xla")
+    g_p = make_unsteady_gradient(m, design, 4, levels=1,
+                                 engine="pallas", shape=lat.shape)
+    obj_x, gx, fin_x = g_x(theta0, state7, lat.params)
+    obj_p, gp, fin_p = g_p(theta0, state7, lat.params)
+    assert int(fin_p.iteration) == int(fin_x.iteration) == 16
+    np.testing.assert_allclose(np.asarray(gp), np.asarray(gx),
+                               rtol=1e-4, atol=1e-7)
+
+
+@pytest.mark.skipif(jax.default_backend() != "tpu",
+                    reason="wall-clock assert needs real TPU kernels")
+def test_pallas_adjoint_faster_than_xla():
+    """Round-4 weak #8: the wall-clock regression guard.  The fused
+    adjoint must beat the XLA adjoint by >= 2x on hardware — a silent
+    fallback to the slow path fails here."""
+    import time
+    m, lat = _setup(ny=256, nx=512)
+    design = InternalTopology(m)
+    theta0 = design.get(lat.state, lat.params)
+    niter = 200
+
+    def timed(engine):
+        gf = make_unsteady_gradient(m, design, niter, levels=1,
+                                    engine=engine, shape=lat.shape)
+        obj, g, _ = gf(theta0, lat.state, lat.params)
+        float(obj)
+        t0 = time.perf_counter()
+        obj, g, _ = gf(theta0, lat.state, lat.params)
+        s = float(obj) + float(jnp.sum(g))
+        assert np.isfinite(s)
+        return time.perf_counter() - t0
+
+    t_x = timed("xla")
+    t_p = timed("pallas")
+    assert t_p * 2.0 < t_x, (t_p, t_x)

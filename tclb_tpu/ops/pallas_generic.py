@@ -36,7 +36,7 @@ lower an op (e.g. ``arccos``).  The hand-tuned d2q9-family kernels
 
 from __future__ import annotations
 
-from functools import partial
+from functools import lru_cache, partial
 from typing import Callable, Optional
 
 import jax
@@ -868,6 +868,195 @@ def make_pallas_iterate(model: Model, shape, dtype=jnp.float32,
     iterate._impl = dict(call1=call1, call_g=call_g, by=by, pad=pad,
                          zonal_si=zonal_si, zshift=zshift,
                          nt_present=nt_present, mk_call=_mk_call)
+    return iterate
+
+
+# --------------------------------------------------------------------------- #
+# Generic VMEM-resident engine (2D): whole lattice on-chip, FUSE_R steps
+# per kernel launch
+# --------------------------------------------------------------------------- #
+
+_RESIDENT_FUSE = 8       # steps per kernel call (EVEN: ping-pong parity)
+_RESIDENT_BUDGET = 72 * 1024 * 1024   # state+aux residency budget (v5e
+#                          VMEM is 128 MiB; the rest holds the chunk
+#                          temporaries Mosaic scopes)
+
+
+def supports_resident(model: Model, shape, dtype) -> bool:
+    """Whether the generic VMEM-resident engine covers this model/shape:
+    any fused-engine-eligible 2D model whose two ping-pong stacks + aux
+    planes fit the residency budget.  This generalizes the d2q9-family
+    resident kernel (ops/pallas_d2q9.make_resident_iterate) to EVERY
+    registry model — the deep temporal fusion the band kernels cannot do
+    (their VMEM holds only a band; the reference has no analogue, its GPU
+    has no software-managed on-chip tier)."""
+    if model.ndim != 2 or len(shape) != 2 or dtype != jnp.float32:
+        return False
+    if not supports(model, shape, dtype, probe=False):
+        return False
+    ny, nx = (int(v) for v in shape)
+    if ny % 8 or nx % 128:
+        return False   # residency keeps the exact periodic wrap: no
+        #                ghost-row machinery, so the shape must be aligned
+    n_aux = 1 + len(model.zonal_settings)
+    if (2 * model.n_storage + n_aux) * ny * nx * 4 > _RESIDENT_BUDGET:
+        return False
+    plan, reach = action_plan(model, "Iteration", fuse=1)
+    if reach > _HALO:
+        return False
+    return supports(model, shape, dtype, probe=True)
+
+
+def make_resident_iterate(model: Model, shape, dtype=jnp.float32,
+                          interpret: Optional[bool] = None,
+                          present: Optional[set] = None,
+                          chunk_cap: int = 64):
+    """Generic VMEM-resident engine: ``_RESIDENT_FUSE`` full lattice
+    steps per kernel launch with the state ping-ponging between two
+    on-chip stacks — HBM traffic (1R+1W)/FUSE per step and ONE kernel
+    launch per FUSE steps (the band engines pay a launch per 1-2 steps,
+    measured ~40 us of gap each on v5e).
+
+    Physics is the SAME ``run_action_plan`` trace as the band kernels,
+    applied to row chunks of the resident stack; chunk halos are sliced
+    from the resident neighbors with exact periodic wrap (``_circ``), so
+    full-band's roll-wrap garbage stays in the discarded margin."""
+    if not supports_resident(model, shape, dtype):
+        raise ValueError(f"generic resident unsupported: {model.name} "
+                         f"{shape}")
+    ny, nx = (int(s) for s in shape)
+    if interpret is None:
+        interpret = jax.default_backend() != "tpu"
+    ns = model.n_storage
+    zonal_names = list(model.zonal_settings)
+    n_aux = 1 + len(zonal_names)
+    nt_present = set(model.node_types) if present is None else set(present)
+    plan1, reach = action_plan(model, "Iteration", fuse=1)
+    n_per_rep = len(model.actions["Iteration"])
+    adv = int(any(model.stages[s].load_densities
+                  for s in model.actions["Iteration"]))
+
+    # largest multiple-of-8 chunk dividing ny under the cap (bounds the
+    # per-chunk temporaries exactly like the band kernels' bands do)
+    chunk = 8
+    for c in range(8, min(ny, chunk_cap) + 1, 8):
+        if ny % c == 0:
+            chunk = c
+
+    def _circ(src, k, lo, hi):
+        """Rows [lo, hi) of resident plane ``k`` with periodic wrap
+        (static indices; at most one end wraps)."""
+        if lo >= 0 and hi <= ny:
+            return src[k, lo:hi, :]
+        parts = []
+        if lo < 0:
+            parts.append(src[k, ny + lo:ny, :])
+            lo = 0
+        parts.append(src[k, lo:min(hi, ny), :])
+        if hi > ny:
+            parts.append(src[k, 0:hi - ny, :])
+        return jnp.concatenate(parts, axis=0)
+
+    def kernel(sett, it_ref, f_ref, aux_ref, out_ref, buf):
+        """Time rides the GRID: step t's src/dst are picked by parity
+        (f_ref only feeds step 0), so the whole horizon runs in ONE
+        kernel launch with the state resident on-chip — the in/out
+        blocks and scratch have constant index maps, so pallas keeps
+        them in VMEM across grid steps and writes HBM once at the end."""
+        t = pl.program_id(0)
+
+        def one_step(src, dst):
+            for c0 in range(0, ny, chunk):
+                c1 = c0 + chunk
+                work = [_circ(src, k, c0 - _HALO, c1 + _HALO)
+                        for k in range(ns)]
+                fl = _circ(aux_ref, 0, c0 - _HALO, c1 + _HALO).astype(
+                    jnp.int32)
+                zon = {nm: _circ(aux_ref, 1 + j, c0 - _HALO, c1 + _HALO)
+                       for j, nm in enumerate(zonal_names)}
+                work, _, _ = run_action_plan(
+                    model, plan1, work, fl, zon, {}, sett,
+                    it_ref[0] + t * adv, nt_present, _HALO, nx, dtype,
+                    n_per_rep=n_per_rep, full_band=True)
+                for k in range(ns):
+                    dst[k, c0:c1, :] = work[k][_HALO:_HALO + chunk, :]
+
+        # ping-pong scratch <-> out (saves a third whole-lattice stack);
+        # an EVEN grid length lands the final step in out_ref
+        @pl.when(t == 0)
+        def _():
+            one_step(f_ref, buf)
+
+        @pl.when(jnp.logical_and(t > 0, jax.lax.rem(t, 2) == 1))
+        def _():
+            one_step(buf, out_ref)
+
+        @pl.when(jnp.logical_and(t > 0, jax.lax.rem(t, 2) == 0))
+        def _():
+            one_step(out_ref, buf)
+
+    @lru_cache(maxsize=None)
+    def _call_for(nsteps: int):
+        return pl.pallas_call(
+            kernel,
+            grid=(nsteps,),
+            in_specs=[
+                pl.BlockSpec(memory_space=pltpu.SMEM),
+                pl.BlockSpec(memory_space=pltpu.SMEM),
+                pl.BlockSpec(memory_space=pltpu.VMEM),
+                pl.BlockSpec(memory_space=pltpu.VMEM),
+            ],
+            out_specs=pl.BlockSpec(memory_space=pltpu.VMEM),
+            out_shape=jax.ShapeDtypeStruct((ns, ny, nx), dtype),
+            scratch_shapes=[pltpu.VMEM((ns, ny, nx), dtype)],
+            compiler_params=pltpu.CompilerParams(
+                vmem_limit_bytes=120 * 1024 * 1024),
+            interpret=interpret,
+        )
+
+    zshift = model.zone_shift
+    zonal_si = [model.setting_index[nm] for nm in zonal_names]
+    # the band engine supplies the trailing in-kernel-globals step (and
+    # any remainder), making the composition full_globals
+    band = make_pallas_iterate(model, shape, dtype, interpret=interpret,
+                               fuse=1, present=present, full_band=True)
+
+    @partial(jax.jit, static_argnames=("niter",), donate_argnums=0)
+    def _resident_jit(state: LatticeState, params: SimParams, niter: int
+                      ) -> LatticeState:
+        flags_i32 = state.flags.astype(jnp.int32)
+        zones = flags_i32 >> zshift
+        sett = params.settings.astype(dtype)
+        aux = jnp.stack(
+            [flags_i32.astype(dtype)]
+            + [params.zone_table[j].astype(dtype)[zones]
+               for j in zonal_si])
+        fields = _call_for(niter)(sett, state.iteration[None],
+                                  state.fields, aux)
+        return LatticeState(fields=fields, flags=state.flags,
+                            globals_=jnp.zeros_like(state.globals_),
+                            iteration=state.iteration + adv * niter)
+
+    def iterate(state: LatticeState, params: SimParams, niter: int
+                ) -> LatticeState:
+        if params.time_series is not None:
+            raise ValueError("generic resident engine does not support "
+                             "Control time series")
+        # EVEN resident length (ping-pong parity) leaving >=1 step for
+        # the band engine's globals flavor when the model declares
+        # Globals (full_globals contract)
+        tail_min = 1 if getattr(band, "full_globals", False) \
+            and model.n_globals else 0
+        main = max(niter - tail_min, 0) // 2 * 2
+        if main:
+            state = _resident_jit(state, params, main)
+        rest = niter - main
+        if rest:
+            state = band(state, params, rest)
+        return state
+
+    iterate.supports_series = False
+    iterate.full_globals = getattr(band, "full_globals", False)
     return iterate
 
 

@@ -18,6 +18,7 @@ numbers (BASELINE.md), so roofline fraction is the honest comparison axis.
 
 import json
 import os
+import sys
 import time
 
 import numpy as np
@@ -306,10 +307,11 @@ def bench_adjoint(results):
     except Exception as e:      # never let the adjoint probe kill bench
         results["adjoint_error"] = str(e)[:200]
         return []
-    # wall-clock regression guard (round-4 weak #8), OUTSIDE the probe's
-    # try so a silent fallback to the XLA path actually fails the bench
-    assert results["adjoint_speedup"] > 1.5, \
-        f"pallas adjoint regressed to XLA-class: {results}"
+    # wall-clock regression guard (round-4 weak #8): flag instead of
+    # asserting mid-run — the full results JSON (the diagnostics a
+    # regression hunt needs) still prints, and main() exits nonzero
+    if results["adjoint_speedup"] <= 1.5:
+        results["adjoint_regressed"] = True
     return []
 
 
@@ -426,6 +428,11 @@ def main():
         "vs_baseline": round(ratio, 4),
         **results,
     }))
+    if results.get("adjoint_regressed"):
+        print("FAIL: pallas adjoint regressed to XLA-class "
+              f"(speedup {results.get('adjoint_speedup')}x <= 1.5x)",
+              file=sys.stderr)
+        sys.exit(1)
 
 
 if __name__ == "__main__":

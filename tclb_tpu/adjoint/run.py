@@ -402,7 +402,8 @@ def make_steady_gradient(model: Model, design, n_adjoint: int = 100,
                          tol: float = 1e-10, strict: bool = False,
                          engine: str = "auto",
                          shape: Optional[tuple] = None,
-                         dtype=jnp.float32) -> Callable:
+                         dtype=jnp.float32,
+                         has_series: bool = False) -> Callable:
     """Fixed-point (steady) adjoint: with the primal converged, solve
     ``lambda = A^T lambda + dJ/ds`` by ``n_adjoint`` adjoint iterations
     (the Neumann series of VJPs of one step) and return
@@ -422,61 +423,88 @@ def make_steady_gradient(model: Model, design, n_adjoint: int = 100,
 
     ``engine="auto"`` (with ``shape``) runs each adjoint pass on the
     fused Pallas kernels at chunk 1 (the Neumann series applies ONE
-    step's transpose per pass); XLA otherwise.
+    step's transpose per pass); XLA otherwise.  ``has_series=True``
+    includes a fixed ``<Control>`` schedule in the engine decision (the
+    per-step aux planes must follow it); a series showing up in
+    ``params`` at call time without it falls back to the XLA step for
+    that call instead of failing at trace time.
     """
     step = _pick_engine(model, design, 1, engine, shape, action, streaming,
-                        dtype)
-    # (steady runs hold a converged primal: Control series do not apply)
-    returns_inc = bool(getattr(step, "returns_inc", False))
-    if step is None:
-        step = make_action_step(model, action, streaming)
-
-    def one_step(theta, fields, state, params):
-        state, params = design.put(theta, state.replace(fields=fields),
-                                   params)
-        w = objective_weights(model, params)
-        if returns_inc:
-            s2, ginc = step(state, params)
-            return s2.fields, jnp.sum(w * ginc)
-        s2 = step(state, params)
-        return s2.fields, jnp.sum(w * s2.globals_)
+                        dtype, has_series)
+    step_is_series = ",series" in getattr(step, "engine_name", "")
 
     def _tree_norm(t) -> jnp.ndarray:
         return jnp.sqrt(sum(jnp.vdot(x, x).real
                             for x in jax.tree_util.tree_leaves(t)) + 1e-300)
 
-    @jax.jit
-    def _run(theta, state: LatticeState, params: SimParams):
-        fields = state.fields
-        (new_fields, obj), vjp = jax.vjp(
-            lambda th, fs: one_step(th, fs, state, params), theta, fields)
-        # seed: dJ/d(output objective) = 1, dJ/d(output fields) = 0
-        zero_f = jnp.zeros_like(new_fields)
-        g_theta0, lam = vjp((zero_f, jnp.ones_like(obj)))
+    def _build(step_):
+        returns_inc = bool(getattr(step_, "returns_inc", False))
+        if step_ is None:
+            step_ = make_action_step(model, action, streaming)
 
-        # Neumann iterations: propagate lambda back through A^T, accumulating
-        # the theta-cotangent each pass.  Convergence is measured on what the
-        # caller consumes — the GRADIENT increment ||dth|| relative to the
-        # accumulated gradient norm — not on lambda (which can decay much
-        # more slowly than its projection onto the design space).
-        def cond(carry):
-            _, acc, k, rel_inc = carry
-            return (k < n_adjoint) & (rel_inc > tol)
+        def one_step(theta, fields, state, params):
+            state, params = design.put(theta, state.replace(fields=fields),
+                                       params)
+            w = objective_weights(model, params)
+            if returns_inc:
+                s2, ginc = step_(state, params)
+                return s2.fields, jnp.sum(w * ginc)
+            s2 = step_(state, params)
+            return s2.fields, jnp.sum(w * s2.globals_)
 
-        def body(carry):
-            lam, acc, k, _ = carry
-            dth, dlam = vjp((lam, jnp.zeros_like(obj)))
-            acc = jax.tree_util.tree_map(jnp.add, acc, dth)
-            rel_inc = _tree_norm(dth) / jnp.maximum(_tree_norm(acc), 1e-30)
-            return (dlam, acc, k + 1, rel_inc)
+        @jax.jit
+        def _run(theta, state: LatticeState, params: SimParams):
+            fields = state.fields
+            (new_fields, obj), vjp = jax.vjp(
+                lambda th, fs: one_step(th, fs, state, params), theta,
+                fields)
+            # seed: dJ/d(output objective) = 1, dJ/d(output fields) = 0
+            zero_f = jnp.zeros_like(new_fields)
+            g_theta0, lam = vjp((zero_f, jnp.ones_like(obj)))
 
-        lam_f, g_theta, k, rel_inc = lax.while_loop(
-            cond, body,
-            (lam, g_theta0, jnp.zeros((), jnp.int32), jnp.ones(())))
-        return obj, g_theta, k, rel_inc
+            # Neumann iterations: propagate lambda back through A^T,
+            # accumulating the theta-cotangent each pass.  Convergence is
+            # measured on what the caller consumes — the GRADIENT
+            # increment ||dth|| relative to the accumulated gradient norm
+            # — not on lambda (which can decay much more slowly than its
+            # projection onto the design space).
+            def cond(carry):
+                _, acc, k, rel_inc = carry
+                return (k < n_adjoint) & (rel_inc > tol)
+
+            def body(carry):
+                lam, acc, k, _ = carry
+                dth, dlam = vjp((lam, jnp.zeros_like(obj)))
+                acc = jax.tree_util.tree_map(jnp.add, acc, dth)
+                rel_inc = _tree_norm(dth) / jnp.maximum(_tree_norm(acc),
+                                                        1e-30)
+                return (dlam, acc, k + 1, rel_inc)
+
+            lam_f, g_theta, k, rel_inc = lax.while_loop(
+                cond, body,
+                (lam, g_theta0, jnp.zeros((), jnp.int32), jnp.ones(())))
+            return obj, g_theta, k, rel_inc
+
+        return _run
+
+    _runs = {"main": _build(step)}
 
     def grad_fn(theta, state: LatticeState, params: SimParams):
-        obj, g_theta, k, rel_inc = _run(theta, state, params)
+        key = "main"
+        if (params.time_series is not None and step is not None
+                and not step_is_series):
+            # the engine was picked without series knowledge (the
+            # historical trace-time ValueError): run this call on the
+            # XLA step instead of dropping the schedule
+            if "xla" not in _runs:
+                from tclb_tpu.utils import log
+                log.info("steady adjoint: params carry a Control series "
+                         "but the engine was built without one — XLA "
+                         "fallback (pass has_series=True to keep the "
+                         "Pallas engine)")
+                _runs["xla"] = _build(None)
+            key = "xla"
+        obj, g_theta, k, rel_inc = _runs[key](theta, state, params)
         inc_v, k_v = float(rel_inc), int(k)
         if not np.isfinite(inc_v):
             raise FloatingPointError(

@@ -1,0 +1,92 @@
+"""Static analysis of registered models and kernel engines.
+
+The reference's R-template codegen is also its validator: a malformed
+velocity set, a stencil wider than the generated margins, or an
+impossible kernel configuration dies at template-expansion time.  This
+port traces instead of generating, so those defects used to surface as
+cryptic Pallas lowering errors (or silent wrong physics) deep inside
+``engine='auto'``.  This package is the replacement gate:
+
+* :func:`analyze_model` — run all checks on one model, returning
+  severity-ranked :class:`Finding`s (invariants, stencil footprint,
+  kernel resources, hygiene);
+* :func:`analyze_repo` — repo-level checks (dead engine entry points,
+  ``id()``-keyed caches);
+* :func:`kernel_safety_ok` — the verdict the engine dispatch consults:
+  no error-severity footprint findings (an undeclared banded-axis read
+  would make the band kernels silently compute wrong physics);
+* CLI: ``python -m tclb_tpu.analysis [--all | MODEL ...]
+  [--format text|json]`` — exits nonzero on any error finding.
+
+Check modules import the kernel engines lazily, so ``tclb_tpu.ops``
+modules can import :mod:`tclb_tpu.analysis.fingerprint` (and
+``analysis.resources`` inside functions) without a cycle.
+"""
+
+from __future__ import annotations
+
+from tclb_tpu.analysis.findings import (Finding, SEVERITIES,  # noqa: F401
+                                        sort_findings, worst_severity)
+from tclb_tpu.analysis.fingerprint import (  # noqa: F401
+    structural_fingerprint)
+
+_safety_cache: dict = {}
+
+
+def _as_model(model_or_name):
+    if isinstance(model_or_name, str):
+        from tclb_tpu.models import get_model
+        return get_model(model_or_name)
+    return model_or_name
+
+
+def analyze_model(model_or_name, shape=None) -> list:
+    """All per-model checks; returns findings sorted most-severe first."""
+    from tclb_tpu.analysis import footprint, hygiene, invariants, resources
+    model = _as_model(model_or_name)
+    findings = []
+    for check in (invariants.check_invariants, footprint.check_footprint,
+                  resources.check_resources, hygiene.check_model_hygiene):
+        try:
+            findings += check(model, shape)
+        except Exception as e:  # noqa: BLE001 — a crashed check is a finding
+            findings.append(Finding(
+                "analysis.check_crashed", "error", model.name,
+                f"{check.__module__.rsplit('.', 1)[-1]} crashed: "
+                f"{type(e).__name__}: {str(e)[:200]}"))
+    return sort_findings(findings)
+
+
+def analyze_repo() -> list:
+    """Repo-level checks (model-independent)."""
+    from tclb_tpu.analysis import hygiene
+    return sort_findings(hygiene.check_repo())
+
+
+def analyze_all(shape=None) -> dict:
+    """``{model_name: findings}`` over every registered model, plus
+    repo-level findings under the empty key."""
+    from tclb_tpu.models import list_models
+    out = {"": analyze_repo()}
+    for name in list_models():
+        out[name] = analyze_model(name, shape)
+    return out
+
+
+def kernel_safety_ok(model) -> bool:
+    """Whether the Pallas engines may run this model: no error-severity
+    stencil-footprint findings.  Cached on the structural fingerprint —
+    the dispatch consults this on every engine build."""
+    key = model.fingerprint
+    if key not in _safety_cache:
+        from tclb_tpu.analysis.footprint import kernel_safety_errors
+        try:
+            errors = kernel_safety_errors(model)
+        except Exception:  # noqa: BLE001 — analyzer failure must not
+            errors = []    # take the engines down; probes still gate
+        if errors:
+            from tclb_tpu.utils import log
+            for f in errors:
+                log.warning(f"analysis: {model.name}: {f.message}")
+        _safety_cache[key] = not errors
+    return _safety_cache[key]

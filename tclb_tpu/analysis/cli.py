@@ -1,0 +1,102 @@
+"""``python -m tclb_tpu.analysis``: the static gate as a command.
+
+Exit status: 0 = no error-severity findings, 1 = errors found,
+2 = usage error.  ``--format json`` emits one machine-readable document
+(schema: ``{"models": {name: [finding...]}, "repo": [finding...],
+"summary": {...}}``) — what CI gates on.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+
+
+def _parse_shape(text):
+    try:
+        shape = tuple(int(v) for v in text.replace("x", ",").split(","))
+    except ValueError:
+        raise argparse.ArgumentTypeError(
+            f"bad shape {text!r}: expected NY,NX or NZ,NY,NX")
+    if len(shape) not in (2, 3):
+        raise argparse.ArgumentTypeError(
+            f"bad shape {text!r}: expected 2 or 3 dims")
+    return shape
+
+
+def main(argv=None) -> int:
+    from tclb_tpu import analysis
+    from tclb_tpu.models import list_models
+
+    p = argparse.ArgumentParser(
+        prog="python -m tclb_tpu.analysis",
+        description="Static analyzer: velocity-set invariants, stencil "
+                    "footprints vs halo, kernel VMEM budgets, registry "
+                    "hygiene.")
+    p.add_argument("models", nargs="*", metavar="MODEL",
+                   help="model names to analyze (see --all)")
+    p.add_argument("--all", action="store_true",
+                   help="analyze every registered model + repo checks")
+    p.add_argument("--format", choices=("text", "json"), default="text")
+    p.add_argument("--shape", type=_parse_shape, default=None,
+                   metavar="NY,NX",
+                   help="lattice shape for the resource checks "
+                        "(default: a production-scale shape per ndim)")
+    p.add_argument("--min-severity", choices=("error", "warning", "info"),
+                   default="info",
+                   help="hide findings below this severity in the output "
+                        "(the exit code always reflects errors)")
+    args = p.parse_args(argv)
+
+    if not args.all and not args.models:
+        p.print_usage(sys.stderr)
+        print("error: give model names or --all", file=sys.stderr)
+        return 2
+    known = set(list_models())
+    unknown = [m for m in args.models if m not in known]
+    if unknown:
+        print(f"error: unknown models {unknown}; known: "
+              f"{sorted(known)}", file=sys.stderr)
+        return 2
+
+    names = sorted(known) if args.all else args.models
+    per_model = {n: analysis.analyze_model(n, args.shape) for n in names}
+    repo = analysis.analyze_repo() if args.all else []
+
+    everything = repo + [f for fs in per_model.values() for f in fs]
+    n_err = sum(f.severity == "error" for f in everything)
+    n_warn = sum(f.severity == "warning" for f in everything)
+    n_info = sum(f.severity == "info" for f in everything)
+
+    max_rank = {"error": 0, "warning": 1, "info": 2}[args.min_severity]
+
+    if args.format == "json":
+        doc = {
+            "models": {n: [f.to_dict() for f in fs if f.rank <= max_rank]
+                       for n, fs in per_model.items()},
+            "repo": [f.to_dict() for f in repo if f.rank <= max_rank],
+            "summary": {"models": len(names), "errors": n_err,
+                        "warnings": n_warn, "info": n_info},
+        }
+        print(json.dumps(doc, indent=2, sort_keys=True))
+    else:
+        def show(fs, head):
+            fs = [f for f in fs if f.rank <= max_rank]
+            if not fs:
+                return
+            print(head)
+            for f in fs:
+                loc = f" [{f.where}]" if f.where else ""
+                print(f"  {f.severity.upper():7s} {f.check}{loc}: "
+                      f"{f.message}")
+        show(repo, "repo:")
+        for n in names:
+            show(per_model[n], f"{n}:")
+        print(f"{len(names)} models: {n_err} errors, {n_warn} warnings, "
+              f"{n_info} info")
+    return 1 if n_err else 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,64 @@
+"""Finding: one static-analysis diagnostic, severity-ranked.
+
+The reference catches model-definition errors at codegen time (its R
+templates refuse to emit a kernel for a malformed velocity set or stencil);
+this port has no codegen, so the analyzer reports the same classes of
+defect as data instead.  Severities:
+
+* ``error``   — the model (or the repo) is broken: wrong physics or a
+  kernel that would silently read garbage.  The engine dispatch refuses
+  Pallas kernels for models with kernel-safety errors, and the CLI exits
+  nonzero.
+* ``warning`` — a capability limit with a correct fallback (e.g. a stencil
+  too deep for the band kernels: the XLA path still runs it) or a hygiene
+  smell worth tracking.
+* ``info``    — advisory facts (resource estimates, skipped checks).
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+
+SEVERITIES = ("error", "warning", "info")
+_RANK = {s: i for i, s in enumerate(SEVERITIES)}
+
+
+@dataclass(frozen=True)
+class Finding:
+    """One diagnostic: ``check`` is the dotted check id (e.g.
+    ``footprint.undeclared_read``), ``model`` the registered model name
+    (or ``""`` for repo-level findings), ``where`` an optional
+    file/stage/plane locator, ``details`` structured data for tooling."""
+
+    check: str
+    severity: str
+    model: str
+    message: str
+    where: str = ""
+    details: dict = field(default_factory=dict, compare=False)
+
+    def __post_init__(self):
+        if self.severity not in _RANK:
+            raise ValueError(f"unknown severity {self.severity!r}")
+
+    @property
+    def rank(self) -> int:
+        return _RANK[self.severity]
+
+    def to_dict(self) -> dict:
+        return {"check": self.check, "severity": self.severity,
+                "model": self.model, "message": self.message,
+                "where": self.where, "details": self.details}
+
+
+def sort_findings(findings: list) -> list:
+    """Most severe first, then by check id and locator (stable output for
+    goldens and diffs)."""
+    return sorted(findings, key=lambda f: (f.rank, f.check, f.model,
+                                           f.where, f.message))
+
+
+def worst_severity(findings: list) -> str | None:
+    if not findings:
+        return None
+    return min(findings, key=lambda f: f.rank).severity

@@ -1,0 +1,24 @@
+"""Structural model fingerprints for eligibility caches.
+
+:func:`structural_fingerprint` hashes everything the kernel engines
+specialize on (``Model.structural_key``) — the cache key the round-5
+advisor asked for: ``id(model)`` keys alias recycled addresses (a rebuilt
+model can inherit a stale verdict from a dead object at the same address)
+and miss structurally identical rebuilds (every rebuild re-probes).
+
+This module deliberately imports nothing from ``tclb_tpu.ops`` so the
+kernel modules can import it without a cycle.
+"""
+
+from __future__ import annotations
+
+from tclb_tpu.core.registry import Model
+
+
+def structural_key(model: Model) -> tuple:
+    return model.structural_key()
+
+
+def structural_fingerprint(model: Model) -> str:
+    """Short hex digest stable across processes and model rebuilds."""
+    return model.fingerprint

@@ -1,0 +1,188 @@
+"""Stencil footprint vs halo: abstract trace of every stage's reads.
+
+The band kernels (ops/pallas_generic) size their windows from DECLARED
+metadata only — streaming vectors and ``Field.d*_range`` — via
+``_stage_reach``/``action_plan``.  A stage that ``ctx.load``s beyond its
+declaration therefore reads rows outside the valid band window: the
+slice stays in-bounds of the buffer, so nothing crashes — the kernel
+silently computes on stale halo rows (exactly the class of bug the
+reference's generated margins make impossible).  This check traces each
+stage function abstractly (``jax.eval_shape`` against a recording
+:class:`~tclb_tpu.ops.pallas_generic.KernelCtx`) and compares every
+recorded ``(dx, dy, dz)`` against the declaration.
+
+On top of the per-stage trace it verifies the plan-level budgets:
+
+* forward band engine: total fuse=1 reach within the 8-row DMA halo;
+* adjoint band kernel: the R-extended backward window needs
+  ``2*R <= halo`` — beyond that, the cotangent cone of one band reaches
+  rows another band also seeds, and the masked-window arithmetic that
+  prevents cross-band double-counting of cotangents no longer holds.
+"""
+
+from __future__ import annotations
+
+from tclb_tpu.analysis.findings import Finding
+from tclb_tpu.core.registry import Model
+
+# severity of an undeclared read depends on the axis: the banded axis
+# (y in 2D, z in 3D) is windowed — reads beyond the declaration hit
+# stale rows; the other axes wrap whole rows/planes exactly, so an
+# undeclared offset there is only a metadata smell.
+_BANDED_AXIS = {2: "dy", 3: "dz"}
+
+
+def trace_stage_reads(model: Model, action: str) -> dict:
+    """``{stage_name: set[(storage_index, dx, dy, dz)]}`` of every
+    ``ctx.load`` each stage performs, recorded during an abstract trace
+    (no FLOPs run).  Raises on untraceable stages — callers wrap."""
+    import jax
+    import jax.numpy as jnp
+
+    from tclb_tpu.ops.pallas_generic import KernelCtx
+
+    pshape = (8, 16) if model.ndim == 2 else (4, 8, 16)
+    dtype = jnp.float32
+    zonal = list(model.zonal_settings)
+    out: dict = {}
+    for sname in model.actions[action]:
+        stage = model.stages[sname]
+        fn = model.stage_fns.get(stage.main)
+        if fn is None:
+            raise ValueError(f"stage {sname!r}: no bound function "
+                             f"{stage.main!r}")
+        recs: set = set()
+
+        def run(stack, flags, sett, zstack, _fn=fn, _recs=recs):
+            planes = [stack[i] for i in range(model.n_storage)]
+
+            def loader(index, dx=0, dy=0, dz=0):
+                _recs.add((int(index), int(dx), int(dy), int(dz)))
+                return stack[index]
+
+            ctx = KernelCtx(
+                model, planes, loader, flags,
+                {nm: zstack[j] for j, nm in enumerate(zonal)},
+                sett, dtype, 0, None, compute_globals=True)
+            return _fn(ctx)
+
+        jax.eval_shape(
+            run,
+            jax.ShapeDtypeStruct((model.n_storage,) + pshape, dtype),
+            jax.ShapeDtypeStruct(pshape, jnp.int32),
+            jax.ShapeDtypeStruct((len(model.settings),), dtype),
+            jax.ShapeDtypeStruct((max(len(zonal), 1),) + pshape, dtype))
+        out[sname] = recs
+    return out
+
+
+def _declared_ranges(model: Model, index: int):
+    """Declared per-axis (lo, hi) load ranges of a storage plane:
+    a Field's registered stencil; densities have no declared ``load``
+    stencil (streaming is separate and always declared)."""
+    n_dens = len(model.densities)
+    if index >= n_dens:
+        f = model.fields[index - n_dens]
+        return {"dx": f.dx_range, "dy": f.dy_range, "dz": f.dz_range}
+    return {"dx": (0, 0), "dy": (0, 0), "dz": (0, 0)}
+
+
+def check_footprint(model: Model, shape=None) -> list:
+    findings: list = []
+    from tclb_tpu.ops import pallas_generic
+
+    for action in sorted(model.actions):
+        try:
+            reads = trace_stage_reads(model, action)
+        except Exception as e:  # noqa: BLE001 — untraceable stage
+            findings.append(Finding(
+                "footprint.trace_failed", "info", model.name,
+                f"action {action!r} not traceable in a kernel context "
+                f"({type(e).__name__}: {str(e)[:120]}) — the band-engine "
+                "capability probe rejects it for the same reason",
+                f"action:{action}"))
+            continue
+        banded = _BANDED_AXIS[model.ndim]
+        for sname, recs in reads.items():
+            for index, dx, dy, dz in sorted(recs):
+                decl = _declared_ranges(model, index)
+                offs = {"dx": dx, "dy": dy, "dz": dz}
+                plane = model.storage_names[index]
+                for axis, off in offs.items():
+                    lo, hi = decl[axis]
+                    if lo <= off <= hi:
+                        continue
+                    if axis == banded:
+                        findings.append(Finding(
+                            "footprint.undeclared_read", "error",
+                            model.name,
+                            f"stage {sname!r} loads {plane!r} at "
+                            f"{axis}={off}, outside its declared range "
+                            f"[{lo}, {hi}]: the band kernels size their "
+                            f"{banded} windows from the declaration and "
+                            "would silently read stale halo rows",
+                            f"action:{action}/stage:{sname}/"
+                            f"plane:{plane}",
+                            {"axis": axis, "offset": off,
+                             "declared": [lo, hi]}))
+                    else:
+                        findings.append(Finding(
+                            "footprint.undeclared_read_wrapped", "warning",
+                            model.name,
+                            f"stage {sname!r} loads {plane!r} at "
+                            f"{axis}={off}, outside its declared range "
+                            f"[{lo}, {hi}] (axis wraps exactly in-kernel, "
+                            "but the declaration understates the stencil)",
+                            f"action:{action}/stage:{sname}/"
+                            f"plane:{plane}",
+                            {"axis": axis, "offset": off,
+                             "declared": [lo, hi]}))
+
+    # -- plan-level halo budgets (the Iteration action is what the band
+    #    engines fuse) ---------------------------------------------------- #
+    if "Iteration" in model.actions:
+        try:
+            _, reach = pallas_generic.action_plan(model, "Iteration",
+                                                  fuse=1)
+        except Exception as e:  # noqa: BLE001
+            findings.append(Finding(
+                "footprint.plan_failed", "warning", model.name,
+                f"action_plan failed: {type(e).__name__}: "
+                f"{str(e)[:120]}"))
+            return findings
+        halo = pallas_generic.HALO
+        if reach > halo:
+            findings.append(Finding(
+                "footprint.halo", "warning", model.name,
+                f"Iteration stencil reach {reach} exceeds the {halo}-row "
+                "DMA halo: band engines ineligible (XLA path still "
+                "correct)", "action:Iteration",
+                {"reach": reach, "halo": halo}))
+        if model.ndim == 2:
+            R = max(reach, 1)
+            if 2 * R > halo:
+                findings.append(Finding(
+                    "footprint.adjoint_band", "warning", model.name,
+                    f"adjoint R-extended band needs 2*R = {2 * R} halo "
+                    f"rows (> {halo}): one band's cotangent cone would "
+                    "alias rows a neighboring band also seeds, "
+                    "double-counting cotangents — fused Pallas adjoint "
+                    "ineligible", "action:Iteration",
+                    {"R": R, "halo": halo}))
+            else:
+                from tclb_tpu.ops import pallas_adjoint
+                k = pallas_adjoint.max_chunk(model)
+                findings.append(Finding(
+                    "footprint.adjoint_chunk", "info", model.name,
+                    f"adjoint chunk budget: max_chunk={k} "
+                    f"(fuse-1 reach {reach})", "action:Iteration",
+                    {"max_chunk": k, "reach": reach}))
+    return findings
+
+
+def kernel_safety_errors(model: Model) -> list:
+    """Error-severity footprint findings only — what the engine dispatch
+    consults before handing a model to the band kernels (an undeclared
+    banded-axis read means the kernel computes wrong physics without
+    failing)."""
+    return [f for f in check_footprint(model) if f.severity == "error"]

@@ -1,0 +1,262 @@
+"""Registry/repo hygiene: dead engine entry points, ``id()``-keyed
+caches, unbound stages, and model test/golden inventory.
+
+The round-5 advisor found two instances of the same disease — an engine
+entry point (``pallas_generic.supports_resident``/``make_resident_iterate``)
+that no dispatch arm ever calls, and an eligibility cache keyed on
+``id(model)`` (stale verdicts on recycled addresses, useless re-probes on
+rebuilt models).  Both are statically detectable, so this module detects
+them for good:
+
+* **dead entry points** — every public ``make_*``/``supports*`` function
+  in ``tclb_tpu/ops`` must be reachable: referenced from another module
+  (qualified ``module.fn`` or ``from module import fn``) or from a LIVE
+  function in its own module.  The liveness fixpoint matters: a dead
+  builder calling its own dead eligibility check must not keep either
+  alive.
+* **id()-keyed caches** — any ``id(...)`` call in package source is
+  flagged (the package has no legitimate use; dict keys were the only
+  historical one).
+"""
+
+from __future__ import annotations
+
+import ast
+import os
+
+from tclb_tpu.analysis.findings import Finding
+from tclb_tpu.core.registry import Model
+
+_PKG_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+_REPO_ROOT = os.path.dirname(_PKG_ROOT)
+
+
+def _py_files(root: str) -> list:
+    out = []
+    for dirpath, _dirnames, filenames in os.walk(root):
+        out += [os.path.join(dirpath, f) for f in filenames
+                if f.endswith(".py")]
+    return sorted(out)
+
+
+def _default_sources() -> list:
+    srcs = _py_files(_PKG_ROOT)
+    tests = os.path.join(_REPO_ROOT, "tests")
+    if os.path.isdir(tests):
+        srcs += _py_files(tests)
+    for extra in ("bench.py",):
+        p = os.path.join(_REPO_ROOT, extra)
+        if os.path.isfile(p):
+            srcs.append(p)
+    return srcs
+
+
+def _module_name(path: str, root: str) -> str:
+    ap = os.path.abspath(path)
+    base = os.path.dirname(os.path.abspath(root))
+    if not ap.startswith(base + os.sep):
+        # out-of-tree sources (the detector's own tests scan tmp dirs):
+        # name relative to the grandparent, so ``<tmp>/ops/eng.py``
+        # becomes ``ops.eng`` — matching how its scanned users import it
+        base = os.path.dirname(os.path.dirname(ap))
+    rel = os.path.relpath(ap, base)
+    mod = rel[:-3].replace(os.sep, ".")
+    return mod[:-len(".__init__")] if mod.endswith(".__init__") else mod
+
+
+def _resolve_from(module, level: int, here: str) -> str:
+    """Resolve a (possibly relative) ``from ... import`` module path."""
+    if level == 0:
+        return module or ""
+    parts = here.split(".")[:-level]
+    return ".".join(parts + ([module] if module else []))
+
+
+def scan_id_keyed_caches(paths=None) -> list:
+    """Flag every call of the builtin ``id`` in the given sources."""
+    findings = []
+    for path in (paths if paths is not None
+                 else _py_files(_PKG_ROOT)):
+        try:
+            with open(path) as fh:
+                tree = ast.parse(fh.read())
+        except SyntaxError as e:
+            findings.append(Finding(
+                "hygiene.unparseable", "error", "",
+                f"cannot parse {path}: {e}", path))
+            continue
+        for node in ast.walk(tree):
+            if isinstance(node, ast.Call) \
+                    and isinstance(node.func, ast.Name) \
+                    and node.func.id == "id":
+                rel = os.path.relpath(path, _REPO_ROOT)
+                findings.append(Finding(
+                    "hygiene.id_keyed_cache", "error", "",
+                    f"{rel}:{node.lineno} uses id(...) — object-identity "
+                    "keys alias recycled addresses and miss structurally "
+                    "identical rebuilds; key on Model.fingerprint "
+                    "instead", f"{rel}:{node.lineno}"))
+    return findings
+
+
+def _file_refs(tree, modname: str):
+    """(qualified_refs, own_module_uses) for one parsed file.
+
+    ``qualified_refs``: set of (module, attr) — ``mod.fn`` attribute
+    accesses through import aliases plus direct ``from mod import fn``.
+    ``own_module_uses``: {name: set of enclosing top-level function names
+    (or "" for module level)} for bare Name loads."""
+    aliases: dict = {}
+    refs: set = set()
+    for node in ast.walk(tree):
+        if isinstance(node, ast.Import):
+            for a in node.names:
+                if a.asname:
+                    aliases[a.asname] = a.name
+        elif isinstance(node, ast.ImportFrom):
+            base = _resolve_from(node.module, node.level, modname)
+            for a in node.names:
+                refs.add((base, a.name))
+                aliases[a.asname or a.name] = (base + "." + a.name
+                                               if base else a.name)
+    for node in ast.walk(tree):
+        if isinstance(node, ast.Attribute) \
+                and isinstance(node.value, ast.Name) \
+                and node.value.id in aliases:
+            refs.add((aliases[node.value.id], node.attr))
+
+    own: dict = {}
+
+    def collect_names(node, scope: str):
+        for child in ast.iter_child_nodes(node):
+            if isinstance(child, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                inner = scope if scope else child.name
+                for dec in child.decorator_list:
+                    for n in ast.walk(dec):
+                        if isinstance(n, ast.Name):
+                            own.setdefault(n.id, set()).add(scope)
+                collect_names(child, inner)
+            elif isinstance(child, ast.Name) \
+                    and isinstance(child.ctx, ast.Load):
+                own.setdefault(child.id, set()).add(scope)
+                collect_names(child, scope)
+            else:
+                if isinstance(child, ast.Name):
+                    own.setdefault(child.id, set()).add(scope)
+                collect_names(child, scope)
+    collect_names(tree, "")
+    return refs, own
+
+
+def scan_dead_entry_points(engine_dir=None, sources=None) -> list:
+    """Unreachable engine entry points: public ``make_*``/``supports*``
+    functions in ``tclb_tpu/ops`` no live code refers to."""
+    engine_dir = engine_dir or os.path.join(_PKG_ROOT, "ops")
+    sources = sources if sources is not None else _default_sources()
+
+    entry: dict = {}          # (module, fn) -> lineno
+    own_uses: dict = {}       # module -> {name: {enclosing fn or ""}}
+    all_refs: set = set()     # qualified (module, fn) refs, everywhere
+    parsed: dict = {}
+    for path in sorted(set(_py_files(engine_dir)) | set(sources)):
+        try:
+            with open(path) as fh:
+                tree = ast.parse(fh.read())
+        except SyntaxError:
+            continue
+        modname = _module_name(path, _PKG_ROOT)
+        parsed[modname] = path
+        refs, own = _file_refs(tree, modname)
+        all_refs |= refs
+        if os.path.dirname(os.path.abspath(path)) \
+                == os.path.abspath(engine_dir):
+            own_uses[modname] = own
+            for node in ast.iter_child_nodes(tree):
+                if isinstance(node, ast.FunctionDef) \
+                        and not node.name.startswith("_") \
+                        and (node.name.startswith("make_")
+                             or node.name.startswith("supports")):
+                    entry[(modname, node.name)] = node.lineno
+
+    # liveness fixpoint: externally referenced entry points are live;
+    # an own-module use keeps a function live only if it comes from
+    # module level or from a function that is not itself a dead entry
+    # point.
+    dead = {k for k in entry if k not in all_refs}
+    changed = True
+    while changed:
+        changed = False
+        for mod, fn in list(dead):
+            users = own_uses.get(mod, {}).get(fn, set())
+            live_users = {u for u in users
+                          if u == "" or (mod, u) not in dead}
+            if live_users:
+                dead.discard((mod, fn))
+                changed = True
+
+    findings = []
+    for mod, fn in sorted(dead):
+        rel = os.path.relpath(parsed[mod], _REPO_ROOT)
+        findings.append(Finding(
+            "hygiene.dead_entry_point", "error", "",
+            f"{mod}.{fn} ({rel}:{entry[(mod, fn)]}) is an engine entry "
+            "point nothing dispatches to — wire it into the Lattice/"
+            "adjoint selection or delete it",
+            f"{rel}:{entry[(mod, fn)]}"))
+    return findings
+
+
+def check_repo(engine_dir=None, sources=None) -> list:
+    return (scan_dead_entry_points(engine_dir, sources)
+            + scan_id_keyed_caches())
+
+
+def check_model_hygiene(model: Model, shape=None) -> list:
+    """Per-model hygiene: unbound stages behind registered actions, and
+    the test/golden inventory (informational — the generic parametrized
+    sweeps cover models no test names explicitly)."""
+    findings: list = []
+    for action, stages in sorted(model.actions.items()):
+        for sname in stages:
+            st = model.stages.get(sname)
+            if st is None:
+                findings.append(Finding(
+                    "hygiene.missing_stage", "error", model.name,
+                    f"action {action!r} references unregistered stage "
+                    f"{sname!r}", f"action:{action}"))
+            elif model.stage_fns.get(st.main) is None:
+                findings.append(Finding(
+                    "hygiene.unbound_stage", "error", model.name,
+                    f"action {action!r} stage {sname!r} has no bound "
+                    f"function {st.main!r}", f"action:{action}"))
+
+    tests_dir = os.path.join(_REPO_ROOT, "tests")
+    named = False
+    if os.path.isdir(tests_dir):
+        needle_a, needle_b = f'"{model.name}"', f"'{model.name}'"
+        for p in _py_files(tests_dir):
+            with open(p) as fh:
+                src = fh.read()
+            if needle_a in src or needle_b in src:
+                named = True
+                break
+    if not named:
+        findings.append(Finding(
+            "hygiene.no_named_test", "info", model.name,
+            "no test references this model by name (the parametrized "
+            "all-models sweeps still cover it)"))
+    goldens_dir = os.path.join(tests_dir, "goldens")
+    has_golden = False
+    if os.path.isdir(goldens_dir):
+        for f in os.listdir(goldens_dir):
+            path = os.path.join(goldens_dir, f)
+            if f.endswith(".json") and os.path.isfile(path):
+                with open(path) as fh:
+                    if model.name in fh.read():
+                        has_golden = True
+                        break
+    if not has_golden:
+        findings.append(Finding(
+            "hygiene.no_golden", "info", model.name,
+            "no golden regression file references this model"))
+    return findings

@@ -1,0 +1,145 @@
+"""Velocity-set / moment invariants.
+
+The reference's codegen reads each model's velocity set and weights from
+its R registration and any malformed set dies at template-expansion time;
+here the registry only stores the streaming vectors, so this check
+re-derives the lattice weights (ops/lbm's shell tables — the same tables
+the physics callables use) and verifies the discrete moment conditions
+every LBM velocity set must satisfy:
+
+* weights positive and summing to 1;
+* first moments vanish: ``sum_i w_i e_i = 0`` (and ``sum_i e_i = 0``);
+* second-moment isotropy: ``sum_i w_i e_ia e_ib = cs^2 delta_ab`` with a
+  single sound speed across axes;
+* opposite-direction pairing: every ``e_i`` has ``-e_i`` in the same
+  group (bounce-back reflects per pair — an unpaired vector makes every
+  Wall node silently lose mass).
+
+A model may carry ``declared_weights`` (mapping group name -> weight
+array, in storage order) — e.g. a test fixture or a model with
+non-standard weights; those are checked instead of the shell table.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+from tclb_tpu.analysis.findings import Finding
+from tclb_tpu.core.registry import Model
+
+_TOL = 1e-12
+
+
+def _velocity_groups(model: Model):
+    """Groups that look like streamed velocity sets: >= 2 members, all
+    densities, at least one nonzero streaming vector."""
+    n_dens = len(model.densities)
+    out = {}
+    for g, idx in model.groups.items():
+        if len(idx) < 2 or any(i >= n_dens for i in idx):
+            continue
+        E = model.ei[list(idx), :model.ndim]
+        if not np.any(E):
+            continue
+        out[g] = E
+    return out
+
+
+def check_invariants(model: Model, shape=None) -> list:
+    findings: list = []
+    vgroups = _velocity_groups(model)
+    if not vgroups:
+        findings.append(Finding(
+            "invariants.no_velocity_set", "info", model.name,
+            "no streamed velocity-set group to check"))
+        return findings
+
+    declared = getattr(model, "declared_weights", None) or {}
+
+    for g, E in vgroups.items():
+        q, d = E.shape
+        where = f"group:{g}"
+
+        # -- set symmetry (weights not needed) -------------------------- #
+        net = E.sum(axis=0)
+        if np.any(net != 0):
+            findings.append(Finding(
+                "invariants.net_velocity", "error", model.name,
+                f"velocity set {g!r} does not sum to zero: "
+                f"sum(e) = {net.tolist()}", where,
+                {"sum_e": net.tolist()}))
+        vset = {tuple(int(v) for v in e) for e in E}
+        unpaired = sorted(e for e in vset
+                          if tuple(-v for v in e) not in vset)
+        if unpaired:
+            findings.append(Finding(
+                "invariants.opposite_pairing", "error", model.name,
+                f"velocity set {g!r} has vectors without an opposite "
+                f"(bounce-back would lose mass): {unpaired}", where,
+                {"unpaired": [list(e) for e in unpaired]}))
+        if len(vset) != q:
+            findings.append(Finding(
+                "invariants.duplicate_vector", "error", model.name,
+                f"velocity set {g!r} has duplicate streaming vectors",
+                where))
+
+        # -- weights ---------------------------------------------------- #
+        if g in declared:
+            w = np.asarray(declared[g], dtype=np.float64)
+            src = "declared"
+        else:
+            try:
+                from tclb_tpu.ops import lbm
+                w = np.asarray(lbm.weights(E), dtype=np.float64)
+                src = "shell-table"
+            except Exception:
+                findings.append(Finding(
+                    "invariants.no_weight_table", "info", model.name,
+                    f"velocity set {g!r} (q={q}, d={d}) has no standard "
+                    "weight table; weight-moment checks skipped", where))
+                continue
+        if w.shape != (q,):
+            findings.append(Finding(
+                "invariants.weight_shape", "error", model.name,
+                f"{src} weights for {g!r} have shape {w.shape}, "
+                f"expected ({q},)", where))
+            continue
+        if np.any(w <= 0):
+            findings.append(Finding(
+                "invariants.weight_sign", "error", model.name,
+                f"{src} weights for {g!r} are not all positive", where,
+                {"weights": w.tolist()}))
+        wsum = float(w.sum())
+        if abs(wsum - 1.0) > 1e-9:
+            findings.append(Finding(
+                "invariants.weight_sum", "error", model.name,
+                f"{src} weights for {g!r} sum to {wsum!r}, expected 1",
+                where, {"sum": wsum}))
+        m1 = w @ E
+        if np.max(np.abs(m1)) > 1e-9:
+            findings.append(Finding(
+                "invariants.first_moment", "error", model.name,
+                f"first moment of {g!r} does not vanish: "
+                f"sum(w e) = {m1.tolist()}", where,
+                {"first_moment": m1.tolist()}))
+        # second moment: T_ab = sum_i w_i e_ia e_ib = cs^2 delta_ab
+        T = np.einsum("i,ia,ib->ab", w, E, E)
+        off = T - np.diag(np.diag(T))
+        diag = np.diag(T)
+        if np.max(np.abs(off)) > 1e-9:
+            findings.append(Finding(
+                "invariants.second_moment_cross", "error", model.name,
+                f"second moment of {g!r} has nonzero cross terms", where,
+                {"T": T.tolist()}))
+        if np.max(np.abs(diag - diag[0])) > 1e-9:
+            findings.append(Finding(
+                "invariants.second_moment_anisotropy", "error", model.name,
+                f"second moment of {g!r} is anisotropic: "
+                f"diag = {diag.tolist()}", where, {"T": T.tolist()}))
+        else:
+            findings.append(Finding(
+                "invariants.sound_speed", "info", model.name,
+                f"velocity set {g!r}: q={q} d={d} cs^2={diag[0]:.6g} "
+                f"({src} weights)", where,
+                {"cs2": float(diag[0]), "q": q, "d": d}))
+    return findings

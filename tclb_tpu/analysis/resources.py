@@ -1,0 +1,157 @@
+"""Kernel-resource estimation: per-engine VMEM/tile budgets, statically.
+
+Mirrors the sizing arithmetic each engine applies at build time
+(``_band_rows``/``_pad_rows``/``_slab_depth_gen``/the backward kernel's
+``by_bwd`` heuristic) and evaluates it at PRODUCTION shapes — including
+the ``k = max_chunk`` fused-chain widths that ``supports_diff``'s cheap
+k=1 probe historically never exercised.  That turns "auto fell back
+because the first TPU compile died" into a finding the analyzer (and the
+eligibility caches) can report before anything compiles.
+
+``adjoint_static_ok`` is the verdict ``supports_diff`` consults: the
+backward band kernel's three double-buffered scratch stacks at the
+minimum band height, against its VMEM ceiling.
+"""
+
+from __future__ import annotations
+
+from tclb_tpu.analysis.findings import Finding
+from tclb_tpu.core.registry import Model
+
+# the backward band kernel raises the compiler's VMEM ceiling to 100 MB
+# (ops/pallas_adjoint); its scratch must leave room for the VJP chain's
+# live temporaries, so the static gate draws the line well below that.
+_ADJ_SCRATCH_LIMIT = 64 * 1024 * 1024
+
+
+def default_shape(model: Model) -> tuple:
+    """Representative production shape (the bench cases' scale)."""
+    return (512, 1024) if model.ndim == 2 else (48, 48, 256)
+
+
+def _adjoint_scratch_bytes(model: Model, nx: int, by: int,
+                           series: bool) -> int:
+    """Bytes of the backward kernel's double-buffered primal + lambda +
+    aux band stacks at band height ``by`` (mirrors make_diff_step)."""
+    halo = 8
+    n_aux = 1 + (2 if series else 1) * len(model.zonal_settings)
+    per_row = (2 * model.n_storage + n_aux) * nx * 4
+    return 2 * (by + 2 * halo) * per_row
+
+
+def adjoint_static_ok(model: Model, nx: int, series: bool = False) -> bool:
+    """Whether the backward band kernel can possibly fit VMEM at this
+    width: even the minimum 8-row band must stay under the scratch
+    limit.  Consulted by ``supports_diff`` so ineligibility is decided
+    statically instead of by a compile failure."""
+    return _adjoint_scratch_bytes(model, nx, 8, series) \
+        <= _ADJ_SCRATCH_LIMIT
+
+
+def check_resources(model: Model, shape=None) -> list:
+    findings: list = []
+    from tclb_tpu.ops import pallas_generic
+
+    shape = tuple(int(s) for s in (shape or default_shape(model)))
+    if len(shape) != model.ndim:
+        findings.append(Finding(
+            "resources.bad_shape", "warning", model.name,
+            f"shape {shape} does not match model ndim={model.ndim}; "
+            "resource checks skipped"))
+        return findings
+    try:
+        _, reach = pallas_generic.action_plan(model, "Iteration", fuse=1)
+    except Exception:  # noqa: BLE001 — no Iteration action / broken plan
+        return findings
+    where = f"shape:{'x'.join(str(s) for s in shape)}"
+
+    if model.ndim == 2:
+        ny, nx = shape
+        # -- forward band engine ---------------------------------------- #
+        pad = pallas_generic._pad_rows(model, ny, nx, max(reach, 1))
+        if pad is None:
+            findings.append(Finding(
+                "resources.band_vmem", "warning", model.name,
+                f"no band height fits the "
+                f"{pallas_generic._VMEM_SCRATCH_BUDGET >> 20} MB scratch "
+                f"budget at {ny}x{nx} ({model.n_storage} storage planes): "
+                "generic band engine ineligible, XLA fallback", where,
+                {"n_storage": model.n_storage, "shape": list(shape)}))
+        else:
+            by = pallas_generic._band_rows(model, ny + pad, nx)
+            n_aux = 1 + 2 * len(model.zonal_settings)
+            est = 2 * (by + 16) * (model.n_storage + n_aux) * nx * 4
+            findings.append(Finding(
+                "resources.band_layout", "info", model.name,
+                f"band engine: by={by} pad={pad} scratch~{est >> 10} KiB",
+                where, {"by": by, "pad": pad, "scratch_bytes": est}))
+        # -- resident engine -------------------------------------------- #
+        n_aux_r = 1 + len(model.zonal_settings)
+        res_bytes = (2 * model.n_storage + n_aux_r) * ny * nx * 4
+        res_ok = (ny % 8 == 0 and nx % 128 == 0
+                  and res_bytes <= pallas_generic._RESIDENT_BUDGET
+                  and reach <= pallas_generic.HALO)
+        findings.append(Finding(
+            "resources.resident", "info", model.name,
+            f"VMEM-resident engine {'eligible' if res_ok else 'ineligible'}"
+            f" at {ny}x{nx} (state+aux {res_bytes >> 20} MiB / "
+            f"{pallas_generic._RESIDENT_BUDGET >> 20} MiB budget)", where,
+            {"eligible": res_ok, "resident_bytes": res_bytes}))
+        # -- adjoint backward kernel at the production chunk ------------ #
+        from tclb_tpu.ops import pallas_adjoint
+        k = pallas_adjoint.max_chunk(model)
+        if k >= 1:
+            for series in (False, True):
+                if series and not model.zonal_settings:
+                    continue
+                if not adjoint_static_ok(model, nx, series):
+                    findings.append(Finding(
+                        "resources.adjoint_vmem", "warning", model.name,
+                        f"backward band kernel cannot fit VMEM at width "
+                        f"nx={nx}"
+                        + (" (series flavor)" if series else "")
+                        + f": minimum-band scratch "
+                        f"{_adjoint_scratch_bytes(model, nx, 8, series) >> 20}"
+                        f" MiB > {_ADJ_SCRATCH_LIMIT >> 20} MiB — "
+                        "engine='auto' adjoint falls back to XLA "
+                        "statically", where,
+                        {"series": series, "nx": nx,
+                         "scratch_bytes":
+                             _adjoint_scratch_bytes(model, nx, 8, series)}))
+                else:
+                    # the default by_bwd the builder would pick at k
+                    n_aux = 1 + (2 if series else 1) \
+                        * len(model.zonal_settings)
+                    per_row = (2 * model.n_storage + n_aux) * nx * 4
+                    by = 64
+                    while by > 8 and 2 * (by + 16) * per_row \
+                            > 24 * 1024 * 1024:
+                        by -= 8
+                    findings.append(Finding(
+                        "resources.adjoint_layout", "info", model.name,
+                        f"adjoint kernel at production chunk k={k}"
+                        + (" (series: k=1)" if series else "")
+                        + f": by_bwd={by} scratch~"
+                        f"{2 * (by + 16) * per_row >> 20} MiB", where,
+                        {"k": 1 if series else k, "by_bwd": by,
+                         "series": series}))
+    else:
+        nz, ny, nx = shape
+        bz = pallas_generic._slab_depth_gen(model, nz, ny, nx,
+                                            max(reach, 1))
+        if bz is None:
+            findings.append(Finding(
+                "resources.slab_vmem", "warning", model.name,
+                f"no z-slab depth fits the 12 MB scratch budget at "
+                f"{nz}x{ny}x{nx} ({model.n_storage} storage planes): "
+                "generic 3D engine ineligible, XLA fallback", where,
+                {"n_storage": model.n_storage, "shape": list(shape)}))
+        else:
+            n_aux = 1 + 2 * len(model.zonal_settings)
+            est = 2 * (bz + 2 * max(reach, 1)) \
+                * (model.n_storage + n_aux) * ny * nx * 4
+            findings.append(Finding(
+                "resources.slab_layout", "info", model.name,
+                f"3D slab engine: bz={bz} scratch~{est >> 20} MiB",
+                where, {"bz": bz, "scratch_bytes": est}))
+    return findings

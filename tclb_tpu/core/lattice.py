@@ -766,6 +766,28 @@ class Lattice:
                 self.model, self.shape, self.dtype, present=present),
                 f"pallas_d3q[{self.model.name}]")
         from tclb_tpu.ops import pallas_generic
+        # the static analyzer's kernel-safety verdict gates EVERY
+        # registry-driven kernel: a stage reading beyond its declared
+        # stencil would make the band windows silently wrong (the XLA
+        # path wraps exactly, so it stays the safe fallback)
+        from tclb_tpu import analysis
+        if not analysis.kernel_safety_ok(self.model):
+            return None, None
+        if (not has_series
+                and pallas_generic.supports_resident(self.model, self.shape,
+                                                     self.dtype)
+                and pallas_generic.mosaic_ok(self.model, self.shape)):
+            # generic counterpart of the tuned d2q9 resident engine
+            # (checked above): whole lattice VMEM-resident, 8 steps per
+            # kernel call, for ANY registry model that fits the budget.
+            # First call is probed; on failure the generic BAND engine
+            # is the fallback (see iterate()'s was_resident branch)
+            from tclb_tpu.ops.lbm import present_types
+            present = present_types(self.model, self._flags_host())
+            self._fast_probing = True
+            return (pallas_generic.make_resident_iterate(
+                self.model, self.shape, self.dtype, present=present),
+                f"pallas_resident_generic[{self.model.name},fuse=8]")
         if (pallas_generic.supports(self.model, self.shape, self.dtype)
                 and pallas_generic.mosaic_ok(self.model, self.shape)):
             from tclb_tpu.ops.lbm import present_types
@@ -845,6 +867,8 @@ class Lattice:
 
                 was_resident = (self._fast_name or "").startswith(
                     "pallas_resident")
+                was_generic_res = (self._fast_name or "").startswith(
+                    "pallas_resident_generic")
                 try:
                     self.state = attempt(fast)
                 except Exception as e:  # noqa: BLE001
@@ -852,19 +876,39 @@ class Lattice:
                         # resident probe failed (its budget can't see
                         # Mosaic temporaries): the band engine is the
                         # proven fallback for these models — swap it in
-                        # and continue this very call
-                        from tclb_tpu.ops import pallas_d2q9
+                        # and continue this very call.  Each resident
+                        # flavor falls back to ITS band family: the
+                        # tuned d2q9 resident to the tuned d2q9 band,
+                        # the generic resident to the generic band.
                         log.info(f"engine: {self._fast_name} failed to "
                                  f"compile ({type(e).__name__}); band "
                                  "engine fallback")
-                        present = pallas_d2q9.present_types(
-                            self.model, self._flags_host())
-                        self._fast = fast = \
-                            pallas_d2q9.make_pallas_iterate(
-                                self.model, self.shape, self.dtype,
-                                fuse=2, present=present)
-                        self._fast_name = (f"pallas_2d"
-                                           f"[{self.model.name},fuse=2]")
+                        if was_generic_res:
+                            from tclb_tpu.ops.lbm import present_types
+                            present = present_types(self.model,
+                                                    self._flags_host())
+                            fz = 2 if pallas_generic.action_plan(
+                                self.model, fuse=2)[1] \
+                                <= pallas_generic.HALO else 1
+                            self._fast = fast = \
+                                pallas_generic.make_pallas_iterate(
+                                    self.model, self.shape, self.dtype,
+                                    fuse=fz, present=present)
+                            self._fast_cfg = (fz, None)
+                            self._fast_name = (
+                                f"pallas_generic"
+                                f"[{self.model.name},fuse={fz}]")
+                        else:
+                            from tclb_tpu.ops import pallas_d2q9
+                            present = pallas_d2q9.present_types(
+                                self.model, self._flags_host())
+                            self._fast = fast = \
+                                pallas_d2q9.make_pallas_iterate(
+                                    self.model, self.shape, self.dtype,
+                                    fuse=2, present=present)
+                            self._fast_name = (f"pallas_2d"
+                                               f"[{self.model.name},"
+                                               f"fuse=2]")
                         self._fast_probing = False
                         self.state = fast(self.state, self.params, nfast)
                         if not full:

@@ -354,6 +354,41 @@ class Model:
             for lo, hi in (f.dx_range, f.dy_range, f.dz_range):
                 self.max_stencil = max(self.max_stencil, abs(lo), abs(hi))
 
+    # -- structural identity ------------------------------------------------ #
+
+    def structural_key(self) -> tuple:
+        """A hashable tuple of everything the kernel engines specialize on:
+        storage layout, streaming vectors, declared stencils, settings
+        (zonal-ness + derived targets), globals, node-type packing and the
+        stage/action plan.  Two independently built instances of the same
+        model compare equal, so caches keyed on this survive model rebuilds
+        — unlike ``id(model)`` keys, which both alias recycled addresses
+        and miss rebuilt-but-identical models."""
+        return (
+            self.name, self.ndim,
+            tuple((x.name, x.dx, x.dy, x.dz, x.group, x.average,
+                   x.parameter) for x in self.densities),
+            tuple((x.name, x.dx_range, x.dy_range, x.dz_range, x.group,
+                   x.average, x.parameter) for x in self.fields),
+            tuple((s.name, s.default, s.zonal,
+                   tuple(t for t, _ in s.derived)) for s in self.settings),
+            tuple((g.name, g.op) for g in self.globals_),
+            tuple((t.name, t.group, t.value, t.mask, t.shift)
+                  for t in self.node_types.values()),
+            tuple((s.name, s.main, s.load_densities, s.save_fields,
+                   s.fixed_point) for s in self.stages.values()),
+            tuple((a, tuple(st)) for a, st in sorted(self.actions.items())),
+        )
+
+    @property
+    def fingerprint(self) -> str:
+        """Short stable hex digest of :meth:`structural_key`."""
+        if getattr(self, "_fingerprint", None) is None:
+            import hashlib
+            raw = repr(self.structural_key()).encode()
+            self._fingerprint = hashlib.sha1(raw).hexdigest()[:16]
+        return self._fingerprint
+
     # -- binding physics ---------------------------------------------------- #
 
     def bind(self, run: Callable = None, init: Callable = None,

@@ -44,7 +44,8 @@ from jax.experimental.pallas import tpu as pltpu
 from tclb_tpu.core.lattice import LatticeState, SimParams
 from tclb_tpu.core.registry import Model
 from tclb_tpu.ops import pallas_generic
-from tclb_tpu.ops.pallas_generic import (_HALO, action_plan, run_action_plan)
+from tclb_tpu.ops.pallas_generic import (_CompilerParams, _HALO, action_plan,
+                                         run_action_plan)
 
 _probe_cache: dict = {}
 
@@ -84,11 +85,27 @@ def supports_diff(model: Model, shape, dtype, series: bool = False) -> bool:
         return False   # the (8, 128) in-kernel settings-tape accumulator
     if series and not model.zonal_settings:
         return False
-    key = (id(model), model.name, nx, series)
+    # static gates from the analyzer: the backward kernel's scratch at
+    # this width (ineligibility decided before any compile), and the
+    # stencil-footprint safety verdict (a stage reading beyond its
+    # declaration would make the band chain silently wrong)
+    from tclb_tpu import analysis
+    from tclb_tpu.analysis import resources
+    if not resources.adjoint_static_ok(model, nx, series):
+        return False
+    if not analysis.kernel_safety_ok(model):
+        return False
+    # cache on the structural fingerprint, not id(model): rebuilt-but-
+    # identical models share the verdict, and a recycled address can
+    # never inherit a stale one.  Probe at the PRODUCTION chunk
+    # k=max_chunk — the fused-chain trace the engine actually builds
+    # (the historical k=1 probe validated a chain nobody runs).
+    key = (model.fingerprint, nx, series)
     if key not in _probe_cache:
         try:
             step = make_diff_step(model, (16, nx), dtype, interpret=True,
-                                  series=series, k=1)
+                                  series=series,
+                                  k=1 if series else max_chunk(model))
             n_aux = 1 + (2 if series else 1) * len(model.zonal_settings)
             fields = jax.ShapeDtypeStruct((model.n_storage, 16, nx), dtype)
             sett = jax.ShapeDtypeStruct((len(model.settings),), dtype)
@@ -354,7 +371,7 @@ def make_diff_step(model: Model, shape, dtype=jnp.float32,
             pltpu.VMEM((2, n_aux, by + 2 * _HALO, nx), dtype),
             pltpu.SemaphoreType.DMA((2, 9)),
         ],
-        compiler_params=pltpu.CompilerParams(
+        compiler_params=_CompilerParams(
             vmem_limit_bytes=100 * 1024 * 1024),
         interpret=interpret,
     )

@@ -51,6 +51,10 @@ from tclb_tpu.core.lattice import (LatticeState, NodeCtx, SimParams,
 from tclb_tpu.core.registry import Model
 from tclb_tpu.ops.lbm import present_types  # noqa: F401  (re-export)
 
+# jax < 0.5 names the Pallas TPU params dataclass TPUCompilerParams
+_CompilerParams = getattr(pltpu, "CompilerParams", None) \
+    or getattr(pltpu, "TPUCompilerParams")
+
 _VMEM_SCRATCH_BUDGET = 4 * 1024 * 1024
 _HALO = 8   # DMA halo block height: one (8, 128) f32 tile per side
 HALO = _HALO  # public: max per-action reach a caller can plan against
@@ -730,7 +734,7 @@ def make_pallas_iterate(model: Model, shape, dtype=jnp.float32,
                 pltpu.VMEM((2, n_aux_k, by + 2 * _HALO, nx), dtype),
                 pltpu.SemaphoreType.DMA((2, 6)),
             ],
-            compiler_params=pltpu.CompilerParams(
+            compiler_params=_CompilerParams(
                 vmem_limit_bytes=vmem_mb * 1024 * 1024)
             if vmem_mb else None,
             interpret=interpret,
@@ -987,11 +991,11 @@ def make_resident_iterate(model: Model, shape, dtype=jnp.float32,
         def _():
             one_step(f_ref, buf)
 
-        @pl.when(jnp.logical_and(t > 0, jax.lax.rem(t, 2) == 1))
+        @pl.when(jnp.logical_and(t > 0, jax.lax.rem(t, jnp.int32(2)) == 1))
         def _():
             one_step(buf, out_ref)
 
-        @pl.when(jnp.logical_and(t > 0, jax.lax.rem(t, 2) == 0))
+        @pl.when(jnp.logical_and(t > 0, jax.lax.rem(t, jnp.int32(2)) == 0))
         def _():
             one_step(out_ref, buf)
 
@@ -1009,7 +1013,7 @@ def make_resident_iterate(model: Model, shape, dtype=jnp.float32,
             out_specs=pl.BlockSpec(memory_space=pltpu.VMEM),
             out_shape=jax.ShapeDtypeStruct((ns, ny, nx), dtype),
             scratch_shapes=[pltpu.VMEM((ns, ny, nx), dtype)],
-            compiler_params=pltpu.CompilerParams(
+            compiler_params=_CompilerParams(
                 vmem_limit_bytes=120 * 1024 * 1024),
             interpret=interpret,
         )
@@ -1339,7 +1343,7 @@ def make_pallas_iterate_3d(model: Model, shape, dtype=jnp.float32,
                 pltpu.VMEM((2, n_aux_k, bz + 2 * R, ny, nx), dtype),
                 pltpu.SemaphoreType.DMA((2, 2 * (1 + 2 * R))),
             ],
-            compiler_params=pltpu.CompilerParams(
+            compiler_params=_CompilerParams(
                 vmem_limit_bytes=100 * 1024 * 1024)
             if vmem_ceiling else None,
             interpret=interpret,

@@ -330,3 +330,27 @@ def test_reparam_roundtrip():
     _, p2 = rc.put(th, lat.state, lat.params)
     series = np.asarray(p2.time_series[0])
     np.testing.assert_allclose(series, np.tile(np.arange(8.0), 4))
+
+
+def test_steady_gradient_series_runtime_fallback():
+    """A Control series showing up in params at CALL time (registered for
+    an unrelated purpose) with a non-series engine step must fall back to
+    the XLA step for that call instead of failing at trace time
+    (make_steady_gradient historically dropped has_series on the floor
+    and raised ValueError from deep inside the engine)."""
+    m, lat = _setup(ny=16, nx=128)
+    lat.iterate(30)
+    design = InternalTopology(m)
+    # engine auto + eligible shape: the step is the fused Pallas chunk
+    grad_fn = make_steady_gradient(m, design, n_adjoint=4,
+                                   shape=(16, 128), dtype=jnp.float32)
+    theta0 = design.get(lat.state, lat.params)
+    obj, g = grad_fn(theta0, lat.state, lat.params)
+    assert np.isfinite(float(obj))
+
+    # attach a series and call the SAME grad_fn — no ValueError, finite
+    lat.set_setting_series("Velocity", np.full((4,), 0.05), zone=0)
+    obj2, g2 = grad_fn(theta0, lat.state, lat.params)
+    assert np.isfinite(float(obj2))
+    assert all(np.isfinite(np.asarray(x)).all()
+               for x in jax.tree_util.tree_leaves(g2))

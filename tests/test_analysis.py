@@ -1,0 +1,246 @@
+"""Static analyzer tests.
+
+Two halves, mirroring how the reference validates models at codegen time:
+
+* the REAL registry must be clean — every registered model analyzed with
+  zero error-severity findings (the CI gate `python -m tclb_tpu.analysis
+  --all` asserts the same), and the repo-level hygiene checks must stay
+  empty now that the generic resident engine is wired and the
+  eligibility caches key on structural fingerprints;
+* each checker must actually FIRE — deliberately-broken fixture models
+  (wrong weight sum, unpaired velocity set, stencil wider than the halo,
+  a stage reading beyond its declaration, a VMEM-overflowing plane
+  count) seed exactly the defects the checks exist for.
+"""
+
+import json
+
+import numpy as np
+import pytest
+
+from tclb_tpu import analysis
+from tclb_tpu.analysis import cli, hygiene
+from tclb_tpu.analysis.findings import Finding, sort_findings, worst_severity
+from tclb_tpu.core.registry import ModelDef
+from tclb_tpu.models import get_model, list_models
+
+ALL_MODELS = list_models()
+
+
+def _error_checks(findings):
+    return {f.check for f in findings if f.severity == "error"}
+
+
+# --------------------------------------------------------------------------- #
+# The real registry is clean
+# --------------------------------------------------------------------------- #
+
+
+@pytest.mark.parametrize("name", ALL_MODELS)
+def test_registered_model_has_no_error_findings(name):
+    findings = analysis.analyze_model(name)
+    errs = [f for f in findings if f.severity == "error"]
+    assert not errs, [f.message for f in errs]
+
+
+def test_repo_hygiene_clean():
+    """No dead engine entry points, no id()-keyed caches — the round-5
+    defects this PR fixed must stay fixed."""
+    findings = analysis.analyze_repo()
+    errs = [f for f in findings if f.severity == "error"]
+    assert not errs, [f.message for f in errs]
+
+
+def test_kernel_safety_ok_for_generic_engine_models():
+    m = get_model("d2q9_heat")
+    assert analysis.kernel_safety_ok(m)
+    # cached on the structural fingerprint: a rebuilt identical model
+    # shares the verdict without re-tracing
+    assert m.fingerprint in analysis._safety_cache
+
+
+# --------------------------------------------------------------------------- #
+# CLI
+# --------------------------------------------------------------------------- #
+
+
+def test_cli_json_schema(capsys):
+    rc = cli.main(["d2q9", "--format", "json", "--shape", "64,128"])
+    doc = json.loads(capsys.readouterr().out)
+    assert rc == 0
+    assert set(doc) == {"models", "repo", "summary"}
+    assert set(doc["models"]) == {"d2q9"}
+    assert doc["repo"] == []
+    for f in doc["models"]["d2q9"]:
+        assert set(f) == {"check", "severity", "model", "message",
+                          "where", "details"}
+        assert f["severity"] in ("error", "warning", "info")
+        assert f["model"] == "d2q9"
+    s = doc["summary"]
+    assert s["models"] == 1
+    assert s["errors"] == 0
+    assert s["errors"] + s["warnings"] + s["info"] \
+        >= len(doc["models"]["d2q9"])
+
+
+def test_cli_usage_errors(capsys):
+    assert cli.main([]) == 2                     # no models, no --all
+    assert cli.main(["definitely_not_a_model"]) == 2
+    capsys.readouterr()
+
+
+def test_cli_min_severity_filters_output(capsys):
+    rc = cli.main(["d2q9", "--format", "json", "--min-severity", "error"])
+    doc = json.loads(capsys.readouterr().out)
+    assert rc == 0
+    assert doc["models"]["d2q9"] == []           # clean model: all hidden
+    assert doc["summary"]["info"] > 0            # ...but still counted
+
+
+# --------------------------------------------------------------------------- #
+# Broken fixtures: every checker fires
+# --------------------------------------------------------------------------- #
+
+
+def _passthrough(groups):
+    def run(ctx):
+        return ctx.store({g: ctx.group(g) for g in groups})
+    return run
+
+
+def test_invariants_fire_on_wrong_weight_sum():
+    d = ModelDef("fx_badweights", ndim=2)
+    d.add_densities("f", [(0, 0), (1, 0), (-1, 0), (0, 1), (0, -1)],
+                    group="f")
+    run = _passthrough(["f"])
+    m = d.finalize().bind(run=run, init=run)
+    m.declared_weights = {"f": np.array([0.4, 0.2, 0.2, 0.2, 0.2])}
+    from tclb_tpu.analysis.invariants import check_invariants
+    assert "invariants.weight_sum" in _error_checks(check_invariants(m))
+    # ...and through the library API as well
+    assert "invariants.weight_sum" in _error_checks(analysis.analyze_model(m))
+
+
+def test_invariants_fire_on_unpaired_velocity_set():
+    d = ModelDef("fx_unpaired", ndim=2)
+    d.add_densities("f", [(1, 0), (0, 1)], group="f")
+    run = _passthrough(["f"])
+    m = d.finalize().bind(run=run, init=run)
+    from tclb_tpu.analysis.invariants import check_invariants
+    errs = _error_checks(check_invariants(m))
+    assert "invariants.net_velocity" in errs
+    assert "invariants.opposite_pairing" in errs
+
+
+def test_footprint_fires_on_stencil_wider_than_halo():
+    d = ModelDef("fx_widestencil", ndim=2)
+    d.add_density("g", group="g")
+    d.add_field("phi", dy=(-12, 12))
+
+    def run(ctx):
+        wide = ctx.load("phi", dy=12) + ctx.load("phi", dy=-12)
+        return ctx.store({"g": ctx.group("g"), "phi": wide[None]})
+    m = d.finalize().bind(run=run, init=_passthrough(["g", "phi"]))
+    from tclb_tpu.analysis.footprint import check_footprint
+    checks = {f.check for f in check_footprint(m)}
+    assert "footprint.halo" in checks            # band engines ineligible
+    assert "footprint.adjoint_band" in checks    # 2R > halo
+    # declared reads are NOT errors: declaration covers the deep stencil
+    assert "footprint.undeclared_read" not in _error_checks(
+        check_footprint(m))
+
+
+def test_footprint_fires_on_undeclared_read():
+    d = ModelDef("fx_undeclared", ndim=2)
+    d.add_density("g", group="g")
+    d.add_field("T", dy=0)                       # declared dy range [0, 0]
+
+    def run(ctx):
+        sneaky = ctx.load("T", dy=1)             # ...but reads dy=1
+        return ctx.store({"g": ctx.group("g"), "T": sneaky[None]})
+    m = d.finalize().bind(run=run, init=_passthrough(["g", "T"]))
+    from tclb_tpu.analysis.footprint import (check_footprint,
+                                             kernel_safety_errors)
+    assert "footprint.undeclared_read" in _error_checks(check_footprint(m))
+    assert kernel_safety_errors(m)
+    # the engine dispatch consults exactly this verdict: the band kernels
+    # would size their windows from the declaration and read stale rows
+    assert not analysis.kernel_safety_ok(m)
+
+
+def test_resources_fire_on_vmem_overflow():
+    d = ModelDef("fx_vmem", ndim=2)
+    for i in range(120):
+        d.add_density(f"a[{i}]", group="a")
+    run = _passthrough(["a"])
+    m = d.finalize().bind(run=run, init=run)
+    from tclb_tpu.analysis.resources import check_resources
+    checks = {f.check for f in check_resources(m, shape=(512, 8192))}
+    assert "resources.band_vmem" in checks       # no band height fits
+    assert "resources.adjoint_vmem" in checks    # backward scratch > limit
+    # overflow is a capability limit (XLA fallback), not broken physics
+    assert not _error_checks(check_resources(m, shape=(512, 8192)))
+
+
+def test_hygiene_fires_on_id_keyed_cache(tmp_path):
+    p = tmp_path / "engine.py"
+    p.write_text("CACHE = {}\n"
+                 "def supports_x(model):\n"
+                 "    CACHE[id(model)] = True\n"
+                 "    return True\n")
+    fs = hygiene.scan_id_keyed_caches(paths=[str(p)])
+    assert [f.check for f in fs] == ["hygiene.id_keyed_cache"]
+    assert fs[0].severity == "error"
+
+
+def test_hygiene_fires_on_dead_entry_point(tmp_path):
+    eng = tmp_path / "ops"
+    eng.mkdir()
+    (eng / "fake_engine.py").write_text(
+        "def supports_foo(model):\n"
+        "    return True\n"
+        "def make_foo_iterate(model):\n"
+        "    assert supports_foo(model)\n"
+        "    return model\n"
+        "def make_bar_iterate(model):\n"
+        "    return model\n")
+    user = tmp_path / "user.py"
+    user.write_text("from ops import fake_engine\n"
+                    "fake_engine.make_bar_iterate(None)\n")
+    fs = hygiene.scan_dead_entry_points(engine_dir=str(eng),
+                                        sources=[str(user)])
+    dead = {f.message.split(" ")[0] for f in fs}
+    # the dead builder's internal call must NOT keep its dead eligibility
+    # check alive (liveness fixpoint) — both die; the referenced one lives
+    assert dead == {"ops.fake_engine.supports_foo",
+                    "ops.fake_engine.make_foo_iterate"}
+
+
+# --------------------------------------------------------------------------- #
+# Finding mechanics / fingerprints
+# --------------------------------------------------------------------------- #
+
+
+def test_finding_sorting_and_severity():
+    fs = [Finding("c.z", "info", "m", "zz"),
+          Finding("a.x", "error", "m", "xx"),
+          Finding("b.y", "warning", "m", "yy")]
+    assert [f.severity for f in sort_findings(fs)] \
+        == ["error", "warning", "info"]
+    assert worst_severity(fs) == "error"
+    assert worst_severity([]) is None
+    with pytest.raises(ValueError):
+        Finding("a", "fatal", "m", "bad severity")
+    d = fs[1].to_dict()
+    assert d["check"] == "a.x" and d["severity"] == "error"
+
+
+def test_fingerprint_stable_across_rebuilds():
+    """Structural fingerprints survive rebuilds (the supports_diff cache
+    keys on them — id() would miss rebuilt models and alias recycled
+    addresses)."""
+    import tclb_tpu.models.wave2d as wave2d
+    a, b = wave2d.build(), wave2d.build()
+    assert a is not b
+    assert a.fingerprint == b.fingerprint
+    assert a.fingerprint != get_model("d2q9").fingerprint

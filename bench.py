@@ -23,13 +23,14 @@ import time
 
 import numpy as np
 
-# known per-chip HBM bandwidths (GB/s); unknown kinds fall back to an
-# ESTIMATE and skip the credibility asserts (round-2 VERDICT Weak #5: a
-# wrong fallback must not make the assert fire or silently pass on new
-# hardware)
-HBM_GBS = {"TPU v5 lite": 819.0, "TPU v5e": 819.0,
-           "TPU v5p": 2765.0, "TPU v4": 1228.0,
-           "TPU v6 lite": 1640.0, "TPU v6e": 1640.0}
+from tclb_tpu import telemetry
+
+# known per-chip HBM bandwidths (GB/s) — shared with the telemetry spans
+# layer so a trace's vs_roofline and this file's credibility asserts can
+# never drift; unknown kinds fall back to an ESTIMATE and skip the
+# asserts (round-2 VERDICT Weak #5: a wrong fallback must not make the
+# assert fire or silently pass on new hardware)
+from tclb_tpu.telemetry.spans import HBM_GBS  # noqa: F401 (re-export)
 
 
 def timed(nodes, iterate_fn, state, params, niter):
@@ -379,10 +380,18 @@ def bench_d3q27(results):
 def main():
     import jax
 
+    # each bench section runs under a telemetry span (active only when
+    # TCLB_TELEMETRY is set), so every BENCH row carries a trace whose
+    # iterate spans attribute the row to an engine and roofline fraction
     results = {}
-    shape2d, bytes_d2q9, checks2d = bench_d2q9(results)
-    checks3d = bench_d3q27(results) + bench_baseline_cases(results) \
-        + bench_adjoint(results)
+    with telemetry.span("bench.d2q9"):
+        shape2d, bytes_d2q9, checks2d = bench_d2q9(results)
+    with telemetry.span("bench.d3q27"):
+        checks3d = bench_d3q27(results)
+    with telemetry.span("bench.baseline_cases"):
+        checks3d += bench_baseline_cases(results)
+    with telemetry.span("bench.adjoint"):
+        checks3d += bench_adjoint(results)
 
     dev = jax.devices()[0]
     hbm = HBM_GBS.get(dev.device_kind)

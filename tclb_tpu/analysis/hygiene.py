@@ -206,9 +206,85 @@ def scan_dead_entry_points(engine_dir=None, sources=None) -> list:
     return findings
 
 
+def _calls_named(node, name: str) -> bool:
+    """True if any call under ``node`` targets ``name`` — bare
+    (``engine_selected(...)``) or qualified (``telemetry.engine_selected``)."""
+    for n in ast.walk(node):
+        if not isinstance(n, ast.Call):
+            continue
+        f = n.func
+        if isinstance(f, ast.Name) and f.id == name:
+            return True
+        if isinstance(f, ast.Attribute) and f.attr == name:
+            return True
+    return False
+
+
+def _assigns_fast_name(node) -> bool:
+    """True if any statement under ``node`` assigns ``self._fast_name``."""
+    for n in ast.walk(node):
+        if isinstance(n, (ast.Assign, ast.AugAssign, ast.AnnAssign)):
+            targets = n.targets if isinstance(n, ast.Assign) else [n.target]
+            for t in targets:
+                if isinstance(t, ast.Attribute) and t.attr == "_fast_name" \
+                        and isinstance(t.value, ast.Name) \
+                        and t.value.id == "self":
+                    return True
+    return False
+
+
+def scan_dispatch_telemetry(lattice_path=None) -> list:
+    """Engine dispatch must be observable: ``_fast_path`` emits
+    ``engine_selected`` and every except handler that reassigns
+    ``self._fast_name`` (i.e. demotes the engine) emits
+    ``engine_fallback``.  Without these, a production trace cannot say
+    which engine ran — the exact blind spot that made the BENCH_r05
+    heat_adj regression untriageable."""
+    path = lattice_path or os.path.join(_PKG_ROOT, "core", "lattice.py")
+    rel = os.path.relpath(path, _REPO_ROOT)
+    try:
+        with open(path) as fh:
+            tree = ast.parse(fh.read())
+    except (OSError, SyntaxError) as e:
+        return [Finding("hygiene.unparseable", "error", "",
+                        f"cannot parse {path}: {e}", path)]
+
+    findings = []
+    fast_path = None
+    for node in ast.walk(tree):
+        if isinstance(node, ast.FunctionDef) and node.name == "_fast_path":
+            fast_path = node
+            break
+    if fast_path is None:
+        findings.append(Finding(
+            "hygiene.untraced_dispatch", "error", "",
+            f"{rel} has no _fast_path — the dispatch tracing contract "
+            "expects one", rel))
+    elif not _calls_named(fast_path, "engine_selected"):
+        findings.append(Finding(
+            "hygiene.untraced_dispatch", "error", "",
+            f"{rel}:{fast_path.lineno} _fast_path never emits "
+            "engine_selected — traces cannot attribute iterate spans to "
+            "an engine", f"{rel}:{fast_path.lineno}"))
+
+    for node in ast.walk(tree):
+        if not isinstance(node, ast.ExceptHandler):
+            continue
+        if _assigns_fast_name(node) \
+                and not _calls_named(node, "engine_fallback"):
+            findings.append(Finding(
+                "hygiene.untraced_dispatch", "error", "",
+                f"{rel}:{node.lineno} except handler demotes "
+                "self._fast_name without emitting engine_fallback — "
+                "silent engine downgrades are invisible in traces",
+                f"{rel}:{node.lineno}"))
+    return findings
+
+
 def check_repo(engine_dir=None, sources=None) -> list:
     return (scan_dead_entry_points(engine_dir, sources)
-            + scan_id_keyed_caches())
+            + scan_id_keyed_caches()
+            + scan_dispatch_telemetry())
 
 
 def check_model_hygiene(model: Model, shape=None) -> list:

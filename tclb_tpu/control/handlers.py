@@ -18,6 +18,7 @@ from typing import Optional
 
 import numpy as np
 
+from tclb_tpu import telemetry
 from tclb_tpu.control.solver import ITERATION_STOP, Solver
 from tclb_tpu.utils import log
 
@@ -147,7 +148,13 @@ class acSolve(GenericAction):
             s.progress(steps)
             for h in s.hands:
                 if h.now(s.iter):
-                    r = h.do_it()
+                    # each periodic callback runs under its own span, so
+                    # a trace attributes Solve wall-time between lattice
+                    # iteration and VTK/Log/Failcheck/... output work
+                    with telemetry.span("handler",
+                                        handler=type(h).__name__,
+                                        iteration=s.iter):
+                        r = h.do_it()
                     if r == ITERATION_STOP:
                         stop = True
                     elif r not in (0, None):
@@ -501,8 +508,13 @@ class cbFailcheck(Handler):
             if "all" not in names and q.name not in names:
                 continue
             arr = np.asarray(s.lattice.get_quantity(q.name))
-            if not np.isfinite(arr).all():
-                log.warning(f"Failcheck: {q.name} has non-finite values")
+            finite = np.isfinite(arr)
+            if not finite.all():
+                n_bad = int(arr.size - finite.sum())
+                log.warning(f"Failcheck: {q.name} has {n_bad} non-finite "
+                            f"values at iteration {s.iter}")
+                telemetry.failcheck(iteration=s.iter, quantity=q.name,
+                                    n_bad=n_bad)
                 bad = True
                 break
         if bad:

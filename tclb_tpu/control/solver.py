@@ -21,6 +21,7 @@ from typing import Any, Optional
 
 import numpy as np
 
+from tclb_tpu import telemetry
 from tclb_tpu.core.lattice import Lattice
 from tclb_tpu.core.registry import Model
 from tclb_tpu.utils.geometry import Geometry
@@ -124,6 +125,9 @@ class Solver:
         log.info(f"iter {self.iter}: {mlups:8.1f} MLUPS "
                  f"({mlups * bytes_per / 1e3:6.1f} GB/s eff) "
                  f"[{self._prog_iters} it in {dt:.2f} s]")
+        telemetry.event("progress", iteration=self.iter,
+                        mlups=round(mlups, 1),
+                        gbps=round(mlups * bytes_per / 1e3, 1))
         self._prog_t0, self._prog_iters = time.time(), 0
 
     # -- config provenance (reference MainContainer dump with version/
@@ -194,9 +198,11 @@ class Solver:
     def write_log(self) -> None:
         if not self.is_main:
             return
-        if self.log is None:
-            self.log = CSVLog(self.out_path("Log", "csv", with_iter=False))
-        self.log.write(self.log_row())
+        with telemetry.span("output.log", iteration=self.iter):
+            if self.log is None:
+                self.log = CSVLog(self.out_path("Log", "csv",
+                                                with_iter=False))
+            self.log.write(self.log_row())
 
     # -- output fan-out ------------------------------------------------------ #
 
@@ -236,15 +242,16 @@ class Solver:
         if not self.is_main:
             return None
         from tclb_tpu.utils.vtk import write_pvti, write_vti
-        arrays = self.quantity_arrays(what)
-        flags = np.asarray(self.lattice.state.flags)
-        # node-type group layers (reference writes one flag layer per
-        # selected group, src/vtkLattice.cpp.Rt:33-46)
-        if what is None or "flag" in (what or set()) or not what:
-            arrays["Flag"] = flags
-        piece = write_vti(self.out_path("VTK", "vti"), arrays,
-                          compress=compress)
-        write_pvti(self.out_path("VTK", "pvti"), piece, arrays)
+        with telemetry.span("output.vtk", iteration=self.iter):
+            arrays = self.quantity_arrays(what)
+            flags = np.asarray(self.lattice.state.flags)
+            # node-type group layers (reference writes one flag layer per
+            # selected group, src/vtkLattice.cpp.Rt:33-46)
+            if what is None or "flag" in (what or set()) or not what:
+                arrays["Flag"] = flags
+            piece = write_vti(self.out_path("VTK", "vti"), arrays,
+                              compress=compress)
+            write_pvti(self.out_path("VTK", "pvti"), piece, arrays)
         return piece
 
     def write_txt(self, what: Optional[set[str]] = None,
@@ -255,15 +262,17 @@ class Solver:
         if not self.is_main:
             return []
         paths = []
-        for name, arr in self.quantity_arrays(what).items():
-            p = self.out_path(f"TXT_{name}", "txt.gz" if gzip_out else "txt")
-            a2 = arr.reshape(-1, arr.shape[-1])
-            if gzip_out:
-                with gzip.open(p, "wt") as f:
-                    np.savetxt(f, a2)
-            else:
-                np.savetxt(p, a2)
-            paths.append(p)
+        with telemetry.span("output.txt", iteration=self.iter):
+            for name, arr in self.quantity_arrays(what).items():
+                p = self.out_path(f"TXT_{name}",
+                                  "txt.gz" if gzip_out else "txt")
+                a2 = arr.reshape(-1, arr.shape[-1])
+                if gzip_out:
+                    with gzip.open(p, "wt") as f:
+                        np.savetxt(f, a2)
+                else:
+                    np.savetxt(p, a2)
+                paths.append(p)
         return paths
 
     def write_bin(self) -> Optional[str]:
@@ -272,7 +281,8 @@ class Solver:
         if not self.is_main:
             return None
         p = self.out_path("BIN", "npz")
-        self.lattice.save(p[:-4])
+        with telemetry.span("output.bin", iteration=self.iter):
+            self.lattice.save(p[:-4])
         return p
 
 

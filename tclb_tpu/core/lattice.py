@@ -34,6 +34,7 @@ import numpy as np
 from flax import struct
 
 from tclb_tpu.core.registry import Model
+from tclb_tpu import telemetry
 
 FLAG_DTYPE = jnp.uint16
 
@@ -826,9 +827,36 @@ class Lattice:
             else:
                 log.debug(f"engine: XLA path ({self.model.name} "
                           f"{self.shape})")
+            telemetry.engine_selected(
+                self._fast_name or "xla", model=self.model.name,
+                shape=list(self.shape), backend=jax.default_backend(),
+                probed=self._fast_probing)
         return self._fast
 
     def iterate(self, niter: int) -> None:
+        """Advance ``niter`` steps on the auto-selected engine.  With
+        telemetry enabled the chunk runs under an ``iterate`` span
+        (block_until_ready-fenced wall time, MLUPS + vs-roofline derived
+        metrics); disabled, the span machinery is a single boolean check."""
+        if not telemetry.enabled():
+            self._iterate_impl(niter)
+            return
+        # int(iteration) forces a device sync BEFORE the span opens, so
+        # the measured wall time never bills a previous chunk's async tail
+        with telemetry.span(
+                "iterate", iters=int(niter),
+                nodes=float(np.prod(self.shape)),
+                bytes_per_node=(2 * self.model.n_storage
+                                * np.dtype(self.state.fields.dtype).itemsize
+                                + 2),
+                model=self.model.name,
+                iteration=int(self.state.iteration)) as sp:
+            self._iterate_impl(niter)
+            sp.add(engine=("sampled_xla" if self.sampler is not None
+                           else (self._fast_name or "xla")))
+            sp.sync(self.state.fields)
+
+    def _iterate_impl(self, niter: int) -> None:
         if self.sampler is not None:
             it0 = int(self.state.iteration)
             self.state, samples = self._iterate_sampled(
@@ -880,8 +908,9 @@ class Lattice:
                         # flavor falls back to ITS band family: the
                         # tuned d2q9 resident to the tuned d2q9 band,
                         # the generic resident to the generic band.
+                        failed = self._fast_name
                         log.info(f"engine: {self._fast_name} failed to "
-                                 f"compile ({type(e).__name__}); band "
+                                 f"compile ({e!r}); band "
                                  "engine fallback")
                         if was_generic_res:
                             from tclb_tpu.ops.lbm import present_types
@@ -909,17 +938,21 @@ class Lattice:
                             self._fast_name = (f"pallas_2d"
                                                f"[{self.model.name},"
                                                f"fuse=2]")
+                        telemetry.engine_fallback(
+                            failed, self._fast_name, repr(e),
+                            model=self.model.name)
                         self._fast_probing = False
                         self.state = fast(self.state, self.params, nfast)
                         if not full:
                             self.state = self._iterate(
                                 self.state, self.params, 1)
                         return
+                    failed = self._fast_name
                     if self.mesh is not None:
                         ladder = []   # sharded engine: no cap ladder
                     else:
                         log.debug(f"engine: {self._fast_name} first "
-                                  f"compile failed ({type(e).__name__}); "
+                                  f"compile failed ({e!r}); "
                                   "trying smaller bands")
                         from tclb_tpu.ops.lbm import present_types
                         present = present_types(self.model,
@@ -948,11 +981,17 @@ class Lattice:
                         self._fast_name = (f"pallas_generic"
                                            f"[{self.model.name},fuse={fz},"
                                            f"by<={cap}]")
+                        telemetry.engine_fallback(
+                            failed, self._fast_name, repr(e),
+                            model=self.model.name)
                         break
                     else:
                         log.info(f"engine: {self._fast_name} failed to "
-                                 f"compile ({type(e).__name__}); XLA "
+                                 f"compile ({e!r}); XLA "
                                  "fallback")
+                        telemetry.engine_fallback(
+                            failed, "xla", repr(e),
+                            model=self.model.name)
                         if self.mesh is None:
                             # the sharded probe exercised a DIFFERENT
                             # kernel (local shard shape) — never poison

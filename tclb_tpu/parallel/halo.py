@@ -21,6 +21,8 @@ from __future__ import annotations
 from functools import lru_cache
 from typing import Callable, Optional
 
+import inspect
+
 import numpy as np
 import jax
 import jax.numpy as jnp
@@ -28,10 +30,24 @@ from jax import lax
 from jax.sharding import Mesh, PartitionSpec as P
 
 try:  # JAX >= 0.7 exposes shard_map at top level
-    from jax import shard_map as _shard_map
+    from jax import shard_map as _shard_map_impl
 except ImportError:  # pragma: no cover
-    from jax.experimental.shard_map import shard_map as _shard_map
+    from jax.experimental.shard_map import shard_map as _shard_map_impl
 
+# the replication-check kwarg was renamed check_rep -> check_vma across
+# jax versions; translate (or drop) so one call site works on both
+_SM_PARAMS = frozenset(
+    inspect.signature(_shard_map_impl).parameters)
+
+
+def _shard_map(f, **kw):
+    if "check_vma" in kw and "check_vma" not in _SM_PARAMS:
+        v = kw.pop("check_vma")
+        if "check_rep" in _SM_PARAMS:
+            kw["check_rep"] = v
+    return _shard_map_impl(f, **kw)
+
+from tclb_tpu import telemetry
 from tclb_tpu.core.lattice import (LatticeState, SimParams, Streaming,
                                    make_action_step)
 from tclb_tpu.core.registry import Model
@@ -311,7 +327,18 @@ def make_sharded_pallas_iterate(model: Model, mesh: Mesh, shape,
         if params.time_series is not None:
             raise ValueError(
                 "pallas iterate does not support Control time series")
-        return _for_niter(int(niter))(state, params)
+        if not telemetry.enabled():
+            return _for_niter(int(niter))(state, params)
+        # one ppermute halo exchange per step along the band axis (plus
+        # one aux-stack exchange per chunk) — counted host-side; the
+        # per-step wall time is the enclosing iterate span's business
+        with telemetry.span("halo.sharded_pallas_iterate",
+                            iters=int(niter), mode=mode or "tuned3d",
+                            mesh=dict(mesh.shape)) as sp:
+            out = _for_niter(int(niter))(state, params)
+            sp.sync(out.fields)
+        telemetry.counter("halo.exchanges", int(niter))
+        return out
 
     # the generic-kernel building block is capability-probed, not proven:
     # the Lattice dispatch probes its first call and falls back to the
@@ -365,12 +392,24 @@ def make_sharded_iterate(model: Model, mesh: Mesh,
                        out_specs=state_specs, check_vma=False)
         return jax.jit(f, donate_argnums=0)
 
+    # how many per-step ppermute exchange rounds the streaming strategy
+    # issues (mesh axes the velocity set actually crosses), for the
+    # host-side exchange counter
+    n_exch = sum(1 for v in streaming._send.values() if v is not None)
+
     def iterate(state, params, niter):
         if int(niter) <= 0:
             # match the single-device engine: no steps, no allreduce (a
             # psum of the already-reduced globals would scale them by the
             # device count)
             return state
-        return _for_niter(int(niter))(state, params)
+        if not telemetry.enabled():
+            return _for_niter(int(niter))(state, params)
+        with telemetry.span("halo.sharded_iterate", iters=int(niter),
+                            mesh=dict(mesh.shape)) as sp:
+            out = _for_niter(int(niter))(state, params)
+            sp.sync(out.fields)
+        telemetry.counter("halo.exchanges", int(niter) * n_exch)
+        return out
 
     return iterate

@@ -1,0 +1,23 @@
+"""Structured telemetry for engine-dispatch tracing and perf attribution.
+
+Usage (a trace costs nothing unless asked for):
+
+* ``TCLB_TELEMETRY=trace.jsonl python run.py`` — or
+  ``telemetry.enable("trace.jsonl")`` — turns the process-wide JSONL
+  sink on; everything below is a strict no-op otherwise;
+* ``telemetry.event(kind, **fields)`` — one structured event line;
+* ``with telemetry.span("iterate", nodes=n, iters=k) as sp: ...;
+  sp.sync(out)`` — honest wall-time (``block_until_ready`` fencing),
+  MLUPS / vs-roofline derived metrics, ``jax.profiler.TraceAnnotation``
+  passthrough;
+* ``telemetry.counter(name)`` — monotonic counters, flushed on close;
+* ``python -m tclb_tpu.telemetry report trace.jsonl [--format text|json]
+  [--compare other.jsonl]`` — per-engine/per-span aggregation and trace
+  diffing (see telemetry/report.py).
+"""
+
+from tclb_tpu.telemetry.events import (  # noqa: F401
+    counter, counters, disable, enable, enabled, engine_fallback,
+    engine_selected, event, failcheck, path)
+from tclb_tpu.telemetry.spans import (  # noqa: F401
+    HBM_GBS, NOOP_SPAN, Span, device_kind, roofline_mlups, span)

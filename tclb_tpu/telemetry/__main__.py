@@ -1,0 +1,5 @@
+import sys
+
+from tclb_tpu.telemetry.report import main
+
+sys.exit(main())

@@ -1,0 +1,172 @@
+"""Structured telemetry: the process-wide JSONL event sink.
+
+The reference ships real observability — the per-iteration globals CSV
+(``cbLog``), NaN failchecks (``cbFailcheck``) and in-situ Catalyst
+monitoring — but all of it is human-facing output.  This module is the
+machine-facing counterpart: one append-only JSONL stream of typed events
+(``{"kind": ..., "ts": ...}`` per line) that the report CLI
+(``python -m tclb_tpu.telemetry report``) aggregates into per-engine /
+per-span attributions.
+
+Design constraints:
+
+* **no-op when disabled** — every entry point starts with an ``enabled()``
+  check (a single attribute test); nothing is imported, opened, synced or
+  allocated on the disabled path, so instrumented hot seams cost nothing
+  in production runs that don't ask for a trace;
+* **process-wide** — one sink shared by every Lattice/Solver in the
+  process, selected via the ``TCLB_TELEMETRY`` environment variable at
+  import or :func:`enable` at runtime (the reference's equivalent switch
+  is its compile-time logging level);
+* **append-only JSONL** — one self-describing JSON object per line, so a
+  crashed run still yields a readable (truncated) trace and two traces
+  diff line-wise.
+"""
+
+from __future__ import annotations
+
+import atexit
+import json
+import os
+import threading
+import time
+from typing import Any, Optional, TextIO
+
+SCHEMA_VERSION = 1
+
+_lock = threading.Lock()
+_sink: Optional[TextIO] = None
+_path: Optional[str] = None
+_counters: dict[str, float] = {}
+_atexit_registered = False
+
+
+def enabled() -> bool:
+    """Fast check instrumentation sites gate on (a plain attribute test)."""
+    return _sink is not None
+
+
+def path() -> Optional[str]:
+    """The active trace path, or None when disabled."""
+    return _path
+
+
+def _json_default(obj: Any):
+    # numpy / jax scalars and arrays reach here from instrumentation
+    # sites; keep the trace readable rather than crash the run
+    for attr in ("item", "tolist"):
+        fn = getattr(obj, attr, None)
+        if callable(fn):
+            try:
+                return fn()
+            except Exception:  # noqa: BLE001 — e.g. .item() on an array
+                continue
+    return str(obj)
+
+
+def enable(trace_path: str) -> None:
+    """Open (append) the JSONL sink at ``trace_path`` and start recording.
+    Re-enabling with a different path closes the previous sink first."""
+    global _sink, _path, _atexit_registered
+    with _lock:
+        if _sink is not None:
+            if _path == trace_path:
+                return
+            _close_locked()
+        d = os.path.dirname(os.path.abspath(trace_path))
+        os.makedirs(d, exist_ok=True)
+        _sink = open(trace_path, "a", buffering=1)  # line-buffered
+        _path = trace_path
+        if not _atexit_registered:
+            atexit.register(disable)
+            _atexit_registered = True
+    from tclb_tpu import __version__
+    event("trace_start", schema=SCHEMA_VERSION, version=__version__,
+          pid=os.getpid())
+
+
+def _close_locked() -> None:
+    global _sink, _path
+    if _sink is None:
+        return
+    if _counters:
+        _write_locked({"kind": "counters", "ts": round(time.time(), 6),
+                       "counters": dict(_counters)})
+        _counters.clear()
+    try:
+        _sink.close()
+    except Exception:  # noqa: BLE001
+        pass
+    _sink = None
+    _path = None
+
+
+def disable() -> None:
+    """Flush counters, close the sink, and stop recording (idempotent)."""
+    with _lock:
+        _close_locked()
+
+
+def _write_locked(doc: dict) -> None:
+    assert _sink is not None
+    _sink.write(json.dumps(doc, default=_json_default) + "\n")
+
+
+def event(kind: str, **fields: Any) -> None:
+    """Emit one structured event; silently a no-op when disabled."""
+    if _sink is None:
+        return
+    doc = {"kind": kind, "ts": round(time.time(), 6)}
+    doc.update(fields)
+    with _lock:
+        if _sink is not None:
+            _write_locked(doc)
+
+
+def counter(name: str, inc: float = 1) -> None:
+    """Bump a monotonic process counter (flushed as one ``counters``
+    event when the sink closes); no-op when disabled."""
+    if _sink is None:
+        return
+    with _lock:
+        _counters[name] = _counters.get(name, 0) + inc
+
+
+def counters() -> dict[str, float]:
+    """Snapshot of the live counters (empty when disabled)."""
+    with _lock:
+        return dict(_counters)
+
+
+# -- named emitters ---------------------------------------------------------- #
+# The engine dispatch and failcheck sites call these by name so the static
+# hygiene gate (analysis.hygiene.scan_dispatch_telemetry) can verify by AST
+# that every dispatch decision and fallback is traced.
+
+
+def engine_selected(engine: str, **fields: Any) -> None:
+    """The dispatch chose an engine (``engine='xla'`` for the pure-XLA
+    path).  Fields: model, shape, backend, ..."""
+    event("engine_selected", engine=engine, **fields)
+
+
+def engine_fallback(from_engine: str, to_engine: str, cause: str,
+                    **fields: Any) -> None:
+    """An engine failed its first compile/probe and the dispatch swapped
+    in a fallback; ``cause`` is the ``repr`` of the triggering
+    exception."""
+    event("engine_fallback", **{"from": from_engine, "to": to_engine,
+                                "cause": cause, **fields})
+
+
+def failcheck(**fields: Any) -> None:
+    """A NaN/Inf failcheck fired.  Fields: iteration, quantity, n_bad."""
+    event("failcheck", **fields)
+
+
+# environment selection: TCLB_TELEMETRY=<path> turns the sink on for the
+# whole process (CI sets this around the tier-1 trace smoke)
+_env_path = os.environ.get("TCLB_TELEMETRY")
+if _env_path:
+    enable(_env_path)
+del _env_path

@@ -1,0 +1,335 @@
+"""Trace aggregation and diffing: ``python -m tclb_tpu.telemetry report``.
+
+Turns a JSONL trace (telemetry/events.py) into the attribution the
+BENCH/ROADMAP triage loop needs:
+
+* **per-engine iterate summary** — for every engine the dispatch ran
+  (``iterate`` spans grouped by their ``engine`` field): chunks, total
+  iterations, wall time, aggregate MLUPS (total node-updates / total
+  time) and the traffic-model roofline fraction;
+* **per-span table** — every span name with count/total/mean/max;
+* **dispatch history** — ``engine_selected`` decisions and the
+  ``engine_fallback`` chain with each fallback's exception cause (the
+  information the old free-form log strings swallowed);
+* **failchecks and counters**.
+
+``--compare other.jsonl`` diffs two traces engine-by-engine and
+span-by-span, flagging slowdowns beyond ``--threshold`` (default 5%) —
+the intended first tool for localizing regressions like the tracked
+BENCH_r05 ``heat_adj_vs_roofline`` 0.91 -> 0.79 drop.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+from typing import Optional
+
+
+def load(path: str) -> list[dict]:
+    """Parse a JSONL trace, skipping malformed lines (a crashed run may
+    truncate its last line mid-write)."""
+    out = []
+    with open(path) as fh:
+        for line in fh:
+            line = line.strip()
+            if not line:
+                continue
+            try:
+                doc = json.loads(line)
+            except json.JSONDecodeError:
+                continue
+            if isinstance(doc, dict) and "kind" in doc:
+                out.append(doc)
+    return out
+
+
+def summarize(evts: list[dict]) -> dict:
+    """Aggregate one trace into the report structure (all plain dicts,
+    JSON-serializable as-is)."""
+    spans: dict[str, dict] = {}
+    engines: dict[str, dict] = {}
+    selected: list[dict] = []
+    fallbacks: list[dict] = []
+    failchecks: list[dict] = []
+    cnt: dict[str, float] = {}
+    kinds: dict[str, int] = {}
+    for e in evts:
+        kind = e.get("kind", "")
+        kinds[kind] = kinds.get(kind, 0) + 1
+        if kind == "span":
+            name = e.get("name", "?")
+            dt = float(e.get("dur_s", 0.0))
+            s = spans.setdefault(name, {"count": 0, "total_s": 0.0,
+                                        "max_s": 0.0})
+            s["count"] += 1
+            s["total_s"] += dt
+            s["max_s"] = max(s["max_s"], dt)
+            if name == "iterate":
+                eng = e.get("engine", "?")
+                g = engines.setdefault(eng, {
+                    "chunks": 0, "iters": 0, "node_updates": 0.0,
+                    "total_s": 0.0, "vs_roofline": None,
+                    "roofline_known": e.get("roofline_known")})
+                g["chunks"] += 1
+                g["iters"] += int(e.get("iters", 0))
+                g["node_updates"] += (float(e.get("nodes", 0.0))
+                                      * float(e.get("iters", 0)))
+                g["total_s"] += dt
+        elif kind == "engine_selected":
+            selected.append(e)
+        elif kind == "engine_fallback":
+            fallbacks.append(e)
+        elif kind == "failcheck":
+            failchecks.append(e)
+        elif kind == "counters":
+            for k, v in (e.get("counters") or {}).items():
+                cnt[k] = cnt.get(k, 0) + v
+    for s in spans.values():
+        s["total_s"] = round(s["total_s"], 6)
+        s["mean_s"] = round(s["total_s"] / max(s["count"], 1), 6)
+        s["max_s"] = round(s["max_s"], 6)
+    for g in engines.values():
+        if g["total_s"] > 0 and g["node_updates"] > 0:
+            # significant digits, not decimals: tiny smoke domains sit
+            # far below 1 MLUPS and must not collapse to 0
+            g["mlups"] = float(f"{g['node_updates'] / g['total_s'] / 1e6:.6g}")
+        else:
+            g["mlups"] = None
+        g["total_s"] = round(g["total_s"], 6)
+        del g["node_updates"]
+    # stamp each engine's roofline fraction from its own iterate spans
+    # (weighted by node-updates so short chunks don't skew it)
+    w: dict[str, list] = {}
+    for e in evts:
+        if e.get("kind") == "span" and e.get("name") == "iterate" \
+                and e.get("vs_roofline") is not None:
+            nu = float(e.get("nodes", 0.0)) * float(e.get("iters", 0))
+            w.setdefault(e.get("engine", "?"), []).append(
+                (nu, float(e["vs_roofline"])))
+        if e.get("kind") == "span" and e.get("name") == "iterate" \
+                and e.get("roofline_known") is not None:
+            eng = e.get("engine", "?")
+            if eng in engines:
+                engines[eng]["roofline_known"] = e["roofline_known"]
+    for eng, rows in w.items():
+        tot = sum(nu for nu, _ in rows)
+        if tot > 0 and eng in engines:
+            engines[eng]["vs_roofline"] = round(
+                sum(nu * r for nu, r in rows) / tot, 4)
+    return {"engines": engines, "spans": spans,
+            "engine_selected": [
+                {k: v for k, v in e.items() if k not in ("kind",)}
+                for e in selected],
+            "fallbacks": [
+                {k: v for k, v in e.items() if k not in ("kind",)}
+                for e in fallbacks],
+            "failchecks": failchecks,
+            "counters": cnt,
+            "event_counts": kinds}
+
+
+def compare(base: dict, other: dict, threshold: float = 0.05) -> dict:
+    """Diff two summaries (``base`` = reference, ``other`` = candidate).
+    Positive deltas mean the candidate is faster/higher.  Entries whose
+    MLUPS dropped (or span time grew) by more than ``threshold`` land in
+    ``regressions``."""
+    out: dict = {"engines": {}, "spans": {}, "regressions": [],
+                 "threshold": threshold}
+    for eng in sorted(set(base["engines"]) | set(other["engines"])):
+        a = base["engines"].get(eng)
+        b = other["engines"].get(eng)
+        row: dict = {"base_mlups": a and a.get("mlups"),
+                     "other_mlups": b and b.get("mlups"),
+                     "base_vs_roofline": a and a.get("vs_roofline"),
+                     "other_vs_roofline": b and b.get("vs_roofline")}
+        if a and b and a.get("mlups") and b.get("mlups"):
+            delta = (b["mlups"] - a["mlups"]) / a["mlups"]
+            row["mlups_delta_pct"] = round(100 * delta, 2)
+            if delta < -threshold:
+                out["regressions"].append({
+                    "what": "engine_mlups", "engine": eng,
+                    "base": a["mlups"], "other": b["mlups"],
+                    "delta_pct": row["mlups_delta_pct"]})
+        elif a and not b:
+            row["note"] = "engine absent in other trace"
+        elif b and not a:
+            row["note"] = "engine absent in base trace"
+        out["engines"][eng] = row
+    for name in sorted(set(base["spans"]) | set(other["spans"])):
+        a = base["spans"].get(name)
+        b = other["spans"].get(name)
+        row = {"base_total_s": a and a["total_s"],
+               "other_total_s": b and b["total_s"],
+               "base_mean_s": a and a["mean_s"],
+               "other_mean_s": b and b["mean_s"]}
+        if a and b and a["mean_s"] > 0:
+            delta = (b["mean_s"] - a["mean_s"]) / a["mean_s"]
+            row["mean_delta_pct"] = round(100 * delta, 2)
+            if delta > threshold:
+                out["regressions"].append({
+                    "what": "span_time", "span": name,
+                    "base_mean_s": a["mean_s"], "other_mean_s": b["mean_s"],
+                    "delta_pct": row["mean_delta_pct"]})
+        out["spans"][name] = row
+    # fallback-chain drift is a regression signal of its own (an engine
+    # newly failing to compile shows up here before any timing does)
+    fb_a = [(f.get("from"), f.get("to")) for f in base.get("fallbacks", [])]
+    fb_b = [(f.get("from"), f.get("to")) for f in other.get("fallbacks", [])]
+    if fb_a != fb_b:
+        out["fallback_drift"] = {"base": fb_a, "other": fb_b}
+        new = [f for f in fb_b if f not in fb_a]
+        if new:
+            out["regressions"].append({
+                "what": "new_fallbacks", "fallbacks": new})
+    return out
+
+
+# -- rendering --------------------------------------------------------------- #
+
+
+def _fmt(v, nd=2) -> str:
+    if v is None:
+        return "-"
+    if isinstance(v, float):
+        return f"{v:.{nd}f}"
+    return str(v)
+
+
+def format_text(summary: dict) -> str:
+    lines = []
+    if summary["engines"]:
+        lines.append("per-engine iterate summary")
+        lines.append(f"  {'engine':<44} {'chunks':>6} {'iters':>9} "
+                     f"{'time_s':>10} {'MLUPS':>10} {'vs_roofline':>12}")
+        for eng, g in sorted(summary["engines"].items()):
+            star = "" if g.get("roofline_known", True) else "~"
+            lines.append(
+                f"  {eng:<44} {g['chunks']:>6} {g['iters']:>9} "
+                f"{_fmt(g['total_s'], 3):>10} {_fmt(g['mlups'], 1):>10} "
+                f"{star + _fmt(g['vs_roofline'], 4):>12}")
+        if any(not g.get("roofline_known", True)
+               for g in summary["engines"].values()):
+            lines.append("  (~ = roofline estimated: unknown device kind)")
+        lines.append("")
+    if summary["spans"]:
+        lines.append("spans")
+        lines.append(f"  {'name':<32} {'count':>6} {'total_s':>10} "
+                     f"{'mean_s':>10} {'max_s':>10}")
+        for name, s in sorted(summary["spans"].items(),
+                              key=lambda kv: -kv[1]["total_s"]):
+            lines.append(f"  {name:<32} {s['count']:>6} "
+                         f"{_fmt(s['total_s'], 4):>10} "
+                         f"{_fmt(s['mean_s'], 4):>10} "
+                         f"{_fmt(s['max_s'], 4):>10}")
+        lines.append("")
+    if summary["engine_selected"]:
+        lines.append("engine selections")
+        for e in summary["engine_selected"]:
+            lines.append(f"  {e.get('engine')}  model={e.get('model')} "
+                         f"shape={e.get('shape')} "
+                         f"backend={e.get('backend')}")
+        lines.append("")
+    if summary["fallbacks"]:
+        lines.append("fallback chain")
+        for f in summary["fallbacks"]:
+            lines.append(f"  {f.get('from')} -> {f.get('to')}: "
+                         f"{f.get('cause')}")
+        lines.append("")
+    if summary["failchecks"]:
+        lines.append("failchecks")
+        for f in summary["failchecks"]:
+            lines.append(f"  iteration {f.get('iteration')}: "
+                         f"{f.get('quantity')} has {f.get('n_bad')} "
+                         "non-finite values")
+        lines.append("")
+    if summary["counters"]:
+        lines.append("counters")
+        for k, v in sorted(summary["counters"].items()):
+            lines.append(f"  {k:<40} {v}")
+        lines.append("")
+    lines.append("events: " + ", ".join(
+        f"{k}={v}" for k, v in sorted(summary["event_counts"].items())))
+    return "\n".join(lines)
+
+
+def format_compare_text(diff: dict) -> str:
+    lines = ["trace comparison (base -> other)"]
+    if diff["engines"]:
+        lines.append(f"  {'engine':<44} {'base MLUPS':>12} "
+                     f"{'other MLUPS':>12} {'delta':>9}")
+        for eng, row in sorted(diff["engines"].items()):
+            d = row.get("mlups_delta_pct")
+            lines.append(
+                f"  {eng:<44} {_fmt(row['base_mlups'], 1):>12} "
+                f"{_fmt(row['other_mlups'], 1):>12} "
+                f"{(_fmt(d, 2) + '%') if d is not None else '-':>9}"
+                + (f"  ({row['note']})" if "note" in row else ""))
+    slow_spans = [(n, r) for n, r in sorted(diff["spans"].items())
+                  if r.get("mean_delta_pct") is not None]
+    if slow_spans:
+        lines.append(f"  {'span':<44} {'base mean_s':>12} "
+                     f"{'other mean_s':>12} {'delta':>9}")
+        for name, row in slow_spans:
+            lines.append(f"  {name:<44} {_fmt(row['base_mean_s'], 4):>12} "
+                         f"{_fmt(row['other_mean_s'], 4):>12} "
+                         f"{_fmt(row['mean_delta_pct'], 2):>8}%")
+    if diff.get("fallback_drift"):
+        lines.append("  fallback drift: "
+                     f"base={diff['fallback_drift']['base']} "
+                     f"other={diff['fallback_drift']['other']}")
+    if diff["regressions"]:
+        lines.append(f"REGRESSIONS (>{100 * diff['threshold']:.0f}%):")
+        for r in diff["regressions"]:
+            lines.append("  " + json.dumps(r))
+    else:
+        lines.append("no regressions beyond threshold")
+    return "\n".join(lines)
+
+
+# -- CLI --------------------------------------------------------------------- #
+
+
+def main(argv: Optional[list] = None) -> int:
+    p = argparse.ArgumentParser(
+        prog="python -m tclb_tpu.telemetry",
+        description="Aggregate and diff tclb_tpu telemetry traces.")
+    sub = p.add_subparsers(dest="cmd", required=True)
+    rp = sub.add_parser("report", help="summarize a JSONL trace")
+    rp.add_argument("trace", help="trace file (JSONL)")
+    rp.add_argument("--format", choices=("text", "json"), default="text")
+    rp.add_argument("--compare", metavar="OTHER", default=None,
+                    help="second trace to diff against (trace = base)")
+    rp.add_argument("--threshold", type=float, default=0.05,
+                    help="relative slowdown flagged as regression "
+                         "(default 0.05)")
+    rp.add_argument("--fail-on-regression", action="store_true",
+                    help="exit 4 if the comparison finds regressions")
+    args = p.parse_args(argv)
+
+    try:
+        base = summarize(load(args.trace))
+    except OSError as e:
+        print(f"error: cannot read {args.trace}: {e}", file=sys.stderr)
+        return 2
+    if args.compare is None:
+        if args.format == "json":
+            print(json.dumps(base, indent=2, sort_keys=True))
+        else:
+            print(format_text(base))
+        return 0
+    try:
+        other = summarize(load(args.compare))
+    except OSError as e:
+        print(f"error: cannot read {args.compare}: {e}", file=sys.stderr)
+        return 2
+    diff = compare(base, other, threshold=args.threshold)
+    if args.format == "json":
+        print(json.dumps({"base": base, "other": other, "compare": diff},
+                         indent=2, sort_keys=True))
+    else:
+        print(format_compare_text(diff))
+    if args.fail_on_regression and diff["regressions"]:
+        return 4
+    return 0

@@ -1,0 +1,155 @@
+"""Timing spans with honest walls and model-derived roofline metrics.
+
+A span measures host wall-time around a region.  JAX dispatch is
+asynchronous, so a naive ``perf_counter`` pair times the *enqueue*, not
+the work — callers fence with :meth:`Span.sync` (``jax.block_until_ready``
+on the region's output) before the span closes, the same discipline
+bench.py's ``timed`` enforces with its in-region checksum.
+
+When the region carries enough context (``nodes``/``iters`` fields), the
+span exit stamps derived metrics the way the reference prints its own
+MLUPS line (reference src/main.cpp.Rt:100-126):
+
+* ``mlups``      — ``nodes * iters / dt / 1e6``;
+* ``vs_roofline`` — achieved fraction of this chip's HBM streaming
+  roofline under the classical LBM traffic model (``bytes_per_node`` =
+  2 x n_storage x sizeof(real) + flag read per node update) — the same
+  math bench.py gates its credibility asserts on (it imports
+  :data:`HBM_GBS` from here so the two can never drift).
+
+Spans also wrap ``jax.profiler.TraceAnnotation`` when available, so a
+concurrent ``jax.profiler`` capture shows the same region names.
+"""
+
+from __future__ import annotations
+
+import time
+from typing import Any, Optional
+
+from tclb_tpu.telemetry import events
+
+# known per-chip HBM bandwidths (GB/s); unknown kinds fall back to an
+# ESTIMATE flagged by roofline_known=False (bench.py additionally skips
+# its credibility asserts for unknown chips)
+HBM_GBS = {"TPU v5 lite": 819.0, "TPU v5e": 819.0,
+           "TPU v5p": 2765.0, "TPU v4": 1228.0,
+           "TPU v6 lite": 1640.0, "TPU v6e": 1640.0}
+HBM_GBS_FALLBACK = 819.0
+
+_device_kind_cache: Optional[tuple] = None
+
+
+def device_kind() -> str:
+    """The first device's kind (cached; '' if jax has no devices)."""
+    global _device_kind_cache
+    if _device_kind_cache is None:
+        try:
+            import jax
+            _device_kind_cache = (jax.devices()[0].device_kind,)
+        except Exception:  # noqa: BLE001
+            _device_kind_cache = ("",)
+    return _device_kind_cache[0]
+
+
+def roofline_mlups(bytes_per_node: float,
+                   kind: Optional[str] = None) -> tuple[float, bool]:
+    """``(MLUPS ceiling, bandwidth_known)`` for the 1R+1W streaming
+    traffic model on ``kind`` (default: this process's first device)."""
+    if kind is None:
+        kind = device_kind()
+    hbm = HBM_GBS.get(kind)
+    known = hbm is not None
+    if hbm is None:
+        hbm = HBM_GBS_FALLBACK
+    return hbm * 1e9 / float(bytes_per_node) / 1e6, known
+
+
+class Span:
+    """Context manager timing one region; emits a ``span`` event on exit.
+
+    Only constructed when telemetry is enabled (use :func:`span`, which
+    returns the shared no-op otherwise), so it may import jax freely."""
+
+    __slots__ = ("name", "fields", "_t0", "_annotation")
+
+    def __init__(self, name: str, fields: dict):
+        self.name = name
+        self.fields = fields
+        self._t0 = 0.0
+        self._annotation = None
+
+    def add(self, **fields: Any) -> None:
+        """Attach/overwrite fields on the pending span event."""
+        self.fields.update(fields)
+
+    def sync(self, x: Any) -> Any:
+        """Fence: block until ``x`` (any pytree of jax arrays) is computed
+        so the span's wall-time covers the work, not the enqueue."""
+        import jax
+        return jax.block_until_ready(x)
+
+    def __enter__(self) -> "Span":
+        try:
+            from jax.profiler import TraceAnnotation
+            self._annotation = TraceAnnotation(self.name)
+            self._annotation.__enter__()
+        except Exception:  # noqa: BLE001 — profiler is optional garnish
+            self._annotation = None
+        self._t0 = time.perf_counter()
+        return self
+
+    def __exit__(self, exc_type, exc, tb) -> bool:
+        dt = time.perf_counter() - self._t0
+        if self._annotation is not None:
+            try:
+                self._annotation.__exit__(exc_type, exc, tb)
+            except Exception:  # noqa: BLE001
+                pass
+        fields = self.fields
+        if exc is not None:
+            fields["ok"] = False
+            fields["error"] = repr(exc)
+        nodes, iters = fields.get("nodes"), fields.get("iters")
+        if nodes and iters and dt > 0:
+            # 6 significant digits, not 6 decimals: tiny test domains sit
+            # far below 1 MLUPS and must not round to zero
+            mlups = float(nodes) * float(iters) / dt / 1e6
+            fields["mlups"] = float(f"{mlups:.6g}")
+            bpn = fields.get("bytes_per_node")
+            if bpn:
+                ceiling, known = roofline_mlups(bpn)
+                fields["vs_roofline"] = round(fields["mlups"] / ceiling, 4)
+                fields["roofline_known"] = known
+                fields["device_kind"] = device_kind()
+        events.event("span", name=self.name, dur_s=round(dt, 6), **fields)
+        return False
+
+
+class _NoopSpan:
+    """The disabled-mode span: never touches jax, files, or the clock."""
+
+    __slots__ = ()
+
+    def add(self, **fields: Any) -> None:
+        pass
+
+    def sync(self, x: Any) -> Any:
+        return x
+
+    def __enter__(self) -> "_NoopSpan":
+        return self
+
+    def __exit__(self, exc_type, exc, tb) -> bool:
+        return False
+
+
+NOOP_SPAN = _NoopSpan()
+
+
+def span(name: str, **fields: Any):
+    """A timing span over a region: ``with span("iterate", niter=n) as sp``.
+    Returns the shared no-op (no timing, no sync, no emission) when
+    telemetry is disabled."""
+    if not events.enabled():
+        return NOOP_SPAN
+    return Span(name, fields)

@@ -16,11 +16,28 @@ import os
 import sys
 
 LEVELS = {"debug": 0, "info": 1, "notice": 2, "warning": 3, "error": 4}
-_threshold = LEVELS.get(os.environ.get("TCLB_LOG", "info"), 1)
+
+
+def _threshold_from_env() -> int:
+    raw = os.environ.get("TCLB_LOG", "info")
+    if raw not in LEVELS:
+        # warn once at import, then fall back to info — a typo in TCLB_LOG
+        # must not silently change verbosity
+        print(f"[warning] TCLB_LOG={raw!r} is not a log level "
+              f"(accepted: {', '.join(LEVELS)}); falling back to 'info'",
+              file=sys.stderr, flush=True)
+        return LEVELS["info"]
+    return LEVELS[raw]
+
+
+_threshold = _threshold_from_env()
 
 
 def set_level(level: str) -> None:
     global _threshold
+    if level not in LEVELS:
+        raise ValueError(
+            f"unknown log level {level!r} (accepted: {', '.join(LEVELS)})")
     _threshold = LEVELS[level]
 
 

@@ -216,6 +216,41 @@ def test_hygiene_fires_on_dead_entry_point(tmp_path):
                     "ops.fake_engine.make_foo_iterate"}
 
 
+def test_hygiene_fires_on_untraced_dispatch(tmp_path):
+    p = tmp_path / "lattice.py"
+    p.write_text(
+        "from tclb_tpu import telemetry\n"
+        "class Lattice:\n"
+        "    def _fast_path(self):\n"
+        "        self._fast_iter = object()   # no engine_selected\n"
+        "    def _iterate_impl(self, n):\n"
+        "        try:\n"
+        "            self._fast_iter(n)\n"
+        "        except Exception:\n"
+        "            self._fast_name = None   # silent demotion\n")
+    fs = hygiene.scan_dispatch_telemetry(lattice_path=str(p))
+    checks = [f.check for f in fs]
+    assert checks == ["hygiene.untraced_dispatch"] * 2
+    assert all(f.severity == "error" for f in fs)
+    assert any("engine_selected" in f.message for f in fs)
+    assert any("engine_fallback" in f.message for f in fs)
+
+    # adding the emissions clears both findings
+    p.write_text(
+        "from tclb_tpu import telemetry\n"
+        "class Lattice:\n"
+        "    def _fast_path(self):\n"
+        "        self._fast_iter = object()\n"
+        "        telemetry.engine_selected('xla')\n"
+        "    def _iterate_impl(self, n):\n"
+        "        try:\n"
+        "            self._fast_iter(n)\n"
+        "        except Exception as e:\n"
+        "            self._fast_name = None\n"
+        "            telemetry.engine_fallback('pallas', 'xla', repr(e))\n")
+    assert hygiene.scan_dispatch_telemetry(lattice_path=str(p)) == []
+
+
 # --------------------------------------------------------------------------- #
 # Finding mechanics / fingerprints
 # --------------------------------------------------------------------------- #

@@ -1,0 +1,377 @@
+"""Telemetry contract tests: strict no-op when disabled, JSONL schema
+when enabled, engine dispatch events on the real Lattice (including the
+forced-fallback path), failcheck events, report aggregation, and the
+--compare regression detector on synthetic traces.
+"""
+
+import json
+import os
+import subprocess
+import sys
+import xml.etree.ElementTree as ET
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from tclb_tpu import telemetry
+from tclb_tpu.core.lattice import Lattice
+from tclb_tpu.models import get_model
+from tclb_tpu.ops import pallas_d2q9
+from tclb_tpu.telemetry import report
+from tclb_tpu.telemetry.spans import NOOP_SPAN
+from tclb_tpu.utils import log
+
+
+@pytest.fixture(autouse=True)
+def _sink_off():
+    """Telemetry is process-global: every test starts and ends disabled."""
+    telemetry.disable()
+    yield
+    telemetry.disable()
+
+
+def _mrt_lattice(ny=8, nx=16):
+    m = get_model("d2q9")
+    lat = Lattice(m, (ny, nx), dtype=jnp.float32,
+                  settings={"nu": 0.05})
+    lat.set_flags(np.full((ny, nx), m.flag_for("MRT"), dtype=np.uint16))
+    lat.init()
+    return m, lat
+
+
+def _karman_lattice(ny=64, nx=128):
+    m = get_model("d2q9")
+    lat = Lattice(m, (ny, nx), dtype=jnp.float32,
+                  settings={"nu": 0.05, "Velocity": 0.03})
+    flags = np.full((ny, nx), m.flag_for("MRT"), dtype=np.uint16)
+    flags[:, 0] = m.flag_for("WVelocity", "MRT")
+    flags[:, -1] = m.flag_for("EPressure", "MRT")
+    flags[0, :] = m.flag_for("Wall")
+    flags[-1, :] = m.flag_for("Wall")
+    lat.set_flags(flags)
+    lat.init()
+    return m, lat
+
+
+# --------------------------------------------------------------------------- #
+# Disabled mode: strict no-op
+# --------------------------------------------------------------------------- #
+
+
+def test_disabled_is_strict_noop(monkeypatch):
+    assert not telemetry.enabled()
+    assert telemetry.path() is None
+    telemetry.event("anything", x=1)          # must not raise or write
+    telemetry.counter("c", 5)
+    assert telemetry.counters() == {}
+
+    # the disabled span is the shared no-op singleton: no clock, no jax
+    sp = telemetry.span("iterate", iters=10)
+    assert sp is NOOP_SPAN
+    sentinel = object()
+
+    def boom(_):
+        raise AssertionError("disabled span must never touch jax")
+
+    monkeypatch.setattr(jax, "block_until_ready", boom)
+    with sp:
+        sp.add(engine="xla")
+        assert sp.sync(sentinel) is sentinel
+
+
+def test_disabled_lattice_iterate_never_syncs(monkeypatch):
+    _, lat = _mrt_lattice()
+
+    real = jax.block_until_ready
+
+    def boom(_):
+        raise AssertionError("disabled iterate must not fence")
+
+    monkeypatch.setattr(jax, "block_until_ready", boom)
+    lat.iterate(2)                             # telemetry disabled
+    monkeypatch.setattr(jax, "block_until_ready", real)
+    assert int(lat.state.iteration) == 2
+
+
+# --------------------------------------------------------------------------- #
+# Enabled mode: JSONL schema
+# --------------------------------------------------------------------------- #
+
+
+def test_enabled_schema_golden(tmp_path):
+    trace = tmp_path / "t.jsonl"
+    telemetry.enable(str(trace))
+    assert telemetry.enabled() and telemetry.path() == str(trace)
+    telemetry.event("custom", n=np.int64(3), arr=np.arange(2))
+    telemetry.counter("halo.exchanges", 4)
+    telemetry.counter("halo.exchanges", 2)
+    with telemetry.span("work", nodes=1000.0, iters=100) as sp:
+        sp.add(engine="xla")
+    telemetry.disable()
+    assert not telemetry.enabled()
+
+    lines = [json.loads(x) for x in trace.read_text().splitlines()]
+    kinds = [e["kind"] for e in lines]
+    assert kinds == ["trace_start", "custom", "span", "counters"]
+    head = lines[0]
+    assert head["schema"] == 1
+    assert head["pid"] == os.getpid()
+    assert isinstance(head["version"], str)
+    assert all(isinstance(e["ts"], float) for e in lines)
+    assert lines[1]["n"] == 3 and lines[1]["arr"] == [0, 1]  # numpy coerced
+    span_evt = lines[2]
+    assert span_evt["name"] == "work" and span_evt["engine"] == "xla"
+    assert span_evt["dur_s"] >= 0 and "mlups" in span_evt
+    assert lines[3]["counters"] == {"halo.exchanges": 6}
+
+
+def test_load_skips_truncated_lines(tmp_path):
+    trace = tmp_path / "t.jsonl"
+    trace.write_text('{"kind": "a", "ts": 1.0}\n'
+                     '{"kind": "b", "ts": 2.0'        # crash mid-write
+                     '\n\n{"kind": "c", "ts": 3.0}\n')
+    assert [e["kind"] for e in report.load(str(trace))] == ["a", "c"]
+
+
+# --------------------------------------------------------------------------- #
+# Lattice dispatch events
+# --------------------------------------------------------------------------- #
+
+
+def test_lattice_iterate_emits_engine_and_span(tmp_path, monkeypatch):
+    monkeypatch.delenv("TCLB_FASTPATH", raising=False)
+    trace = tmp_path / "t.jsonl"
+    telemetry.enable(str(trace))
+    m, lat = _mrt_lattice()
+    lat.iterate(3)
+    lat.iterate(2)
+    telemetry.disable()
+
+    evts = report.load(str(trace))
+    sel = [e for e in evts if e["kind"] == "engine_selected"]
+    assert len(sel) == 1                      # once per built engine
+    assert sel[0]["engine"] == "xla"          # CPU + auto => XLA path
+    assert sel[0]["model"] == "d2q9" and sel[0]["shape"] == [8, 16]
+
+    it = [e for e in evts if e["kind"] == "span" and e["name"] == "iterate"]
+    assert [e["iters"] for e in it] == [3, 2]
+    assert [e["iteration"] for e in it] == [0, 3]
+    for e in it:
+        assert e["engine"] == "xla"
+        assert e["nodes"] == 8 * 16
+        assert e["mlups"] > 0
+        # classical traffic model: 1R+1W of every storage field + flag
+        assert e["bytes_per_node"] == 2 * m.n_storage * 4 + 2
+        # CPU device kind is not in the HBM table: estimated roofline
+        assert e["roofline_known"] is False
+        assert e["vs_roofline"] >= 0
+
+
+def test_forced_fallback_emits_events(tmp_path, monkeypatch):
+    """Break the resident engine's probe: the dispatch must land on the
+    band engine AND leave an engine_fallback breadcrumb with the cause."""
+    monkeypatch.setenv("TCLB_FASTPATH", "force")
+
+    def bad_resident(model, shape, dtype, present=None):
+        def it(state, params, niter):
+            raise RuntimeError("synthetic mosaic failure")
+        return it
+
+    monkeypatch.setattr(pallas_d2q9, "make_resident_iterate", bad_resident)
+
+    trace = tmp_path / "t.jsonl"
+    telemetry.enable(str(trace))
+    _, lat = _karman_lattice()
+    niter = 5
+    lat.iterate(niter)
+    telemetry.disable()
+
+    assert lat._fast_name == "pallas_2d[d2q9,fuse=2]"
+    assert int(lat.state.iteration) == niter
+
+    evts = report.load(str(trace))
+    sel = [e for e in evts if e["kind"] == "engine_selected"]
+    assert sel and sel[0]["engine"] == "pallas_resident[d2q9,fuse=8]"
+    assert sel[0]["probed"] is True
+    fb = [e for e in evts if e["kind"] == "engine_fallback"]
+    assert len(fb) == 1
+    assert fb[0]["from"] == "pallas_resident[d2q9,fuse=8]"
+    assert fb[0]["to"] == "pallas_2d[d2q9,fuse=2]"
+    assert "synthetic mosaic failure" in fb[0]["cause"]
+    # the iterate span records the engine that actually finished the chunk
+    it = [e for e in evts if e["kind"] == "span" and e["name"] == "iterate"]
+    assert it and it[-1]["engine"] == "pallas_2d[d2q9,fuse=2]"
+
+
+# --------------------------------------------------------------------------- #
+# Failcheck events
+# --------------------------------------------------------------------------- #
+
+
+def test_failcheck_event(tmp_path):
+    from tclb_tpu.control.handlers import cbFailcheck
+    from tclb_tpu.control.solver import ITERATION_STOP, Solver
+
+    trace = tmp_path / "t.jsonl"
+    telemetry.enable(str(trace))
+    m = get_model("d2q9")
+    s = Solver(m, output=str(tmp_path / "out") + "/")
+    s.set_size((8, 16))
+    s.lattice.set_flags(
+        np.full((8, 16), m.flag_for("MRT"), dtype=np.uint16))
+    s.lattice.init()
+    f = np.asarray(s.lattice.state.fields).copy()
+    f[0, 2, 3] = np.nan
+    s.lattice.state = s.lattice.state.replace(fields=jnp.asarray(f))
+
+    h = cbFailcheck(ET.Element("Failcheck"), s)
+    h.init()
+    assert h.do_it() == ITERATION_STOP
+    telemetry.disable()
+
+    fc = [e for e in report.load(str(trace)) if e["kind"] == "failcheck"]
+    assert len(fc) == 1
+    assert fc[0]["iteration"] == 0
+    assert fc[0]["n_bad"] >= 1
+    assert isinstance(fc[0]["quantity"], str) and fc[0]["quantity"]
+
+
+# --------------------------------------------------------------------------- #
+# Report aggregation + compare
+# --------------------------------------------------------------------------- #
+
+_ENG = "pallas_2d[d2q9,fuse=2]"
+
+
+def _iterate_span(dur_s, nodes=8192.0, iters=100, engine=_ENG):
+    return {"kind": "span", "ts": 1.0, "name": "iterate", "dur_s": dur_s,
+            "iters": iters, "nodes": nodes, "engine": engine,
+            "mlups": round(nodes * iters / dur_s / 1e6, 3),
+            "vs_roofline": 0.5, "roofline_known": True}
+
+
+def _write_trace(path, events):
+    with open(path, "w") as fh:
+        for e in events:
+            fh.write(json.dumps(e) + "\n")
+    return str(path)
+
+
+def test_summarize_engine_table(tmp_path):
+    evts = [{"kind": "trace_start", "ts": 0.0, "schema": 1},
+            _iterate_span(0.01), _iterate_span(0.01),
+            {"kind": "span", "ts": 1.0, "name": "output.vtk",
+             "dur_s": 0.25},
+            {"kind": "engine_selected", "ts": 0.5, "engine": _ENG,
+             "model": "d2q9"},
+            {"kind": "counters", "ts": 2.0,
+             "counters": {"halo.exchanges": 12}}]
+    s = report.summarize(report.load(_write_trace(tmp_path / "a.jsonl",
+                                                  evts)))
+    g = s["engines"][_ENG]
+    assert g["chunks"] == 2 and g["iters"] == 200
+    assert g["mlups"] == pytest.approx(8192 * 200 / 0.02 / 1e6, rel=1e-3)
+    assert g["vs_roofline"] == pytest.approx(0.5)
+    assert s["spans"]["output.vtk"]["count"] == 1
+    assert s["counters"] == {"halo.exchanges": 12}
+    txt = report.format_text(s)
+    assert "per-engine iterate summary" in txt and _ENG in txt
+
+
+def test_compare_detects_injected_slowdown(tmp_path):
+    base = _write_trace(tmp_path / "base.jsonl",
+                        [_iterate_span(0.010) for _ in range(3)])
+    # candidate runs the same work 40% slower — far beyond the 5% gate
+    other = _write_trace(tmp_path / "other.jsonl",
+                         [_iterate_span(0.014) for _ in range(3)])
+    diff = report.compare(report.summarize(report.load(base)),
+                          report.summarize(report.load(other)))
+    regs = [r for r in diff["regressions"] if r["what"] == "engine_mlups"]
+    assert len(regs) == 1 and regs[0]["engine"] == _ENG
+    assert regs[0]["delta_pct"] < -25
+
+    # identical traces: clean bill
+    diff2 = report.compare(report.summarize(report.load(base)),
+                           report.summarize(report.load(base)))
+    assert diff2["regressions"] == []
+
+
+def test_compare_flags_new_fallbacks(tmp_path):
+    base = _write_trace(tmp_path / "base.jsonl", [_iterate_span(0.01)])
+    other = _write_trace(
+        tmp_path / "other.jsonl",
+        [{"kind": "engine_fallback", "ts": 0.1, "from": _ENG, "to": "xla",
+          "cause": "RuntimeError('mosaic')"},
+         _iterate_span(0.01, engine="xla")])
+    diff = report.compare(report.summarize(report.load(base)),
+                          report.summarize(report.load(other)))
+    assert diff["fallback_drift"]["other"] == [[_ENG, "xla"]] \
+        or diff["fallback_drift"]["other"] == [(_ENG, "xla")]
+    assert any(r["what"] == "new_fallbacks" for r in diff["regressions"])
+
+
+def test_report_cli(tmp_path, capsys):
+    base = _write_trace(tmp_path / "base.jsonl",
+                        [_iterate_span(0.010) for _ in range(3)])
+    other = _write_trace(tmp_path / "other.jsonl",
+                         [_iterate_span(0.020) for _ in range(3)])
+
+    assert report.main(["report", base]) == 0
+    assert _ENG in capsys.readouterr().out
+
+    assert report.main(["report", base, "--format", "json"]) == 0
+    doc = json.loads(capsys.readouterr().out)
+    assert doc["engines"][_ENG]["chunks"] == 3
+
+    assert report.main(["report", base, "--compare", other,
+                        "--fail-on-regression"]) == 4
+    out = capsys.readouterr().out
+    assert "REGRESSIONS" in out
+
+    assert report.main(["report", base, "--compare", other,
+                        "--format", "json"]) == 0
+    doc = json.loads(capsys.readouterr().out)
+    assert doc["compare"]["regressions"]
+
+    assert report.main(["report", str(tmp_path / "missing.jsonl")]) == 2
+    capsys.readouterr()
+
+
+# --------------------------------------------------------------------------- #
+# Env activation + log-level validation (satellite)
+# --------------------------------------------------------------------------- #
+
+
+def test_env_activation_and_bad_log_level(tmp_path):
+    """TCLB_TELEMETRY turns the sink on at import; a bogus TCLB_LOG warns
+    once (naming the value and the accepted levels) and falls back."""
+    trace = tmp_path / "env.jsonl"
+    env = dict(os.environ, TCLB_TELEMETRY=str(trace), TCLB_LOG="bogus",
+               JAX_PLATFORMS="cpu")
+    r = subprocess.run(
+        [sys.executable, "-c",
+         "from tclb_tpu.utils import log\n"
+         "from tclb_tpu import telemetry\n"
+         "assert telemetry.enabled()\n"
+         "telemetry.event('ping', x=1)\n"
+         "telemetry.disable()\n"],
+        env=env, capture_output=True, text=True, timeout=300)
+    assert r.returncode == 0, r.stderr
+    assert "TCLB_LOG" in r.stderr and "'bogus'" in r.stderr
+    assert "debug" in r.stderr and "error" in r.stderr   # accepted levels
+    kinds = [e["kind"] for e in report.load(str(trace))]
+    assert kinds[0] == "trace_start" and "ping" in kinds
+
+
+def test_set_level_rejects_unknown():
+    old = log._threshold
+    try:
+        with pytest.raises(ValueError, match="bogus"):
+            log.set_level("bogus")
+        assert log._threshold == old          # unchanged on error
+        log.set_level("warning")
+        assert log._threshold == log.LEVELS["warning"]
+    finally:
+        log._threshold = old

@@ -65,7 +65,7 @@ def _cmd_run(args) -> int:
         jax.profiler.start_trace(args.profile)
     try:
         solver = run_config(args.case, model, mesh=mesh, dtype=dtype,
-                            output=args.output)
+                            output=args.output, resume=args.resume)
     finally:
         if args.profile:
             jax.profiler.stop_trace()
@@ -121,6 +121,12 @@ def main(argv=None) -> int:
     r.add_argument("--mesh", default=None,
                    help="device mesh, e.g. 2x4 (z-y-x major)")
     r.add_argument("--precision", choices=("f32", "f64"), default="f32")
+    r.add_argument("--resume", nargs="?", const="latest", default=None,
+                   metavar="CKPT",
+                   help="resume from a checkpoint before solving: bare "
+                   "--resume picks the newest valid checkpoint under the "
+                   "config's <SaveCheckpoint> root, or pass an explicit "
+                   "checkpoint directory")
     r.add_argument("--profile", default=None, metavar="DIR",
                    help="write a TensorBoard trace of the run to DIR")
     r.add_argument("--distributed", default=None, metavar="SPEC",

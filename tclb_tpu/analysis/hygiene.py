@@ -281,10 +281,101 @@ def scan_dispatch_telemetry(lattice_path=None) -> list:
     return findings
 
 
+def _public_self_attr_writes(fn_node) -> list:
+    """``(attr, lineno)`` for every public ``self.<attr>`` the function
+    assigns — plain/augmented assignment targets and subscript stores
+    (``self.old[name] = ...``)."""
+    out = []
+    for n in ast.walk(fn_node):
+        if isinstance(n, (ast.Assign, ast.AugAssign, ast.AnnAssign)):
+            targets = n.targets if isinstance(n, ast.Assign) else [n.target]
+            for t in targets:
+                if isinstance(t, ast.Subscript):
+                    t = t.value
+                if isinstance(t, ast.Attribute) \
+                        and isinstance(t.value, ast.Name) \
+                        and t.value.id == "self" \
+                        and not t.attr.startswith("_"):
+                    out.append((t.attr, n.lineno))
+    return out
+
+
+def scan_unrestorable_handlers(paths=None) -> list:
+    """Checkpoint completeness: a Handler subclass whose ``do_it`` mutates
+    public ``self`` attributes carries run-state that a full-run
+    checkpoint must capture — it must implement ``restorable_state`` in
+    its own body (or explicitly opt out with ``checkpoint_exempt =
+    True``), otherwise a kill-resume silently resets that state and the
+    resumed run diverges from the uninterrupted one."""
+    if paths is None:
+        paths = _py_files(os.path.join(_PKG_ROOT, "control"))
+    findings = []
+    for path in paths:
+        try:
+            with open(path) as fh:
+                tree = ast.parse(fh.read())
+        except (OSError, SyntaxError) as e:
+            findings.append(Finding(
+                "hygiene.unparseable", "error", "",
+                f"cannot parse {path}: {e}", path))
+            continue
+        rel = os.path.relpath(path, _REPO_ROOT)
+
+        classes = {n.name: n for n in ast.walk(tree)
+                   if isinstance(n, ast.ClassDef)}
+
+        def is_handler(cls, seen=None) -> bool:
+            seen = seen or set()
+            if cls.name in seen:
+                return False
+            seen.add(cls.name)
+            for b in cls.bases:
+                name = b.id if isinstance(b, ast.Name) else \
+                    (b.attr if isinstance(b, ast.Attribute) else None)
+                if name == "Handler":
+                    return True
+                if name in classes and is_handler(classes[name], seen):
+                    return True
+            return False
+
+        for cls in classes.values():
+            if cls.name == "Handler" or not is_handler(cls):
+                continue
+            body_fns = {n.name for n in cls.body
+                        if isinstance(n, (ast.FunctionDef,
+                                          ast.AsyncFunctionDef))}
+            exempt = any(
+                isinstance(n, ast.Assign)
+                and any(isinstance(t, ast.Name)
+                        and t.id == "checkpoint_exempt"
+                        for t in n.targets)
+                and isinstance(n.value, ast.Constant) and n.value.value
+                for n in cls.body)
+            if "restorable_state" in body_fns or exempt:
+                continue
+            do_it = next((n for n in cls.body
+                          if isinstance(n, ast.FunctionDef)
+                          and n.name == "do_it"), None)
+            if do_it is None:
+                continue
+            writes = _public_self_attr_writes(do_it)
+            if writes:
+                attrs = sorted({a for a, _ln in writes})
+                findings.append(Finding(
+                    "hygiene.unrestorable_handler", "error", "",
+                    f"{rel}:{cls.lineno} {cls.name}.do_it mutates "
+                    f"self.{', self.'.join(attrs)} but the class neither "
+                    "implements restorable_state() nor sets "
+                    "checkpoint_exempt = True — this state is lost on "
+                    "checkpoint resume", f"{rel}:{cls.lineno}"))
+    return findings
+
+
 def check_repo(engine_dir=None, sources=None) -> list:
     return (scan_dead_entry_points(engine_dir, sources)
             + scan_id_keyed_caches()
-            + scan_dispatch_telemetry())
+            + scan_dispatch_telemetry()
+            + scan_unrestorable_handlers())
 
 
 def check_model_hygiene(model: Model, shape=None) -> list:

@@ -1,0 +1,49 @@
+"""Fault-tolerant checkpoint/restart subsystem.
+
+The reference treats full-state save/restart as a first-class capability
+(``Lattice::save``, src/Lattice.cu.Rt:592-626, plus the SaveBinary /
+LoadBinary handlers); this package is its production-grade counterpart,
+built with the discipline of a training stack:
+
+* **atomic** — every checkpoint is written into a temp step directory
+  and fsync+renamed into place, so a SIGKILL mid-write can never corrupt
+  the only copy (:mod:`tclb_tpu.checkpoint.writer`);
+* **verified** — per-array CRC32 + dtype/shape land in a JSON manifest
+  stamped with ``Model.fingerprint``, the mesh layout and a schema
+  version (:mod:`tclb_tpu.checkpoint.manifest`); restore refuses a
+  manifest that does not match the live model;
+* **async** — device→host copies are fenced with ``block_until_ready``,
+  then serialization runs on a background thread with at most one save
+  in flight, so iterate loops keep running
+  (:class:`tclb_tpu.checkpoint.manager.CheckpointManager`);
+* **sharded** — on a device mesh, ``fields``/``flags`` are written one
+  file per shard keyed by mesh coordinates, and restore stitches the
+  global array back together onto the same or a compatible layout
+  (:mod:`tclb_tpu.checkpoint.restore`);
+* **resumable** — ``CheckpointManager.latest()`` skips checkpoints that
+  fail verification and falls back to the previous valid one; the
+  control layer's ``<SaveCheckpoint every=.../>`` handler and the
+  ``--resume`` CLI flag build kill-resume on top.
+
+``python -m tclb_tpu.checkpoint {inspect,verify,prune}`` operates on
+checkpoint directories from the command line.
+"""
+
+from tclb_tpu.checkpoint.manifest import (CheckpointError, MANIFEST_NAME,
+                                          SCHEMA_VERSION, is_checkpoint_dir,
+                                          read_manifest, verify_checkpoint)
+from tclb_tpu.checkpoint.writer import (atomic_path, atomic_write_bytes,
+                                        resolve_npz, strip_suffix,
+                                        with_suffix)
+from tclb_tpu.checkpoint.manager import CheckpointManager
+from tclb_tpu.checkpoint.restore import (apply_restored_solver_state,
+                                         collect_solver_state, load_any,
+                                         restore_lattice, save_checkpoint)
+
+__all__ = [
+    "CheckpointError", "CheckpointManager", "MANIFEST_NAME",
+    "SCHEMA_VERSION", "apply_restored_solver_state", "atomic_path",
+    "atomic_write_bytes", "collect_solver_state", "is_checkpoint_dir",
+    "load_any", "read_manifest", "resolve_npz", "restore_lattice",
+    "save_checkpoint", "strip_suffix", "verify_checkpoint", "with_suffix",
+]

@@ -1,0 +1,5 @@
+import sys
+
+from tclb_tpu.checkpoint.cli import main
+
+sys.exit(main())

@@ -1,0 +1,145 @@
+"""``python -m tclb_tpu.checkpoint {inspect,verify,prune}``.
+
+Operates purely on the on-disk format (manifest + npy files) — no model
+or jax state is needed, so these commands are safe on a machine that
+can't even run the solver.
+
+Exit codes: 0 ok, 1 verification failed / no valid checkpoint,
+2 usage error.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+
+from tclb_tpu.checkpoint import manifest as mf
+from tclb_tpu.checkpoint.manager import CheckpointManager
+
+
+def _checkpoint_dirs(path: str) -> list[str]:
+    """``path`` is either one checkpoint dir or a manager root holding
+    ``step_*`` dirs."""
+    if mf.is_checkpoint_dir(path):
+        return [path]
+    mgr = CheckpointManager(path, keep_last=0)
+    return [p for _s, p in mgr.steps()]
+
+
+def _summary(dirpath: str) -> dict:
+    try:
+        man = mf.read_manifest(dirpath)
+    except mf.CheckpointError as e:
+        return {"path": dirpath, "error": str(e)}
+    arrays = {}
+    nbytes = 0
+    for name, rec in man.get("arrays", {}).items():
+        shards = rec.get("shards")
+        nb = (sum(int(s["nbytes"]) for s in shards) if shards is not None
+              else int(rec.get("nbytes", 0)))
+        nbytes += nb
+        arrays[name] = {"dtype": rec["dtype"], "shape": rec["shape"],
+                        "nbytes": nb,
+                        **({"shards": len(shards)} if shards is not None
+                           else {})}
+    return {"path": dirpath, "schema": man["schema"],
+            "model": man["model"], "iteration": man["iteration"],
+            "shape": man["shape"], "dtype": man["dtype"],
+            "mesh": man["mesh"], "bytes": nbytes, "arrays": arrays,
+            "extra_keys": sorted(man.get("extra", {}))}
+
+
+def _cmd_inspect(args) -> int:
+    dirs = _checkpoint_dirs(args.path)
+    if not dirs:
+        print(f"no checkpoints under {args.path}", file=sys.stderr)
+        return 1
+    summaries = [_summary(d) for d in dirs]
+    if args.format == "json":
+        print(json.dumps(summaries if len(summaries) > 1 else summaries[0],
+                         indent=2))
+        return 0
+    for s in summaries:
+        if "error" in s:
+            print(f"{s['path']}: INVALID — {s['error']}")
+            continue
+        mesh = s["mesh"]["axes"] if s["mesh"] else "unsharded"
+        print(f"{s['path']}: {s['model']['name']} "
+              f"(fp {s['model']['fingerprint']}) iter={s['iteration']} "
+              f"shape={tuple(s['shape'])} {s['dtype']} mesh={mesh} "
+              f"{s['bytes'] / 1e6:.2f} MB")
+        for name, rec in sorted(s["arrays"].items()):
+            extra = f" x{rec['shards']} shards" if "shards" in rec else ""
+            print(f"    {name:12s} {rec['dtype']:10s} "
+                  f"{tuple(rec['shape'])}{extra}")
+    return 0
+
+
+def _cmd_verify(args) -> int:
+    dirs = _checkpoint_dirs(args.path)
+    if not dirs:
+        print(f"no checkpoints under {args.path}", file=sys.stderr)
+        return 1
+    bad = 0
+    for d in dirs:
+        problems = mf.verify_checkpoint(d, deep=not args.shallow)
+        if problems:
+            bad += 1
+            print(f"{d}: FAIL")
+            for p in problems:
+                print(f"    {p}")
+        else:
+            print(f"{d}: ok")
+    return 1 if bad else 0
+
+
+def _cmd_prune(args) -> int:
+    if mf.is_checkpoint_dir(args.path):
+        print(f"{args.path} is a single checkpoint, not a root of "
+              "step_* directories", file=sys.stderr)
+        return 2
+    mgr = CheckpointManager(args.path, keep_last=args.keep)
+    if not mgr.steps():
+        print(f"no checkpoints under {args.path}", file=sys.stderr)
+        return 1
+    for p in mgr.prune():
+        print(f"removed {p}")
+    kept = mgr.steps()
+    print(f"kept {len(kept)} checkpoint(s)"
+          + (f", newest step {kept[-1][0]}" if kept else ""))
+    return 0
+
+
+def main(argv=None) -> int:
+    p = argparse.ArgumentParser(
+        prog="python -m tclb_tpu.checkpoint",
+        description="Inspect, verify and prune tclb checkpoints")
+    sub = p.add_subparsers(dest="cmd", required=True)
+
+    i = sub.add_parser("inspect", help="print manifest summaries")
+    i.add_argument("path", help="checkpoint dir or manager root")
+    i.add_argument("--format", choices=("text", "json"), default="text")
+    i.set_defaults(fn=_cmd_inspect)
+
+    v = sub.add_parser("verify", help="recompute CRCs against manifests")
+    v.add_argument("path", help="checkpoint dir or manager root")
+    v.add_argument("--shallow", action="store_true",
+                   help="skip CRC recomputation (existence+header only)")
+    v.set_defaults(fn=_cmd_verify)
+
+    pr = sub.add_parser("prune", help="apply keep-last-N retention")
+    pr.add_argument("path", help="manager root of step_* directories")
+    pr.add_argument("--keep", type=int, default=3, metavar="N")
+    pr.set_defaults(fn=_cmd_prune)
+
+    args = p.parse_args(argv)
+    if not os.path.exists(args.path):
+        print(f"no such path: {args.path}", file=sys.stderr)
+        return 2
+    return args.fn(args)
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,163 @@
+"""CheckpointManager: retention, auto-resume and async orchestration.
+
+One manager owns one checkpoint root::
+
+    <root>/step_00000500/   step_00001000/   step_00001500.tmp-...
+
+``save`` fences + host-copies on the calling thread, then serializes on
+a background thread (at most one save in flight — a second save first
+drains the previous one).  ``latest()`` walks committed step directories
+newest-first, *verifying* each manifest, and falls back past corrupted
+or truncated checkpoints — the property the kill-resume CI job exercises
+with a real SIGKILL.
+"""
+
+from __future__ import annotations
+
+import os
+import re
+import shutil
+import time
+from typing import Optional
+
+from tclb_tpu import telemetry
+from tclb_tpu.checkpoint import manifest as mf
+from tclb_tpu.checkpoint import restore as rst
+from tclb_tpu.checkpoint import writer
+from tclb_tpu.utils import log
+
+_STEP_RE = re.compile(r"^step_(\d{8,})$")
+
+
+class CheckpointManager:
+    """Keep-last-N checkpoints of one run under ``root``."""
+
+    def __init__(self, root: str, keep_last: int = 3,
+                 async_saves: bool = True):
+        self.root = root
+        self.keep_last = int(keep_last)
+        self.async_saves = bool(async_saves)
+        self._writer = writer.AsyncWriter()
+
+    # -- naming / discovery -------------------------------------------------- #
+
+    def step_path(self, step: int) -> str:
+        return os.path.join(self.root, f"step_{int(step):08d}")
+
+    def steps(self) -> list[tuple[int, str]]:
+        """Committed checkpoints, oldest first, as ``(step, path)``."""
+        out = []
+        try:
+            names = os.listdir(self.root)
+        except OSError:
+            return []
+        for name in names:
+            m = _STEP_RE.match(name)
+            if m:
+                out.append((int(m.group(1)), os.path.join(self.root, name)))
+        return sorted(out)
+
+    def latest(self) -> Optional[str]:
+        """Path of the newest checkpoint that passes verification; skips
+        (with a warning + telemetry event) any that don't."""
+        for step, path in reversed(self.steps()):
+            problems = mf.verify_checkpoint(path)
+            if not problems:
+                return path
+            log.warning(f"checkpoint {path} failed verification "
+                        f"({problems[0]}) — falling back")
+            telemetry.event("checkpoint_invalid", path=path, step=step,
+                            problems=problems[:4])
+        return None
+
+    # -- save ---------------------------------------------------------------- #
+
+    def save(self, lattice, step: Optional[int] = None,
+             extra: Optional[dict] = None, block: bool = False) -> str:
+        """Checkpoint ``lattice`` as step ``step`` (default: its current
+        iteration).  Async mode returns right after the fenced host copy;
+        the CRC/manifest/commit work runs on the background thread."""
+        import jax
+        import numpy as np
+        if step is None:
+            step = int(np.asarray(lattice.state.iteration))
+        step = int(step)
+        multihost = jax.process_count() > 1
+        mode = "sync" if (block or multihost or not self.async_saves) \
+            else "async"
+        with telemetry.span("checkpoint.save", step=step, mode=mode) as sp:
+            captured = rst.capture_lattice(lattice, extra)
+            if mode == "async":
+                self._writer.submit(lambda: self._write(step, captured))
+            else:
+                self._writer.wait()
+                self._write(step, captured, multihost=multihost)
+            sp.add(root=self.root)
+        return self.step_path(step)
+
+    def _write(self, step: int, captured: dict,
+               multihost: bool = False) -> None:
+        t0 = time.perf_counter()
+        final = self.step_path(step)
+        # fixed temp name (no pid): under multi-host every process writes
+        # its shards into the same directory on the shared filesystem
+        tmp = final + ".tmp"
+        if multihost:
+            import jax
+            main = jax.process_index() == 0
+            if main and os.path.isdir(tmp):
+                shutil.rmtree(tmp)
+            self._barrier(f"checkpoint_clean_{step}")
+            os.makedirs(tmp, exist_ok=True)
+            rst.write_shard_fragment(tmp, captured, jax.process_index())
+            self._barrier(f"checkpoint_write_{step}")
+            if not main:
+                return
+            nbytes = rst.write_checkpoint_files(tmp, captured,
+                                                merge_fragments=True)
+        else:
+            if os.path.isdir(tmp):
+                shutil.rmtree(tmp)
+            os.makedirs(tmp)
+            nbytes = rst.write_checkpoint_files(tmp, captured)
+        writer.commit_dir(tmp, final)
+        telemetry.event("checkpoint_committed", step=step, path=final,
+                        bytes=nbytes,
+                        dur_s=round(time.perf_counter() - t0, 6))
+        telemetry.counter("checkpoint.bytes_written", nbytes)
+        telemetry.counter("checkpoint.saves")
+        self.prune()
+
+    @staticmethod
+    def _barrier(tag: str) -> None:
+        try:
+            from jax.experimental import multihost_utils
+            multihost_utils.sync_global_devices(tag)
+        except Exception as e:  # noqa: BLE001 — older jax / no DCN
+            log.warning(f"multi-host checkpoint barrier unavailable: {e!r}")
+
+    # -- restore / retention ------------------------------------------------- #
+
+    def restore(self, lattice, path: Optional[str] = None) -> dict:
+        """Restore from ``path`` (default: ``latest()``); returns the
+        manifest."""
+        if path is None:
+            path = self.latest()
+            if path is None:
+                raise mf.CheckpointError(
+                    f"no valid checkpoint under {self.root}")
+        return rst.restore_lattice(lattice, path)
+
+    def prune(self) -> list[str]:
+        """Apply keep-last-N retention; returns removed paths."""
+        removed = []
+        steps = self.steps()
+        if self.keep_last > 0:
+            for _step, path in steps[:-self.keep_last]:
+                shutil.rmtree(path, ignore_errors=True)
+                removed.append(path)
+        return removed
+
+    def wait(self) -> None:
+        """Drain the in-flight background save (re-raises its error)."""
+        self._writer.wait()

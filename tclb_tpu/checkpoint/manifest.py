@@ -1,0 +1,151 @@
+"""Checkpoint manifests: the integrity contract of a step directory.
+
+A committed checkpoint is a directory::
+
+    step_00001200/
+      manifest.json
+      fields.npy            (unsharded)   or   fields@y0x1.npy ... (sharded)
+      flags.npy
+      settings.npy  zone_table.npy  globals.npy  [time_series.npy ...]
+
+``manifest.json`` records, per array, the file name, CRC32 of the file
+bytes, dtype and shape — plus the saving model's ``Model.fingerprint``,
+the mesh/shard layout and a schema version.  Verification recomputes the
+CRCs; restore refuses a fingerprint that does not match the live model
+(a checkpoint is only meaningful against the exact structural model that
+produced it, the same contract ``supports_diff`` keys on).
+"""
+
+from __future__ import annotations
+
+import json
+import os
+from typing import Any, Optional
+
+import numpy as np
+
+from tclb_tpu.checkpoint import writer
+
+SCHEMA_VERSION = 1
+MANIFEST_NAME = "manifest.json"
+
+
+class CheckpointError(RuntimeError):
+    """A checkpoint is missing, malformed, or fails verification."""
+
+
+def _json_sanitize(obj: Any):
+    for attr in ("item", "tolist"):
+        fn = getattr(obj, attr, None)
+        if callable(fn):
+            try:
+                return fn()
+            except Exception:  # noqa: BLE001
+                continue
+    return str(obj)
+
+
+def build_manifest(*, fingerprint: str, model_name: str, iteration: int,
+                   shape: tuple, dtype: str, mesh_layout: Optional[dict],
+                   arrays: dict, extra: Optional[dict] = None) -> dict:
+    return {
+        "schema": SCHEMA_VERSION,
+        "kind": "tclb_checkpoint",
+        "model": {"name": model_name, "fingerprint": fingerprint},
+        "iteration": int(iteration),
+        "shape": [int(s) for s in shape],
+        "dtype": str(dtype),
+        "mesh": mesh_layout,          # {"axes": {"y": 2, "x": 1}} or None
+        "arrays": arrays,
+        "extra": extra or {},
+    }
+
+
+def write_manifest(dirpath: str, manifest: dict) -> None:
+    # the manifest lives inside a temp step dir whose commit is the atomic
+    # boundary; a plain write here is enough (commit_dir fsyncs it)
+    with open(os.path.join(dirpath, MANIFEST_NAME), "w") as f:
+        json.dump(manifest, f, indent=1, default=_json_sanitize)
+        f.write("\n")
+
+
+def read_manifest(dirpath: str) -> dict:
+    path = os.path.join(dirpath, MANIFEST_NAME)
+    try:
+        with open(path) as f:
+            man = json.load(f)
+    except OSError as e:
+        raise CheckpointError(f"no readable manifest in {dirpath}: {e}") \
+            from e
+    except json.JSONDecodeError as e:
+        raise CheckpointError(f"manifest {path} is not valid JSON: {e}") \
+            from e
+    if not isinstance(man, dict) or man.get("kind") != "tclb_checkpoint":
+        raise CheckpointError(f"{path} is not a tclb checkpoint manifest")
+    if int(man.get("schema", -1)) > SCHEMA_VERSION:
+        raise CheckpointError(
+            f"{path} has schema {man.get('schema')} — newer than this "
+            f"build understands ({SCHEMA_VERSION})")
+    return man
+
+
+def is_checkpoint_dir(path: str) -> bool:
+    return os.path.isdir(path) \
+        and os.path.isfile(os.path.join(path, MANIFEST_NAME))
+
+
+def _npy_header(path: str) -> tuple[str, tuple]:
+    """(dtype, shape) from an ``.npy`` header without loading the data."""
+    arr = np.load(path, mmap_mode="r")
+    return str(arr.dtype), tuple(int(s) for s in arr.shape)
+
+
+def _check_record(dirpath: str, name: str, rec: dict, deep: bool,
+                  problems: list) -> None:
+    path = os.path.join(dirpath, rec["file"])
+    if not os.path.isfile(path):
+        problems.append(f"{name}: missing file {rec['file']}")
+        return
+    if deep:
+        crc = writer.crc32_file(path)
+        if crc != int(rec["crc32"]):
+            problems.append(
+                f"{name}: CRC mismatch in {rec['file']} "
+                f"(manifest {int(rec['crc32']):#010x}, file {crc:#010x})")
+            return
+    try:
+        dtype, shape = _npy_header(path)
+    except Exception as e:  # noqa: BLE001 — truncated/garbled header
+        problems.append(f"{name}: unreadable npy {rec['file']}: {e!r}")
+        return
+    if dtype != rec["dtype"]:
+        problems.append(f"{name}: dtype {dtype} != manifest {rec['dtype']}")
+    if list(shape) != list(rec["shape"]):
+        problems.append(f"{name}: shape {list(shape)} != manifest "
+                        f"{list(rec['shape'])}")
+
+
+def verify_checkpoint(dirpath: str, deep: bool = True) -> list[str]:
+    """Every problem found in one committed checkpoint directory (empty
+    list == valid).  ``deep`` recomputes per-file CRC32s; shallow checks
+    only existence and npy headers."""
+    try:
+        man = read_manifest(dirpath)
+    except CheckpointError as e:
+        return [str(e)]
+    problems: list[str] = []
+    for name, rec in man.get("arrays", {}).items():
+        shards = rec.get("shards")
+        if shards is None:
+            _check_record(dirpath, name, rec, deep, problems)
+            continue
+        covered = 0
+        for srec in shards:
+            _check_record(dirpath, name, srec, deep, problems)
+            covered += int(np.prod(srec["shape"]))
+        total = int(np.prod(rec["shape"]))
+        if covered != total:
+            problems.append(
+                f"{name}: shard files cover {covered} elements of {total} "
+                "— incomplete shard set for this layout")
+    return problems

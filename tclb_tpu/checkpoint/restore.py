@@ -1,0 +1,359 @@
+"""Capture, serialize and restore full solver state.
+
+The split matters for async saves: :func:`capture_lattice` runs on the
+calling thread (fences the device arrays with ``block_until_ready`` and
+pulls host copies — the only part that must see a quiescent device),
+while :func:`write_checkpoint_files` runs on the manager's background
+thread and only touches numpy + the filesystem.
+
+Sharded lattices write one file per shard keyed by mesh coordinates
+(``fields@y0x1.npy``), each with the global index block it covers, so
+restore can stitch the global array back together and re-place it onto
+*any* compatible mesh — the same-or-different-layout restore the
+reference's MPI restart files cannot do.
+"""
+
+from __future__ import annotations
+
+import os
+from typing import Any, Optional
+
+import numpy as np
+
+from tclb_tpu import telemetry
+from tclb_tpu.checkpoint import manifest as mf
+from tclb_tpu.checkpoint import writer
+from tclb_tpu.utils import log
+
+
+class ShardedCapture:
+    """Host-side copies of one sharded array: global dtype/shape plus a
+    list of ``{"coords": {...}, "index": ((lo, hi), ...), "data": np}``."""
+
+    __slots__ = ("dtype", "shape", "shards")
+
+    def __init__(self, dtype: str, shape: tuple, shards: list):
+        self.dtype = dtype
+        self.shape = shape
+        self.shards = shards
+
+
+def _shard_host_copies(arr, mesh) -> ShardedCapture:
+    dims = tuple(int(s) for s in arr.shape)
+    shards, seen = [], set()
+    for sh in arr.addressable_shards:
+        index = tuple(
+            (0 if sl.start is None else int(sl.start),
+             dims[d] if sl.stop is None else int(sl.stop))
+            for d, sl in enumerate(sh.index))
+        if index in seen:     # replicated axis: one copy is enough
+            continue
+        seen.add(index)
+        pos = np.argwhere(mesh.devices == sh.device)
+        coords = ({a: int(pos[0][i]) for i, a in enumerate(mesh.axis_names)}
+                  if len(pos) else {})
+        shards.append({"coords": coords, "index": index,
+                       "data": np.asarray(sh.data)})
+    return ShardedCapture(str(arr.dtype), dims, shards)
+
+
+def capture_lattice(lattice, extra: Optional[dict] = None) -> dict:
+    """Fence + host-copy everything a checkpoint needs (runs on the
+    calling thread; the result is plain numpy, safe to serialize on a
+    background thread)."""
+    import jax
+    state, params = lattice.state, lattice.params
+    jax.block_until_ready((state.fields, state.flags, state.globals_))
+    mesh = lattice.mesh
+    arrays: dict[str, Any] = {}
+    if mesh is not None:
+        arrays["fields"] = _shard_host_copies(state.fields, mesh)
+        arrays["flags"] = _shard_host_copies(state.flags, mesh)
+    else:
+        arrays["fields"] = np.asarray(state.fields)
+        arrays["flags"] = np.asarray(state.flags)
+    arrays["globals"] = np.asarray(state.globals_)
+    arrays["settings"] = np.asarray(params.settings)
+    arrays["zone_table"] = np.asarray(params.zone_table)
+    if params.time_series is not None:
+        arrays["time_series"] = np.asarray(params.time_series)
+        arrays["series_map"] = np.asarray(params.series_map, dtype=np.int64)
+    full_extra = {"avg_start": int(lattice.avg_start)}
+    full_extra.update(extra or {})
+    mesh_layout = None
+    if mesh is not None:
+        mesh_layout = {"axes": {a: int(s) for a, s in
+                                zip(mesh.axis_names, mesh.devices.shape)}}
+    return {
+        "arrays": arrays,
+        "fingerprint": lattice.model.fingerprint,
+        "model_name": lattice.model.name,
+        "iteration": int(np.asarray(state.iteration)),
+        "shape": lattice.shape,
+        "dtype": str(np.dtype(lattice.dtype)),
+        "mesh": mesh_layout,
+        "extra": full_extra,
+    }
+
+
+def _shard_tag(coords: dict) -> str:
+    return "".join(f"{a}{coords[a]}" for a in sorted(coords)) or "p0"
+
+
+def _write_shards(dirpath: str, name: str, val: ShardedCapture
+                  ) -> tuple[list, int]:
+    shards, total = [], 0
+    for sh in val.shards:
+        fname = f"{name}@{_shard_tag(sh['coords'])}.npy"
+        rec = writer.write_npy(os.path.join(dirpath, fname), sh["data"])
+        rec["coords"] = sh["coords"]
+        rec["index"] = [[int(a), int(b)] for a, b in sh["index"]]
+        shards.append(rec)
+        total += rec["nbytes"]
+    return shards, total
+
+
+def write_shard_fragment(dirpath: str, captured: dict, proc: int) -> int:
+    """Multi-host: write this process's addressable shards plus a JSON
+    fragment of their manifest records (merged by the main process)."""
+    import json
+    frag: dict[str, list] = {}
+    total = 0
+    for name, val in captured["arrays"].items():
+        if isinstance(val, ShardedCapture):
+            frag[name], nb = _write_shards(dirpath, name, val)
+            total += nb
+    with open(os.path.join(dirpath, f"fragment.{proc}.json"), "w") as f:
+        json.dump(frag, f)
+    return total
+
+
+def write_checkpoint_files(dirpath: str, captured: dict,
+                           merge_fragments: bool = False) -> int:
+    """Serialize a capture into ``dirpath`` (already existing, typically a
+    temp step dir) + its manifest; returns total array bytes written.
+
+    With ``merge_fragments`` (multi-host main process), sharded arrays
+    are assumed already written — this process's via
+    :func:`write_shard_fragment`, peers' via theirs — and their records
+    are merged from the fragment files instead of re-written."""
+    import json
+    records: dict[str, dict] = {}
+    total = 0
+    fragments: dict[str, list] = {}
+    if merge_fragments:
+        for fname in sorted(os.listdir(dirpath)):
+            if fname.startswith("fragment.") and fname.endswith(".json"):
+                with open(os.path.join(dirpath, fname)) as f:
+                    for name, shards in json.load(f).items():
+                        fragments.setdefault(name, []).extend(shards)
+                os.unlink(os.path.join(dirpath, fname))
+    for name, val in captured["arrays"].items():
+        if isinstance(val, ShardedCapture):
+            if merge_fragments:
+                seen: set = set()
+                shards = []
+                for rec in fragments.get(name, []):
+                    key = tuple(tuple(p) for p in rec["index"])
+                    if key not in seen:
+                        seen.add(key)
+                        shards.append(rec)
+                total += sum(int(r["nbytes"]) for r in shards)
+            else:
+                shards, nb = _write_shards(dirpath, name, val)
+                total += nb
+            records[name] = {"dtype": val.dtype,
+                             "shape": [int(s) for s in val.shape],
+                             "shards": shards}
+        else:
+            rec = writer.write_npy(os.path.join(dirpath, f"{name}.npy"), val)
+            records[name] = rec
+            total += rec["nbytes"]
+    man = mf.build_manifest(
+        fingerprint=captured["fingerprint"],
+        model_name=captured["model_name"],
+        iteration=captured["iteration"],
+        shape=captured["shape"],
+        dtype=captured["dtype"],
+        mesh_layout=captured["mesh"],
+        arrays=records,
+        extra=captured["extra"])
+    mf.write_manifest(dirpath, man)
+    return total
+
+
+def save_checkpoint(dirpath: str, lattice, extra: Optional[dict] = None
+                    ) -> str:
+    """One-shot synchronous checkpoint of ``lattice`` into directory
+    ``dirpath`` (atomic: written to a temp dir, then committed)."""
+    import shutil
+    with telemetry.span("checkpoint.save", mode="sync",
+                        path=dirpath) as sp:
+        captured = capture_lattice(lattice, extra)
+        tmp = dirpath.rstrip("/") + ".tmp"
+        if os.path.isdir(tmp):
+            shutil.rmtree(tmp)
+        os.makedirs(tmp)
+        nbytes = write_checkpoint_files(tmp, captured)
+        writer.commit_dir(tmp, dirpath)
+        sp.add(bytes=nbytes, step=captured["iteration"])
+        telemetry.counter("checkpoint.bytes_written", nbytes)
+        telemetry.counter("checkpoint.saves")
+    return dirpath
+
+
+def _load_array(dirpath: str, rec: dict) -> np.ndarray:
+    shards = rec.get("shards")
+    if shards is None:
+        return np.load(os.path.join(dirpath, rec["file"]))
+    out = np.empty(tuple(rec["shape"]), dtype=np.dtype(rec["dtype"]))
+    for srec in shards:
+        block = tuple(slice(int(a), int(b)) for a, b in srec["index"])
+        out[block] = np.load(os.path.join(dirpath, srec["file"]))
+    return out
+
+
+def restore_lattice(lattice, dirpath: str, verify: bool = True) -> dict:
+    """Restore a lattice from a committed checkpoint directory; returns
+    the manifest (its ``extra`` carries handler/solver state).
+
+    The stitched global arrays are re-placed through the lattice's own
+    mesh, so a checkpoint saved on one layout restores onto any
+    compatible one (including unsharded).
+    """
+    import jax.numpy as jnp
+
+    from tclb_tpu.core.lattice import FLAG_DTYPE, LatticeState, SimParams
+    with telemetry.span("checkpoint.restore", path=dirpath) as sp:
+        if verify:
+            problems = mf.verify_checkpoint(dirpath)
+            if problems:
+                raise mf.CheckpointError(
+                    f"checkpoint {dirpath} failed verification: "
+                    + "; ".join(problems))
+        man = mf.read_manifest(dirpath)
+        fp = man["model"]["fingerprint"]
+        if fp != lattice.model.fingerprint:
+            raise mf.CheckpointError(
+                f"checkpoint {dirpath} was saved by model "
+                f"{man['model']['name']} (fingerprint {fp}); live model is "
+                f"{lattice.model.name} ({lattice.model.fingerprint})")
+        if tuple(man["shape"]) != tuple(lattice.shape):
+            raise mf.CheckpointError(
+                f"checkpoint shape {tuple(man['shape'])} != lattice shape "
+                f"{tuple(lattice.shape)}")
+        recs = man["arrays"]
+        fields = _load_array(dirpath, recs["fields"])
+        flags = _load_array(dirpath, recs["flags"])
+        nbytes = fields.nbytes + flags.nbytes
+        lattice._fast_tried = False   # restored flags may paint new types
+        lattice._iterate_cached = None
+        lattice._host_flags = np.asarray(flags, dtype=np.uint16)
+        lattice.state = LatticeState(
+            fields=jnp.asarray(fields, dtype=lattice.dtype),
+            flags=jnp.asarray(flags, dtype=FLAG_DTYPE),
+            globals_=jnp.asarray(_load_array(dirpath, recs["globals"]),
+                                 dtype=lattice.dtype),
+            iteration=jnp.asarray(int(man["iteration"]), dtype=jnp.int32),
+        )
+        lattice._series = {}
+        ts, smap = None, ()
+        if "time_series" in recs:
+            ts_np = _load_array(dirpath, recs["time_series"])
+            smap_np = _load_array(dirpath, recs["series_map"])
+            ts = jnp.asarray(ts_np, dtype=lattice.dtype)
+            smap = tuple(tuple(int(v) for v in row) for row in smap_np)
+            for si, z, r in smap:
+                lattice._series[(si, z)] = np.asarray(ts_np[r])
+        lattice.params = SimParams(
+            settings=jnp.asarray(_load_array(dirpath, recs["settings"]),
+                                 dtype=lattice.dtype),
+            zone_table=jnp.asarray(_load_array(dirpath, recs["zone_table"]),
+                                   dtype=lattice.dtype),
+            time_series=ts, series_map=smap)
+        if lattice._place is not None:
+            lattice.state, lattice.params = lattice._place()
+        lattice.avg_start = int(man.get("extra", {}).get("avg_start", 0))
+        sp.add(step=int(man["iteration"]), bytes=nbytes)
+        telemetry.counter("checkpoint.bytes_read", nbytes)
+        telemetry.counter("checkpoint.restores")
+    return man
+
+
+def load_any(lattice, path: str) -> Optional[dict]:
+    """Restore from either a checkpoint directory (returns its manifest)
+    or a legacy ``.npz`` save (returns None) — the LoadBinary handler's
+    single entry point."""
+    if mf.is_checkpoint_dir(path):
+        return restore_lattice(lattice, path)
+    legacy = mf.is_checkpoint_dir(writer.strip_suffix(path, ".npz"))
+    if legacy:
+        return restore_lattice(lattice, writer.strip_suffix(path, ".npz"))
+    lattice.load(writer.strip_suffix(path, ".npz"))
+    return None
+
+
+# -- solver-side glue (duck-typed: no import of the control layer) ----------- #
+
+
+def collect_solver_state(solver) -> dict:
+    """The ``extra`` dict a full-run checkpoint records: averaging
+    accumulator origin, optimizer iteration, and every stacked handler's
+    ``restorable_state()`` plus its schedule anchor, keyed by the
+    handler's deterministic config-order key."""
+    handlers: dict[str, dict] = {}
+    stack = list(getattr(solver, "solve_stack", []))
+    for h in list(solver.hands) + stack:
+        key = getattr(h, "ck_key", None)
+        if key is None or key in handlers:
+            continue
+        if getattr(h, "kind", "action") == "action" and h not in stack:
+            # a COMPLETED periodic action (a <Solve> that already returned
+            # but still sits in the callback stack for chunk alignment):
+            # its schedule anchor is spent — recording it would re-anchor
+            # a later run's same-keyed action to the old origin
+            continue
+        st = dict(h.restorable_state() or {})
+        st["__start_iter"] = int(h.start_iter)
+        handlers[key] = st
+    return {"avg_start": int(solver.lattice.avg_start),
+            "opt_iter": int(solver.opt_iter),
+            "iter": int(solver.iter),
+            "handlers": handlers}
+
+
+def apply_restored_solver_state(solver, manifest: Optional[dict]) -> None:
+    """Reconcile the Solver clock and handler schedules with a freshly
+    restored lattice iteration.
+
+    Handlers recorded in the checkpoint get their exact saved
+    ``start_iter`` + ``restorable_state`` back (so a resumed ``<Solve
+    Iterations="N">`` completes to the same absolute iteration as the
+    uninterrupted run).  Handlers the checkpoint doesn't know — including
+    every handler after a plain ``LoadBinary`` of a legacy ``.npz`` —
+    are shifted by the clock jump instead, so ``every=`` firing stays
+    aligned relative to their own start.  States for handlers that
+    initialize later in the config replay are parked on
+    ``solver._pending_restore`` and applied as they come up.
+    """
+    restored = int(np.asarray(solver.lattice.state.iteration))
+    delta = restored - solver.iter
+    solver.iter = restored
+    extra = (manifest or {}).get("extra", {})
+    solver.opt_iter = int(extra.get("opt_iter", solver.opt_iter))
+    states = dict(extra.get("handlers") or {})
+    for h in list(solver.hands) + list(getattr(solver, "solve_stack", [])):
+        key = getattr(h, "ck_key", None)
+        st = states.pop(key, None) if key is not None else None
+        if st is not None:
+            if "__start_iter" in st:
+                h.start_iter = int(st["__start_iter"])
+            h.restore_state({k: v for k, v in st.items()
+                             if not k.startswith("__")})
+        elif delta:
+            h.start_iter += delta
+    if states:
+        solver._pending_restore.update(states)
+    if delta:
+        log.notice(f"restored state at iteration {restored} "
+                   f"(clock jumped by {delta:+d})")

@@ -1,0 +1,187 @@
+"""Atomic file plumbing + the async background writer.
+
+Everything that touches the filesystem on the save path goes through
+here: the temp+fsync+rename discipline (no output file can ever be
+observed half-written — also adopted by ``Solver.write_txt``/
+``write_bin``), the centralized filename-suffix normalization that the
+SaveBinary/LoadBinary handlers previously juggled inline (``fn[:-4]``
+broke for stems containing a dot), and the one-save-in-flight background
+thread that :class:`~tclb_tpu.checkpoint.manager.CheckpointManager`
+serializes on.
+"""
+
+from __future__ import annotations
+
+import contextlib
+import os
+import threading
+import zlib
+from typing import Callable, Iterator, Optional
+
+import numpy as np
+
+
+# -- path normalization ------------------------------------------------------- #
+# One place for the ".npz"/".npy" suffix rules: a suffix is only ever the
+# exact trailing extension, never "the last 4 characters", so stems with
+# dots ("state.v2", "run.best") survive a save/load round trip.
+
+
+def with_suffix(path: str, ext: str) -> str:
+    """``path`` guaranteed to end with ``ext`` (appended when absent)."""
+    return path if path.endswith(ext) else path + ext
+
+
+def strip_suffix(path: str, ext: str) -> str:
+    """``path`` with one trailing ``ext`` removed (only if present)."""
+    return path[:-len(ext)] if path.endswith(ext) else path
+
+
+def resolve_npz(path: str) -> str:
+    """The on-disk file a legacy ``.npz`` reference points at: the path
+    itself when it exists (or already carries the suffix), else the
+    suffixed variant ``np.savez`` would have produced."""
+    if path.endswith(".npz") or os.path.exists(path):
+        return path
+    return path + ".npz"
+
+
+# -- atomic writes ------------------------------------------------------------ #
+
+
+def _fsync_file(path: str) -> None:
+    fd = os.open(path, os.O_RDONLY)
+    try:
+        os.fsync(fd)
+    finally:
+        os.close(fd)
+
+
+def _fsync_dir(path: str) -> None:
+    try:
+        fd = os.open(path, os.O_RDONLY)
+    except OSError:
+        return
+    try:
+        os.fsync(fd)
+    except OSError:
+        pass   # some filesystems refuse fsync on directories
+    finally:
+        os.close(fd)
+
+
+@contextlib.contextmanager
+def atomic_path(path: str) -> Iterator[str]:
+    """Yield a temp path; on clean exit fsync it and rename onto ``path``.
+
+    The rename is atomic on POSIX, so readers see either the old file or
+    the complete new one — never a torn write.  On error the temp file is
+    removed and nothing replaces ``path``.
+    """
+    d = os.path.dirname(os.path.abspath(path))
+    os.makedirs(d, exist_ok=True)
+    tmp = f"{path}.tmp-{os.getpid()}"
+    try:
+        yield tmp
+        _fsync_file(tmp)
+        os.replace(tmp, path)
+        _fsync_dir(d)
+    except BaseException:
+        with contextlib.suppress(OSError):
+            os.unlink(tmp)
+        raise
+
+
+def atomic_write_bytes(path: str, data: bytes) -> None:
+    with atomic_path(path) as tmp:
+        with open(tmp, "wb") as f:
+            f.write(data)
+
+
+def write_npy(path: str, arr: np.ndarray) -> dict:
+    """Write one ``.npy`` file (no suffix games: the open file object is
+    handed to ``np.save``) and return its manifest record."""
+    arr = np.ascontiguousarray(arr)
+    with open(path, "wb") as f:
+        np.save(f, arr)
+        f.flush()
+        os.fsync(f.fileno())
+    return {"file": os.path.basename(path),
+            "crc32": crc32_file(path),
+            "dtype": str(arr.dtype),
+            "shape": [int(s) for s in arr.shape],
+            "nbytes": int(arr.nbytes)}
+
+
+def crc32_file(path: str, chunk: int = 1 << 22) -> int:
+    """Streaming CRC32 of a file's bytes (what the manifest records and
+    verification recomputes)."""
+    crc = 0
+    with open(path, "rb") as f:
+        while True:
+            buf = f.read(chunk)
+            if not buf:
+                break
+            crc = zlib.crc32(buf, crc)
+    return crc & 0xFFFFFFFF
+
+
+def commit_dir(tmp_dir: str, final_dir: str) -> None:
+    """Atomically promote a fully-written temp step directory: fsync its
+    contents, rename into place, fsync the parent.
+
+    An existing ``final_dir`` (a re-save of a step the run already
+    passed — e.g. after resuming below a corrupted checkpoint) is
+    removed first; ``os.replace`` cannot rename onto a non-empty
+    directory, so this one case trades the atomic swap for a brief
+    window in which the step is absent rather than torn."""
+    import shutil
+    for name in os.listdir(tmp_dir):
+        _fsync_file(os.path.join(tmp_dir, name))
+    _fsync_dir(tmp_dir)
+    if os.path.isdir(final_dir):
+        shutil.rmtree(final_dir)
+    os.replace(tmp_dir, final_dir)
+    _fsync_dir(os.path.dirname(os.path.abspath(final_dir)))
+
+
+# -- async serialization ------------------------------------------------------ #
+
+
+class AsyncWriter:
+    """At most one background save in flight.
+
+    ``submit`` first drains any previous job (so two saves can never
+    interleave in one checkpoint root), then runs ``fn`` on a daemon
+    thread.  Errors are captured and re-raised on the *next* ``wait()``
+    — a failed background save must not kill the solve loop, but it must
+    not stay silent either.
+    """
+
+    def __init__(self) -> None:
+        self._thread: Optional[threading.Thread] = None
+        self._error: Optional[BaseException] = None
+
+    def submit(self, fn: Callable[[], None]) -> None:
+        self.wait()
+
+        def run() -> None:
+            try:
+                fn()
+            except BaseException as e:  # noqa: BLE001 — surfaced on wait()
+                self._error = e
+
+        self._thread = threading.Thread(target=run, daemon=True,
+                                        name="tclb-checkpoint-writer")
+        self._thread.start()
+
+    def busy(self) -> bool:
+        return self._thread is not None and self._thread.is_alive()
+
+    def wait(self) -> None:
+        if self._thread is not None:
+            self._thread.join()
+            self._thread = None
+        if self._error is not None:
+            err, self._error = self._error, None
+            raise err

@@ -12,6 +12,7 @@ Handlers run host-side; everything device-bound goes through the Lattice.
 from __future__ import annotations
 
 import math
+import os
 import re
 import xml.etree.ElementTree as ET
 from typing import Optional
@@ -27,19 +28,33 @@ class Handler:
     """Base scheduling unit (reference vHandler, src/Handlers.h:24-78)."""
 
     kind = "action"   # action | callback | container | design
+    # handlers with mutable numeric run-state must either implement
+    # restorable_state()/restore_state() or set this marker (enforced by
+    # the hygiene.unrestorable_handler static check)
+    checkpoint_exempt = False
 
     def __init__(self, node: ET.Element, solver: Solver):
         self.node = node
         self.solver = solver
         self.start_iter = 0
         self.every_iter = 0.0
+        self.ck_key: Optional[str] = None
 
     # -- schedule ----------------------------------------------------------- #
 
     def _parse_interval(self) -> None:
+        # deterministic config-order key: the same document always yields
+        # the same keys, so checkpointed handler state finds its handler
+        # again on a resume replay
+        self.ck_key = self.solver.next_ck_key(type(self).__name__)
         self.start_iter = self.solver.iter
         attr = self.node.get("Iterations")
         self.every_iter = self.solver.units.alt(attr) if attr else 0.0
+        # a resume restores each recorded handler's schedule anchor before
+        # its init body runs (init may immediately start a Solve loop)
+        st = self.solver._pending_restore.get(self.ck_key)
+        if st is not None and "__start_iter" in st:
+            self.start_iter = int(st["__start_iter"])
 
     def now(self, it: int) -> bool:
         """True when ``it`` is a firing iteration (reference vHandler::Now:
@@ -72,6 +87,18 @@ class Handler:
     def finish(self) -> int:
         return 0
 
+    # -- checkpoint protocol ------------------------------------------------- #
+
+    def restorable_state(self) -> dict:
+        """Mutable run-state a full-run checkpoint must capture (must be
+        JSON-serializable).  The default is stateless; any handler whose
+        ``do_it`` mutates numeric attributes overrides this (the
+        ``hygiene.unrestorable_handler`` static check enforces it)."""
+        return {}
+
+    def restore_state(self, state: dict) -> None:
+        """Re-apply a dict previously produced by ``restorable_state``."""
+
 
 class GenericAction(Handler):
     """Container executing children immediately; periodic children stack
@@ -91,6 +118,14 @@ class GenericAction(Handler):
             ret = h.init()
             if ret not in (0, None):
                 return ret
+            # a pending resume state for this handler (parked by
+            # apply_restored_solver_state) lands after init so the init
+            # body can't clobber the restored values
+            st = self.solver._pending_restore.pop(
+                getattr(h, "ck_key", None) or "", None)
+            if st is not None:
+                h.restore_state({k: v for k, v in st.items()
+                                 if not k.startswith("__")})
             if h.every_iter or h.kind == "design":
                 self.solver.hands.append(h)
                 self._stacked += 1
@@ -135,32 +170,39 @@ class acSolve(GenericAction):
             return ret
         s = self.solver
         stop = False
-        while True:
-            next_it = self.next_it(s.iter)
-            for h in s.hands:
-                it = h.next_it(s.iter)
-                if 0 < it < next_it:
-                    next_it = it
-            steps = next_it
-            s.iter += steps
-            s.update_synthetic_turbulence(steps)
-            s.lattice.iterate(steps)
-            s.progress(steps)
-            for h in s.hands:
-                if h.now(s.iter):
-                    # each periodic callback runs under its own span, so
-                    # a trace attributes Solve wall-time between lattice
-                    # iteration and VTK/Log/Failcheck/... output work
-                    with telemetry.span("handler",
-                                        handler=type(h).__name__,
-                                        iteration=s.iter):
-                        r = h.do_it()
-                    if r == ITERATION_STOP:
-                        stop = True
-                    elif r not in (0, None):
-                        return r
-            if stop or self.now(s.iter):
-                break
+        # visible to checkpoint collection: the running Solve's schedule
+        # anchor must be saved so a resume replay completes to the same
+        # absolute iteration instead of restarting its count
+        s.solve_stack.append(self)
+        try:
+            while True:
+                next_it = self.next_it(s.iter)
+                for h in s.hands:
+                    it = h.next_it(s.iter)
+                    if 0 < it < next_it:
+                        next_it = it
+                steps = next_it
+                s.iter += steps
+                s.update_synthetic_turbulence(steps)
+                s.lattice.iterate(steps)
+                s.progress(steps)
+                for h in s.hands:
+                    if h.now(s.iter):
+                        # each periodic callback runs under its own span, so
+                        # a trace attributes Solve wall-time between lattice
+                        # iteration and VTK/Log/Failcheck/... output work
+                        with telemetry.span("handler",
+                                            handler=type(h).__name__,
+                                            iteration=s.iter):
+                            r = h.do_it()
+                        if r == ITERATION_STOP:
+                            stop = True
+                        elif r not in (0, None):
+                            return r
+                if stop or self.now(s.iter):
+                    break
+        finally:
+            s.solve_stack.pop()
         self.unstack()
         return 0
 
@@ -489,6 +531,16 @@ class cbStop(Handler):
             return ITERATION_STOP
         return 0
 
+    def restorable_state(self) -> dict:
+        return {"old": {k: float(v) for k, v in self.old.items()},
+                "score": int(self.score)}
+
+    def restore_state(self, state: dict) -> None:
+        for k, v in state.get("old", {}).items():
+            if k in self.old:
+                self.old[k] = float(v)
+        self.score = int(state.get("score", 0))
+
 
 class cbFailcheck(Handler):
     """<Failcheck Iterations="N">: NaN scan of quantities; on failure run
@@ -566,6 +618,16 @@ class cbSample(Handler):
         self.solver.lattice.sampler = None
         return 0
 
+    def restorable_state(self) -> dict:
+        # flush so no buffered probe rows die with the process; the header
+        # flag makes a resumed run append to the CSV instead of rewriting
+        self.sampler.flush()
+        return {"wrote_header": bool(self.sampler._wrote_header)}
+
+    def restore_state(self, state: dict) -> None:
+        if state.get("wrote_header"):
+            self.sampler._wrote_header = True
+
 
 class cbKeep(Handler):
     """<Keep What="..." Above=|Below=|Equal=...>: feedback controller pinning
@@ -604,21 +666,35 @@ class cbKeep(Handler):
 
 
 class cbSaveBinary(Handler):
+    """<SaveBinary [comp=f[i]] [filename=...]>, re-backed onto the
+    checkpoint subsystem: path suffixes go through its centralized
+    normalization (an exact-extension rule — stems containing dots no
+    longer confuse the old ``fn[:-4]`` juggling), every write is atomic,
+    and a filename *without* the legacy ``.npz`` suffix saves the new
+    manifest-verified checkpoint directory format."""
+
     kind = "callback"
 
     def do_it(self) -> int:
+        from tclb_tpu import checkpoint as ckpt
         s = self.solver
         comp = self.node.get("comp")
         if comp:
             # per-component dump (reference saveComp,
             # src/Solver.cpp.Rt:480-510: one density -> one .comp file)
-            fn = self.node.get("filename") \
-                or s.out_path(f"Save_{comp}", "npy")
-            np.save(fn if fn.endswith(".npy") else fn + ".npy",
-                    np.asarray(s.lattice.get_density(comp)))
+            fn = ckpt.with_suffix(self.node.get("filename")
+                                  or s.out_path(f"Save_{comp}", "npy"),
+                                  ".npy")
+            with ckpt.atomic_path(fn) as tmp:
+                with open(tmp, "wb") as f:
+                    np.save(f, np.asarray(s.lattice.get_density(comp)))
             return 0
         fn = self.node.get("filename") or s.out_path("Save", "npz")
-        s.lattice.save(fn[:-4] if fn.endswith(".npz") else fn)
+        if fn.endswith(".npz"):
+            s.lattice.save(fn)      # legacy single-file format (atomic)
+        else:
+            ckpt.save_checkpoint(fn, s.lattice,
+                                 extra=ckpt.collect_solver_state(s))
         return 0
 
     def init(self) -> int:
@@ -629,20 +705,94 @@ class cbSaveBinary(Handler):
 
 
 class acLoadBinary(Handler):
+    """<LoadBinary filename=... [comp=f[i]]>: restore a SaveBinary dump —
+    either the manifest-verified checkpoint directory format or a legacy
+    ``.npz`` — and reconcile the Solver clock with the restored lattice
+    iteration so ``every=``-based handlers keep firing on schedule after
+    a restart (previously the solver stayed at its old count while the
+    lattice jumped, and Control series/Log output went misaligned)."""
+
     def init(self) -> int:
         super().init()
         fn = self.node.get("filename")
         if not fn:
             raise ValueError("LoadBinary needs filename=")
+        from tclb_tpu import checkpoint as ckpt
         comp = self.node.get("comp")
         if comp:
             # per-component restore (reference loadComp,
             # src/Solver.cpp.Rt:512-545); mirror SaveBinary's suffixing
-            if not fn.endswith(".npy"):
-                fn = fn + ".npy"
-            self.solver.lattice.set_density(comp, np.load(fn))
+            self.solver.lattice.set_density(
+                comp, np.load(ckpt.with_suffix(fn, ".npy")))
             return 0
-        self.solver.lattice.load(fn)
+        man = ckpt.load_any(self.solver.lattice, fn)
+        ckpt.apply_restored_solver_state(self.solver, man)
+        return 0
+
+
+class cbSaveCheckpoint(Handler):
+    """<SaveCheckpoint Iterations="N" [dir=...] [keep="3"] [mode="async"]>:
+    periodic full-run checkpoints through
+    :class:`tclb_tpu.checkpoint.CheckpointManager` — atomic, CRC-verified,
+    keep-last-N, serialized off-thread (``mode="sync"`` forces blocking
+    saves).  Captures lattice state *plus* solver/handler run-state
+    (averaging origin, optimizer iteration, every stacked handler's
+    ``restorable_state``).
+
+    This handler is also the resume point: when the solver carries a
+    ``--resume`` request, its init restores from the requested checkpoint
+    (default: the manager's newest *valid* one — corrupted checkpoints
+    are skipped) before any <Solve> runs."""
+
+    kind = "callback"
+
+    def init(self) -> int:
+        super().init()
+        s = self.solver
+        from tclb_tpu.checkpoint import CheckpointManager
+        root = self.node.get("dir")
+        if not root:
+            base = s.output_prefix
+            if base.endswith("/"):
+                os.makedirs(base, exist_ok=True)
+                base = os.path.join(base, s.conf_name)
+            root = base + "_checkpoint"
+        mode = (self.node.get("mode", "async") or "async").lower()
+        self.manager = CheckpointManager(
+            root, keep_last=int(self.node.get("keep", "3")),
+            async_saves=mode != "sync")
+        if s.resume_from is not None:
+            self._resume()
+        return 0
+
+    def _resume(self) -> None:
+        s = self.solver
+        from tclb_tpu import checkpoint as ckpt
+        target, s.resume_from = s.resume_from, None
+        if isinstance(target, str) and target not in ("", "latest", "auto"):
+            path = target
+            if not ckpt.is_checkpoint_dir(path):
+                raise ValueError(
+                    f"--resume: {path} is not a checkpoint directory")
+        else:
+            path = self.manager.latest()
+        if path is None:
+            log.notice("resume requested but no valid checkpoint under "
+                       f"{self.manager.root} — starting cold")
+            return
+        man = ckpt.restore_lattice(s.lattice, path)
+        ckpt.apply_restored_solver_state(s, man)
+        log.notice(f"resumed from {path} at iteration {s.iter}")
+
+    def do_it(self) -> int:
+        s = self.solver
+        from tclb_tpu.checkpoint import collect_solver_state
+        self.manager.save(s.lattice, step=s.iter,
+                          extra=collect_solver_state(s))
+        return 0
+
+    def finish(self) -> int:
+        self.manager.wait()
         return 0
 
 
@@ -828,6 +978,7 @@ _HANDLERS = {
     "Keep": cbKeep,
     "SaveBinary": cbSaveBinary,
     "SaveMemoryDump": cbSaveBinary,
+    "SaveCheckpoint": cbSaveCheckpoint,
     "LoadBinary": acLoadBinary,
     "LoadMemoryDump": acLoadBinary,
     "DumpSettings": cbDumpSettings,

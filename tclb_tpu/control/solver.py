@@ -57,6 +57,20 @@ class Solver:
         self.conf_name = "run"
         self.stop_flag = False
         self.synthetic_turbulence = None   # set by <SyntheticTurbulence>
+        # checkpoint/restart plumbing (tclb_tpu.checkpoint)
+        self.resume_from: Optional[str] = None  # --resume target, consumed
+        self.solve_stack: list = []    # acSolve handlers currently running
+        self._pending_restore: dict = {}   # ck_key -> restored handler state
+        self._ck_counts: dict = {}     # class name -> instances seen so far
+
+    def next_ck_key(self, cls_name: str) -> str:
+        """Deterministic per-handler checkpoint key: Nth instance of a
+        handler class in config order gets ``"<Class>#<N>"``.  Stable
+        across runs of the same config, which is what lets a checkpoint's
+        per-handler state find its owner on resume."""
+        n = self._ck_counts.get(cls_name, 0)
+        self._ck_counts[cls_name] = n + 1
+        return f"{cls_name}#{n}"
 
     # -- naming (reference Solver::outIterFile/outGlobalFile) --------------- #
 
@@ -259,6 +273,8 @@ class Solver:
         """Per-quantity text dumps (reference cbTXT/writeTXT gzip path,
         src/Solver.cpp.Rt:228-260)."""
         import gzip
+
+        from tclb_tpu.checkpoint.writer import atomic_path
         if not self.is_main:
             return []
         paths = []
@@ -267,11 +283,12 @@ class Solver:
                 p = self.out_path(f"TXT_{name}",
                                   "txt.gz" if gzip_out else "txt")
                 a2 = arr.reshape(-1, arr.shape[-1])
-                if gzip_out:
-                    with gzip.open(p, "wt") as f:
-                        np.savetxt(f, a2)
-                else:
-                    np.savetxt(p, a2)
+                with atomic_path(p) as tmp:
+                    if gzip_out:
+                        with gzip.open(tmp, "wt") as f:
+                            np.savetxt(f, a2)
+                    else:
+                        np.savetxt(tmp, a2)
                 paths.append(p)
         return paths
 
@@ -282,7 +299,7 @@ class Solver:
             return None
         p = self.out_path("BIN", "npz")
         with telemetry.span("output.bin", iteration=self.iter):
-            self.lattice.save(p[:-4])
+            self.lattice.save(p)
         return p
 
 
@@ -310,20 +327,24 @@ def _read_units(root: ET.Element, solver: Solver) -> None:
 
 def run_config_string(xml_text: str, model: Model, mesh: Any = None,
                       dtype: Any = None, output: Optional[str] = None,
-                      conf_name: str = "run") -> Solver:
+                      conf_name: str = "run",
+                      resume: Optional[str] = None) -> Solver:
     root = ET.fromstring(xml_text)
-    return _run_root(root, model, mesh, dtype, output, conf_name)
+    return _run_root(root, model, mesh, dtype, output, conf_name,
+                     resume=resume)
 
 
 def run_config(path: str, model: Model, mesh: Any = None,
-               dtype: Any = None, output: Optional[str] = None) -> Solver:
+               dtype: Any = None, output: Optional[str] = None,
+               resume: Optional[str] = None) -> Solver:
     root = ET.parse(path).getroot()
     name = os.path.splitext(os.path.basename(path))[0]
-    return _run_root(root, model, mesh, dtype, output, name)
+    return _run_root(root, model, mesh, dtype, output, name, resume=resume)
 
 
 def _run_root(root: ET.Element, model: Model, mesh, dtype,
-              output: Optional[str], conf_name: str) -> Solver:
+              output: Optional[str], conf_name: str,
+              resume: Optional[str] = None) -> Solver:
     from tclb_tpu.control.handlers import MainContainer
     if root.tag != "CLBConfig":
         raise ValueError(f"config root must be <CLBConfig>, got <{root.tag}>")
@@ -331,6 +352,7 @@ def _run_root(root: ET.Element, model: Model, mesh, dtype,
                     output=output or root.get("output", "output/"),
                     mesh=mesh, dtype=dtype)
     solver.conf_name = conf_name
+    solver.resume_from = resume
     _read_units(root, solver)
     geom = root.find("Geometry")
     if geom is None:
@@ -344,4 +366,8 @@ def _run_root(root: ET.Element, model: Model, mesh, dtype,
                  int(round(solver.units.alt(geom.get("nx", "1")))))
     solver.set_size(shape)
     MainContainer(root, solver).init()
+    if solver.resume_from is not None:
+        from tclb_tpu.utils import log
+        log.warning("--resume was given but the config has no "
+                    "<SaveCheckpoint> handler — nothing was restored")
     return solver

@@ -1100,22 +1100,34 @@ class Lattice:
 
     def save(self, path: str) -> None:
         """Full-state dump (reference Lattice::save, src/Lattice.cu.Rt:592-626),
-        including any Control time series."""
+        including any Control time series.  Legacy ``.npz`` format, written
+        atomically (temp + fsync + rename) through the checkpoint
+        subsystem's writer so a kill mid-save never corrupts an existing
+        copy; the manifest-verified directory format lives in
+        :mod:`tclb_tpu.checkpoint`."""
+        from tclb_tpu.checkpoint.writer import atomic_path, with_suffix
         extra = {}
         if self.params.time_series is not None:
             extra["time_series"] = np.asarray(self.params.time_series)
             extra["series_map"] = np.asarray(self.params.series_map,
                                              dtype=np.int64)
-        np.savez(path,
-                 fields=np.asarray(self.state.fields),
-                 flags=np.asarray(self.state.flags),
-                 iteration=int(self.state.iteration),
-                 settings=np.asarray(self.params.settings),
-                 zone_table=np.asarray(self.params.zone_table),
-                 **extra)
+        target = with_suffix(path, ".npz")
+        with telemetry.span("checkpoint.save", mode="legacy_npz",
+                            path=target) as sp:
+            sp.sync(self.state.fields)
+            with atomic_path(target) as tmp:
+                with open(tmp, "wb") as f:
+                    np.savez(f,
+                             fields=np.asarray(self.state.fields),
+                             flags=np.asarray(self.state.flags),
+                             iteration=int(self.state.iteration),
+                             settings=np.asarray(self.params.settings),
+                             zone_table=np.asarray(self.params.zone_table),
+                             **extra)
 
     def load(self, path: str) -> None:
-        d = np.load(path if path.endswith(".npz") else path + ".npz")
+        from tclb_tpu.checkpoint.writer import resolve_npz
+        d = np.load(resolve_npz(path))
         self._fast_tried = False   # restored flags may paint new types
         self._iterate_cached = None
         self._host_flags = np.asarray(d["flags"], dtype=np.uint16)

@@ -251,6 +251,56 @@ def test_hygiene_fires_on_untraced_dispatch(tmp_path):
     assert hygiene.scan_dispatch_telemetry(lattice_path=str(p)) == []
 
 
+def test_hygiene_fires_on_unrestorable_handler(tmp_path):
+    p = tmp_path / "handlers.py"
+    p.write_text(
+        "class Handler:\n"
+        "    pass\n"
+        "class cbLeaky(Handler):\n"
+        "    def do_it(self):\n"
+        "        self.count = self.count + 1\n"
+        "        self.old['x'] = 1.0\n"
+        "        self._scratch = 2   # private: not flagged\n"
+        "        return 0\n"
+        "class cbIndirect(cbLeaky):\n"
+        "    def do_it(self):\n"
+        "        self.score += 1\n"
+        "class cbExempt(Handler):\n"
+        "    checkpoint_exempt = True\n"
+        "    def do_it(self):\n"
+        "        self.count = 1\n"
+        "class cbCovered(Handler):\n"
+        "    def do_it(self):\n"
+        "        self.count = 1\n"
+        "    def restorable_state(self):\n"
+        "        return {'count': self.count}\n"
+        "class NotAHandler:\n"
+        "    def do_it(self):\n"
+        "        self.count = 1\n")
+    fs = hygiene.scan_unrestorable_handlers(paths=[str(p)])
+    assert all(f.check == "hygiene.unrestorable_handler" for f in fs)
+    assert all(f.severity == "error" for f in fs)
+    flagged = {f.message.split(" ")[1].split(".")[0] for f in fs}
+    assert flagged == {"cbLeaky", "cbIndirect"}
+    leaky = next(f for f in fs if "cbLeaky" in f.message)
+    assert "self.count" in leaky.message and "self.old" in leaky.message
+    assert "_scratch" not in leaky.message
+
+    # implementing the protocol clears the finding
+    p.write_text(
+        "class Handler:\n"
+        "    pass\n"
+        "class cbLeaky(Handler):\n"
+        "    def do_it(self):\n"
+        "        self.count += 1\n"
+        "        return 0\n"
+        "    def restorable_state(self):\n"
+        "        return {'count': self.count}\n"
+        "    def restore_state(self, state):\n"
+        "        self.count = state['count']\n")
+    assert hygiene.scan_unrestorable_handlers(paths=[str(p)]) == []
+
+
 # --------------------------------------------------------------------------- #
 # Finding mechanics / fingerprints
 # --------------------------------------------------------------------------- #

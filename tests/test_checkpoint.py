@@ -1,0 +1,515 @@
+"""Checkpoint/restart subsystem tests.
+
+Covers the save -> restore round trip (bit-exact fields/flags/settings/
+handler state, incl. Control time-series and sharded meshes), the
+integrity manifest (corruption detection + ``latest()`` fallback),
+retention, async serialization, the LoadBinary clock-sync regression,
+and the headline property: a run SIGKILLed mid-solve resumes from its
+newest valid checkpoint and finishes bit-identical to an uninterrupted
+run.
+"""
+
+import json
+import os
+import signal
+import subprocess
+import sys
+
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from tclb_tpu import checkpoint as ckpt
+from tclb_tpu.checkpoint import (CheckpointManager, CheckpointError,
+                                 manifest as mf, writer)
+from tclb_tpu.checkpoint.cli import main as ckpt_cli
+from tclb_tpu.control import run_config_string
+from tclb_tpu.core.lattice import Lattice
+from tclb_tpu.models import get_model
+from tclb_tpu.parallel.mesh import make_mesh
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def _flip_last_byte(path):
+    with open(path, "r+b") as f:
+        f.seek(-1, os.SEEK_END)
+        b = f.read(1)
+        f.seek(-1, os.SEEK_END)
+        f.write(bytes([b[0] ^ 0xFF]))
+
+
+def _channel_flags(m, ny, nx):
+    wall = m.node_types["Wall"]
+    f = np.zeros((ny, nx), dtype=np.uint16)
+    f[0, :] = f[-1, :] = wall.value
+    return f
+
+
+def _make_lattice(mesh=None, dtype=jnp.float64, shape=(16, 32)):
+    m = get_model("d2q9")
+    lat = Lattice(m, shape, dtype=dtype,
+                  settings={"nu": 0.05, "Velocity": 0.02}, mesh=mesh)
+    lat.set_flags(_channel_flags(m, *shape))
+    lat.init()
+    return lat
+
+
+def _state_tuple(lat):
+    return (np.asarray(lat.state.fields), np.asarray(lat.state.flags),
+            np.asarray(lat.params.settings),
+            np.asarray(lat.params.zone_table),
+            int(np.asarray(lat.state.iteration)))
+
+
+def assert_lattices_identical(a, b):
+    sa, sb = _state_tuple(a), _state_tuple(b)
+    for xa, xb in zip(sa[:-1], sb[:-1]):
+        np.testing.assert_array_equal(xa, xb)
+    assert sa[-1] == sb[-1]
+
+
+# --------------------------------------------------------------------------- #
+# Round trip
+# --------------------------------------------------------------------------- #
+
+
+def test_roundtrip_bit_exact(tmp_path):
+    lat = _make_lattice()
+    lat.iterate(20)
+    lat.avg_start = 7
+    d = str(tmp_path / "ck")
+    ckpt.save_checkpoint(d, lat)
+    assert mf.is_checkpoint_dir(d)
+    assert mf.verify_checkpoint(d) == []
+
+    lat2 = _make_lattice()
+    man = ckpt.restore_lattice(lat2, d)
+    assert man["iteration"] == 20
+    assert_lattices_identical(lat, lat2)
+    assert lat2.avg_start == 7
+
+    # the restored lattice keeps computing identically
+    lat.iterate(10)
+    lat2.iterate(10)
+    assert_lattices_identical(lat, lat2)
+
+
+def test_roundtrip_time_series(tmp_path):
+    lat = _make_lattice()
+    ramp = np.linspace(0.0, 0.05, 32)
+    lat.set_setting_series("Velocity", ramp, zone=0)
+    lat.iterate(8)
+    d = str(tmp_path / "ck")
+    ckpt.save_checkpoint(d, lat)
+
+    lat2 = _make_lattice()
+    ckpt.restore_lattice(lat2, d)
+    np.testing.assert_array_equal(np.asarray(lat2.params.time_series),
+                                  np.asarray(lat.params.time_series))
+    assert lat2.params.series_map == lat.params.series_map
+    lat.iterate(8)
+    lat2.iterate(8)
+    assert_lattices_identical(lat, lat2)
+
+
+@pytest.mark.parametrize("decomp", [{"y": 2, "x": 1}, {"y": 2, "x": 2}])
+def test_sharded_save_restores_onto_any_layout(tmp_path, decomp):
+    import jax
+    shape = (16, 32)
+    nshards = int(np.prod(list(decomp.values())))
+    mesh = make_mesh(shape, devices=jax.devices()[:nshards],
+                     decomposition=decomp)
+    lat = _make_lattice(mesh=mesh, shape=shape)
+    lat.iterate(12)
+    d = str(tmp_path / "ck")
+    ckpt.save_checkpoint(d, lat)
+
+    # one file per shard, keyed by mesh coordinates
+    shard_files = [f for f in os.listdir(d)
+                   if f.startswith("fields@") and f.endswith(".npy")]
+    assert len(shard_files) == nshards
+    man = mf.read_manifest(d)
+    assert man["mesh"] == {"axes": decomp}
+    assert len(man["arrays"]["fields"]["shards"]) == nshards
+    assert mf.verify_checkpoint(d) == []
+
+    # restore onto an UNSHARDED lattice: stitched global array, bit-exact
+    plain = _make_lattice(shape=shape)
+    ckpt.restore_lattice(plain, d)
+    ref = _make_lattice(shape=shape)
+    ref.iterate(12)
+    np.testing.assert_array_equal(np.asarray(plain.state.fields),
+                                  np.asarray(ref.state.fields))
+
+    # and onto a DIFFERENT sharded layout
+    other = _make_lattice(mesh=make_mesh(shape, devices=jax.devices()[:4],
+                                         decomposition={"y": 4, "x": 1}),
+                          shape=shape)
+    ckpt.restore_lattice(other, d)
+    np.testing.assert_array_equal(np.asarray(other.state.fields),
+                                  np.asarray(ref.state.fields))
+    other.iterate(4)
+    ref.iterate(4)
+    np.testing.assert_array_equal(np.asarray(other.state.fields),
+                                  np.asarray(ref.state.fields))
+
+
+def test_restore_refuses_wrong_model_and_shape(tmp_path):
+    lat = _make_lattice()
+    d = str(tmp_path / "ck")
+    ckpt.save_checkpoint(d, lat)
+
+    other = Lattice(get_model("d2q9_SRT"), (16, 32), dtype=jnp.float64)
+    with pytest.raises(CheckpointError, match="fingerprint"):
+        ckpt.restore_lattice(other, d)
+
+    small = _make_lattice(shape=(8, 16))
+    with pytest.raises(CheckpointError, match="shape"):
+        ckpt.restore_lattice(small, d)
+
+
+# --------------------------------------------------------------------------- #
+# Integrity + retention + async
+# --------------------------------------------------------------------------- #
+
+
+def test_corruption_detected_and_latest_falls_back(tmp_path):
+    lat = _make_lattice()
+    mgr = CheckpointManager(str(tmp_path / "root"), keep_last=5,
+                            async_saves=False)
+    lat.iterate(10)
+    mgr.save(lat)
+    lat.iterate(10)
+    p20 = mgr.save(lat)
+    assert [s for s, _p in mgr.steps()] == [10, 20]
+    assert mgr.latest() == p20
+
+    # flip one byte in the newest checkpoint's field data
+    _flip_last_byte(os.path.join(p20, "fields.npy"))
+    problems = mf.verify_checkpoint(p20)
+    assert problems and "crc" in problems[0].lower()
+    # latest() skips it and lands on step 10
+    assert mgr.latest() == mgr.step_path(10)
+
+    # a missing file is also fatal
+    os.unlink(os.path.join(mgr.step_path(10), "flags.npy"))
+    assert mgr.latest() is None
+    with pytest.raises(CheckpointError, match="no valid checkpoint"):
+        mgr.restore(lat)
+
+
+def test_truncated_file_detected(tmp_path):
+    lat = _make_lattice()
+    d = str(tmp_path / "ck")
+    ckpt.save_checkpoint(d, lat)
+    fpath = os.path.join(d, "fields.npy")
+    with open(fpath, "r+b") as f:
+        f.truncate(os.path.getsize(fpath) // 2)
+    assert mf.verify_checkpoint(d) != []
+
+
+def test_retention_keeps_last_n(tmp_path):
+    lat = _make_lattice()
+    mgr = CheckpointManager(str(tmp_path / "root"), keep_last=2,
+                            async_saves=False)
+    for step in (10, 20, 30, 40):
+        mgr.save(lat, step=step)
+    assert [s for s, _p in mgr.steps()] == [30, 40]
+
+
+def test_async_saves_serialize_and_commit(tmp_path):
+    lat = _make_lattice()
+    mgr = CheckpointManager(str(tmp_path / "root"), keep_last=5,
+                            async_saves=True)
+    for step in (10, 20, 30):
+        lat.iterate(2)
+        mgr.save(lat, step=step)   # each save first drains the previous
+    mgr.wait()
+    assert [s for s, _p in mgr.steps()] == [10, 20, 30]
+    for _s, p in mgr.steps():
+        assert mf.verify_checkpoint(p) == []
+    # no stray temp dirs once drained
+    assert not [n for n in os.listdir(mgr.root) if n.endswith(".tmp")]
+
+
+def test_async_writer_defers_errors_to_wait():
+    w = writer.AsyncWriter()
+
+    def boom():
+        raise RuntimeError("disk on fire")
+
+    w.submit(boom)
+    with pytest.raises(RuntimeError, match="disk on fire"):
+        w.wait()
+    w.wait()   # error consumed, writer reusable
+
+
+def test_atomic_path_never_leaves_partial_file(tmp_path):
+    target = tmp_path / "out.txt"
+    target.write_text("old")
+    with pytest.raises(RuntimeError):
+        with writer.atomic_path(str(target)) as tmp:
+            with open(tmp, "w") as f:
+                f.write("half-writ")
+            raise RuntimeError("crash mid-write")
+    assert target.read_text() == "old"
+    assert os.listdir(tmp_path) == ["out.txt"]
+
+
+# --------------------------------------------------------------------------- #
+# Path normalization (the fn[:-4] suffix-juggling fix)
+# --------------------------------------------------------------------------- #
+
+
+def test_suffix_helpers_handle_dotted_stems():
+    assert writer.with_suffix("a/state.v2", ".npz") == "a/state.v2.npz"
+    assert writer.with_suffix("a/state.npz", ".npz") == "a/state.npz"
+    assert writer.strip_suffix("a/state.v2.npz", ".npz") == "a/state.v2"
+    assert writer.strip_suffix("a/state.v2", ".npz") == "a/state.v2"
+
+
+def test_legacy_save_load_dotted_stem(tmp_path):
+    lat = _make_lattice()
+    lat.iterate(6)
+    stem = str(tmp_path / "run.best")   # dot in the stem, no suffix
+    lat.save(stem)
+    assert os.path.exists(stem + ".npz")
+    lat.save(stem + ".npz")             # suffixed spelling: same file
+    assert not os.path.exists(stem + ".npz.npz")
+
+    for name in (stem, stem + ".npz"):
+        lat2 = _make_lattice()
+        lat2.load(name)
+        assert_lattices_identical(lat, lat2)
+
+
+# --------------------------------------------------------------------------- #
+# Full-run state through the control layer
+# --------------------------------------------------------------------------- #
+
+CHANNEL_XML = """<?xml version="1.0"?>
+<CLBConfig output="{out}/">
+    <Geometry nx="32" ny="16">
+        <MRT><Box/></MRT>
+        <Wall mask="ALL"><Box ny="1"/><Box dy="-1"/></Wall>
+    </Geometry>
+    <Model><Params Velocity="0.02" nu="0.05"/></Model>
+    {body}
+</CLBConfig>
+"""
+
+
+def _run(tmp_path, body, **kw):
+    xml = CHANNEL_XML.format(out=tmp_path, body=body)
+    return run_config_string(xml, get_model("d2q9"), dtype=jnp.float64,
+                             conf_name="t", **kw)
+
+
+def test_save_checkpoint_handler_records_handler_state(tmp_path):
+    s = _run(tmp_path, """
+    <SaveCheckpoint Iterations="10" keep="3" mode="sync"/>
+    <Stop OutletFluxChange="1e-12" Times="100" Iterations="10"/>
+    <Solve Iterations="20"/>""")
+    assert s.iter == 20
+    root = str(tmp_path) + "/t_checkpoint"
+    mgr = CheckpointManager(root)
+    latest = mgr.latest()
+    assert latest == mgr.step_path(20)
+    extra = mf.read_manifest(latest)["extra"]
+    assert extra["iter"] == 20
+    hands = extra["handlers"]
+    # the periodic Stop handler's accumulator state rode along
+    assert "cbStop#0" in hands
+    assert "old" in hands["cbStop#0"] and "score" in hands["cbStop#0"]
+    assert hands["cbStop#0"]["old"] != {}
+    # the running <Solve> recorded its schedule anchor
+    assert "acSolve#0" in hands
+    assert hands["acSolve#0"]["__start_iter"] == 0
+
+
+def test_resume_restores_handler_state_and_completes(tmp_path):
+    body = """
+    <SaveCheckpoint Iterations="10" keep="3" mode="sync"/>
+    <Stop OutletFluxChange="1e-12" Times="100" Iterations="10"/>
+    <Log Iterations="10"/>
+    <Solve Iterations="40"/>"""
+    ref = _run(tmp_path / "ref", body)
+    assert ref.iter == 40
+
+    part = _run(tmp_path / "res", body.replace('Iterations="40"',
+                                               'Iterations="20"'))
+    assert part.iter == 20
+    # resume the FULL config from the interrupted run's checkpoint:
+    # <Solve Iterations="40"> must complete to 40, not run 40 more
+    res = _run(tmp_path / "res", body, resume="latest")
+    assert res.iter == 40
+    np.testing.assert_array_equal(np.asarray(res.lattice.state.fields),
+                                  np.asarray(ref.lattice.state.fields))
+    # Log CSV continues on the original cadence (10,20 then 30,40 — the
+    # resumed run re-fires nothing before its restore point)
+    csv = tmp_path / "res" / "t_Log.csv"
+    rows = [ln.split(",")[0] for ln in csv.read_text().splitlines()[1:]]
+    assert [int(float(r)) for r in rows[-2:]] == [30, 40]
+
+
+def test_resume_explicit_path_and_cold_start(tmp_path):
+    body = """
+    <SaveCheckpoint Iterations="10" mode="sync"/>
+    <Solve Iterations="20"/>"""
+    s = _run(tmp_path, body)
+    explicit = str(tmp_path) + "/t_checkpoint/step_00000010"
+    s2 = _run(tmp_path, body, resume=explicit)
+    assert s2.iter == 20
+
+    with pytest.raises(ValueError, match="not a checkpoint directory"):
+        _run(tmp_path, body, resume=str(tmp_path / "nowhere"))
+
+    # resume with an empty root: cold start, still completes
+    s3 = _run(tmp_path / "fresh", body, resume="latest")
+    assert s3.iter == 20
+
+
+def test_loadbinary_syncs_solver_clock(tmp_path):
+    """Regression: LoadBinary used to jump the lattice iteration while
+    solver.iter stayed at 0, so every Iterations=-based handler fired on
+    a misaligned schedule and <Solve> ran the full count again."""
+    a = _run(tmp_path, """
+    <Solve Iterations="30"/>
+    <SaveBinary filename="{0}/state.npz"/>""".format(tmp_path))
+    assert a.iter == 30
+
+    b = _run(tmp_path, """
+    <LoadBinary filename="{0}/state.npz"/>
+    <Log Iterations="10"/>
+    <Solve Iterations="20"/>""".format(tmp_path))
+    # clock reconciled: 30 restored + 20 more
+    assert b.iter == 50
+    assert int(np.asarray(b.lattice.state.iteration)) == 50
+    csv = tmp_path / "t_Log.csv"
+    rows = [ln.split(",")[0] for ln in csv.read_text().splitlines()[1:]]
+    # Log fires at 40 and 50 — aligned to the restored clock
+    assert [int(float(r)) for r in rows] == [40, 50]
+
+
+def test_savebinary_directory_format_roundtrip(tmp_path):
+    """A SaveBinary filename without .npz writes the manifest-verified
+    checkpoint directory; LoadBinary restores it with full solver state."""
+    a = _run(tmp_path, """
+    <Solve Iterations="20"/>
+    <SaveBinary filename="{0}/dump"/>""".format(tmp_path))
+    d = str(tmp_path / "dump")
+    assert mf.is_checkpoint_dir(d)
+    assert mf.verify_checkpoint(d) == []
+
+    b = _run(tmp_path, """
+    <LoadBinary filename="{0}/dump"/>
+    <Solve Iterations="15"/>""".format(tmp_path))
+    assert b.iter == 35
+    ref = _run(tmp_path / "ref", "<Solve Iterations=\"35\"/>")
+    np.testing.assert_array_equal(np.asarray(b.lattice.state.fields),
+                                  np.asarray(ref.lattice.state.fields))
+
+
+# --------------------------------------------------------------------------- #
+# CLI
+# --------------------------------------------------------------------------- #
+
+
+def test_cli_inspect_verify_prune(tmp_path, capsys):
+    lat = _make_lattice()
+    mgr = CheckpointManager(str(tmp_path / "root"), keep_last=9,
+                            async_saves=False)
+    for step in (10, 20, 30):
+        mgr.save(lat, step=step)
+
+    assert ckpt_cli(["inspect", mgr.root, "--format", "json"]) == 0
+    out = json.loads(capsys.readouterr().out)
+    # saved at explicit steps; the lattice itself never iterated
+    assert [s["iteration"] for s in out] == [0, 0, 0]
+    assert out[0]["model"]["name"] == "d2q9"
+    assert out[0]["arrays"]["fields"]["dtype"] == "float64"
+
+    assert ckpt_cli(["verify", mgr.root]) == 0
+
+    # corrupt one -> verify exits 1 and names it
+    _flip_last_byte(os.path.join(mgr.step_path(20), "flags.npy"))
+    assert ckpt_cli(["verify", mgr.root]) == 1
+    assert "FAIL" in capsys.readouterr().out
+
+    assert ckpt_cli(["prune", mgr.root, "--keep", "1"]) == 0
+    assert [s for s, _p in mgr.steps()] == [30]
+
+    assert ckpt_cli(["inspect", str(tmp_path / "missing")]) == 2
+
+
+# --------------------------------------------------------------------------- #
+# Kill-resume: the property the subsystem exists for
+# --------------------------------------------------------------------------- #
+
+KILLER_MOD = """
+import os, signal
+
+def run(solver):
+    if os.environ.get("TCLB_TEST_KILL") == "1":
+        os.kill(os.getpid(), signal.SIGKILL)
+"""
+
+KILL_XML = """<?xml version="1.0"?>
+<CLBConfig model="d2q9" output="{out}/">
+    <Geometry nx="32" ny="16">
+        <MRT><Box/></MRT>
+        <Wall mask="ALL"><Box ny="1"/><Box dy="-1"/></Wall>
+    </Geometry>
+    <Model><Params Velocity="0.02" nu="0.05"/></Model>
+    <SaveCheckpoint Iterations="10" keep="3" mode="sync"/>
+    <CallPython module="killer" function="run" Iterations="25"/>
+    <Solve Iterations="40"/>
+    <SaveBinary filename="{out}/final.npz"/>
+</CLBConfig>
+"""
+
+
+def _spawn(case, out, *extra, kill=False):
+    env = dict(os.environ, JAX_PLATFORMS="cpu",
+               PYTHONPATH=f"{out}{os.pathsep}{REPO}")
+    env.pop("TCLB_TEST_KILL", None)
+    if kill:
+        env["TCLB_TEST_KILL"] = "1"
+    return subprocess.run(
+        [sys.executable, "-m", "tclb_tpu", "run", str(case), *extra],
+        env=env, cwd=REPO, capture_output=True, text=True, timeout=600)
+
+
+def test_kill_resume_bit_identical(tmp_path):
+    (tmp_path / "killer.py").write_text(KILLER_MOD)
+    case = tmp_path / "case.xml"
+
+    # uninterrupted reference (same config => same checkpoint cadence,
+    # so iterate chunk boundaries match exactly)
+    ref_out = tmp_path / "ref"
+    case.write_text(KILL_XML.format(out=ref_out))
+    r = _spawn(case, tmp_path)
+    assert r.returncode == 0, r.stderr
+
+    # interrupted run: SIGKILL at iteration 25, after checkpoints 10+20
+    out = tmp_path / "run"
+    case.write_text(KILL_XML.format(out=out))
+    r = _spawn(case, tmp_path, kill=True)
+    assert r.returncode == -signal.SIGKILL
+    root = out / "case_checkpoint"
+    steps = sorted(os.listdir(root))
+    assert steps == ["step_00000010", "step_00000020"]
+
+    # corrupt the newest checkpoint: resume must fall back to step 10
+    _flip_last_byte(root / "step_00000020" / "fields.npy")
+
+    r = _spawn(case, tmp_path, "--resume")
+    assert r.returncode == 0, r.stderr
+    assert "resumed from" in r.stdout and "step_00000010" in r.stdout
+
+    ref = np.load(ref_out / "final.npz")
+    got = np.load(out / "final.npz")
+    np.testing.assert_array_equal(got["fields"], ref["fields"])
+    np.testing.assert_array_equal(got["flags"], ref["flags"])
+    assert int(got["iteration"]) == int(ref["iteration"]) == 40

@@ -177,6 +177,47 @@ def check_footprint(model: Model, shape=None) -> list:
                     f"adjoint chunk budget: max_chunk={k} "
                     f"(fuse-1 reach {reach})", "action:Iteration",
                     {"max_chunk": k, "reach": reach}))
+        # -- K-step fused halos ------------------------------------------ #
+        # The fused engines DMA K reach-slabs of halo per side and let
+        # each of the K steps consume one: a stencil wider than one
+        # reach-unit per step outgrows the halo silently (the slices
+        # stay in-bounds — the kernel just computes on stale rows).
+        if model.ndim == 2:
+            fz = pallas_generic.choose_fuse(model)
+            if fz >= 2:
+                try:
+                    _, rf = pallas_generic.action_plan(
+                        model, "Iteration", fuse=fz)
+                except Exception:  # noqa: BLE001
+                    rf = None
+                if rf is not None and rf > halo:
+                    findings.append(Finding(
+                        "footprint.fusion_halo", "error", model.name,
+                        f"planner picked fuse={fz} but the fused plan's "
+                        f"reach {rf} exceeds the {halo}-row DMA halo: "
+                        "the band kernel would compute on stale halo "
+                        "rows", "action:Iteration",
+                        {"fuse": fz, "reach": rf, "halo": halo}))
+        else:
+            from tclb_tpu.ops import pallas_d3q
+            if model.name in pallas_d3q._SUPPORTED:
+                # the tuned z-slab kernel widens its halo by exactly ONE
+                # slab per fused step: structural eligibility (the name
+                # allowlist) must imply per-step z-reach <= 1 from the
+                # declarations (streaming vectors + field dz stencils)
+                zr = max((abs(int(e[2])) for e in model.ei), default=0)
+                for f in model.fields:
+                    lo, hi = f.dz_range
+                    zr = max(zr, abs(int(lo)), abs(int(hi)))
+                if zr > 1:
+                    findings.append(Finding(
+                        "footprint.fusion_halo", "error", model.name,
+                        f"model is name-eligible for the tuned d3q "
+                        f"kernel but declares per-step z-reach {zr} > 1:"
+                        " the fused kernel's K-slab halo covers exactly "
+                        "one reach-slab per fused step — wider stencils "
+                        "read stale halo slabs", "action:Iteration",
+                        {"z_reach": zr}))
     return findings
 
 

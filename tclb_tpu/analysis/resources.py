@@ -154,4 +154,58 @@ def check_resources(model: Model, shape=None) -> list:
                 "resources.slab_layout", "info", model.name,
                 f"3D slab engine: bz={bz} scratch~{est >> 20} MiB",
                 where, {"bz": bz, "scratch_bytes": est}))
+        # -- fused (K>=2) working sets at the PRODUCTION fusion depth -- #
+        # the planners only propose configs their own fits() predicate
+        # accepts, so a config exceeding its engine's budget here means
+        # planner and builder have drifted apart — an error, because the
+        # first TPU compile would die where the probe ladder can't see it
+        K3 = pallas_generic.choose_fuse_3d(model, shape)
+        if K3 >= 2:
+            _, rK = pallas_generic.action_plan(model, "Iteration",
+                                               fuse=K3)
+            RK = max(rK, 1)
+            bzK = pallas_generic._slab_depth_gen(
+                model, nz, ny, nx, RK, n_aux=1,
+                budget=pallas_generic._FUSED3D_BUDGET)
+            estK = None if bzK is None else \
+                2 * (bzK + 2 * RK) * (model.n_storage + 1) * ny * nx * 4
+            if bzK is None or estK > pallas_generic._FUSED3D_BUDGET:
+                findings.append(Finding(
+                    "resources.fused_vmem", "error", model.name,
+                    f"generic 3D planner picked fuse={K3} but no slab "
+                    f"depth fits the "
+                    f"{pallas_generic._FUSED3D_BUDGET >> 20} MB fused "
+                    f"scratch budget at {nz}x{ny}x{nx}: planner/builder "
+                    "drift, first TPU compile will fail", where,
+                    {"fuse": K3, "reach": RK}))
+            else:
+                findings.append(Finding(
+                    "resources.fused_slab", "info", model.name,
+                    f"generic 3D fused engine: fuse={K3} bz={bzK} "
+                    f"reach={RK} scratch~{estK >> 20} MiB", where,
+                    {"fuse": K3, "bz": bzK, "reach": RK,
+                     "scratch_bytes": estK}))
+        from tclb_tpu.ops import pallas_d3q
+        cfg = pallas_d3q.fused_cfg(model, shape)
+        if cfg is not None:
+            bzD, KD = cfg
+            if not pallas_d3q._fused_fits(model, nz, ny, nx, bzD, KD):
+                findings.append(Finding(
+                    "resources.fused_vmem", "error", model.name,
+                    f"tuned d3q planner picked (bz={bzD}, K={KD}) but "
+                    f"its working set exceeds the "
+                    f"{pallas_d3q._FUSED_BUDGET >> 20} MB fused budget "
+                    f"at {nz}x{ny}x{nx}: planner/builder drift", where,
+                    {"fuse": KD, "bz": bzD}))
+            else:
+                H = bzD + 2 * KD
+                per = ny * nx * 4
+                estD = (2 * (model.n_storage + 1) * H
+                        + 2 * model.n_storage * bzD) * per
+                findings.append(Finding(
+                    "resources.fused_slab", "info", model.name,
+                    f"tuned d3q fused engine: fuse={KD} bz={bzD} "
+                    f"scratch~{estD >> 20} MiB (+ collision "
+                    "temporaries)", where,
+                    {"fuse": KD, "bz": bzD, "scratch_bytes": estD}))
     return findings

@@ -763,9 +763,16 @@ class Lattice:
                 self.model, self.shape, self.dtype):
             present = pallas_d3q.present_types(
                 self.model, self._flags_host())
+            # K>=2 multi-step fusion (one HBM round trip per K steps)
+            # compiles against the raised scoped-vmem ceiling: first TPU
+            # compile may still hit Mosaic temporaries the planner can't
+            # see, so the fused build is probed (fallback: fuse=1)
+            k3 = pallas_d3q.choose_fuse(self.model, self.shape)
+            if k3 >= 2:
+                self._fast_probing = True
             return (pallas_d3q.make_pallas_iterate(
                 self.model, self.shape, self.dtype, present=present),
-                f"pallas_d3q[{self.model.name}]")
+                f"pallas_d3q[{self.model.name},fuse={k3}]")
         from tclb_tpu.ops import pallas_generic
         # the static analyzer's kernel-safety verdict gates EVERY
         # registry-driven kernel: a stage reading beyond its declared
@@ -800,11 +807,16 @@ class Lattice:
                 fz, cap = cfg
             else:
                 self._fast_probing = True   # first call may hit a Mosaic
-                # temporal fusion halves traffic but doubles the in-band
-                # reach; deep-stencil models (lee: reach 6/step) must
-                # stay at fuse=1
-                fz = 2 if pallas_generic.action_plan(
-                    self.model, fuse=2)[1] <= pallas_generic.HALO else 1
+                # temporal fusion amortizes one HBM round trip over K
+                # steps; the shared planner caps K by the stencil reach
+                # fitting the halo (2D: fixed 8-row block; deep-stencil
+                # models like lee at reach 6/step stay fuse=1) or by the
+                # traffic model vs the K=1 engine (3D: slab halos grow
+                # with K, so the win must be priced)
+                fz = (pallas_generic.choose_fuse_3d(self.model,
+                                                    self.shape)
+                      if self.model.ndim == 3
+                      else pallas_generic.choose_fuse(self.model))
                 cap = None
             self._fast_cfg = (fz, cap)
             return (pallas_generic.make_pallas_iterate(  # lowering gap
@@ -852,8 +864,9 @@ class Lattice:
                 model=self.model.name,
                 iteration=int(self.state.iteration)) as sp:
             self._iterate_impl(niter)
-            sp.add(engine=("sampled_xla" if self.sampler is not None
-                           else (self._fast_name or "xla")))
+            engine = ("sampled_xla" if self.sampler is not None
+                      else (self._fast_name or "xla"))
+            sp.add(engine=engine, fuse=telemetry.fuse_of(engine))
             sp.sync(self.state.fields)
 
     def _iterate_impl(self, niter: int) -> None:
@@ -897,9 +910,39 @@ class Lattice:
                     "pallas_resident")
                 was_generic_res = (self._fast_name or "").startswith(
                     "pallas_resident_generic")
+                was_d3q = (self._fast_name or "").startswith(
+                    "pallas_d3q[")
                 try:
                     self.state = attempt(fast)
                 except Exception as e:  # noqa: BLE001
+                    if was_d3q:
+                        # fused (K>=2) tuned-3D probe failed — its
+                        # raised-ceiling scratch budget cannot see
+                        # Mosaic's compute temporaries.  The K=1 block
+                        # kernel is the proven engine for these models:
+                        # swap it in and continue this very call.
+                        failed = self._fast_name
+                        log.info(f"engine: {self._fast_name} failed to "
+                                 f"compile ({e!r}); fuse=1 "
+                                 "d3q fallback")
+                        from tclb_tpu.ops import pallas_d3q
+                        present = pallas_d3q.present_types(
+                            self.model, self._flags_host())
+                        self._fast = fast = \
+                            pallas_d3q.make_pallas_iterate(
+                                self.model, self.shape, self.dtype,
+                                present=present, fuse=1)
+                        self._fast_name = (
+                            f"pallas_d3q[{self.model.name},fuse=1]")
+                        telemetry.engine_fallback(
+                            failed, self._fast_name, repr(e),
+                            model=self.model.name)
+                        self._fast_probing = False
+                        self.state = fast(self.state, self.params, nfast)
+                        if not full:
+                            self.state = self._iterate(
+                                self.state, self.params, 1)
+                        return
                     if was_resident:
                         # resident probe failed (its budget can't see
                         # Mosaic temporaries): the band engine is the
@@ -916,9 +959,11 @@ class Lattice:
                             from tclb_tpu.ops.lbm import present_types
                             present = present_types(self.model,
                                                     self._flags_host())
-                            fz = 2 if pallas_generic.action_plan(
-                                self.model, fuse=2)[1] \
-                                <= pallas_generic.HALO else 1
+                            fz = (pallas_generic.choose_fuse_3d(
+                                self.model, self.shape)
+                                if self.model.ndim == 3
+                                else pallas_generic.choose_fuse(
+                                    self.model))
                             self._fast = fast = \
                                 pallas_generic.make_pallas_iterate(
                                     self.model, self.shape, self.dtype,
@@ -959,7 +1004,7 @@ class Lattice:
                                                 self._flags_host())
                         fz0, _ = self._fast_cfg
                         ladder = [(fz0, 16), (fz0, 8)]
-                        if fz0 == 2 and self.model.ndim == 2:
+                        if fz0 >= 2:
                             ladder += [(1, 16), (1, 8)]
                         if self.model.ndim == 3:
                             # last resort: raised scoped-vmem ceiling
@@ -1005,7 +1050,8 @@ class Lattice:
                         self.state = self._iterate(self.state, self.params,
                                                    niter)
                         return
-                if self.mesh is None and not was_resident:
+                if self.mesh is None and not was_resident \
+                        and not was_d3q:
                     # verdict caches belong to the generic engine only
                     pallas_generic.set_mosaic_ok(self.model, self.shape,
                                                  True)

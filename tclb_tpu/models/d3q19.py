@@ -52,7 +52,15 @@ def run(ctx: NodeCtx) -> jnp.ndarray:
     f = ctx.group("f")
     f = family.apply_boundaries(ctx, f, E, W, OPP)
     family.add_flux_objectives(ctx, f, E)
-    f = jnp.where(ctx.nt_in_group("COLLISION")[None], collide(ctx, f), f)
+    # pin collide's input and output: without this XLA fuses the
+    # boundary select chain and the collision select into the relaxation
+    # arithmetic, and the FMA contraction it picks depends on the
+    # surrounding graph — so the XLA step and the Pallas z-slab kernel
+    # (which barriers the same two seams) would differ by 1 ULP instead
+    # of being bit-identical
+    f = lbm.pin(f)
+    fc = lbm.pin(collide(ctx, f))
+    f = jnp.where(ctx.nt_in_group("COLLISION")[None], fc, f)
     return ctx.store({"f": f})
 
 

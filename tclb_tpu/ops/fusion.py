@@ -1,0 +1,104 @@
+"""Shared temporal-fusion planner for the band/slab Pallas engines.
+
+Temporal fusion runs ``K`` lattice steps per HBM round trip: the DMA'd
+band carries ``K * reach`` halo rows/slabs per side and each fused step
+shrinks the valid interior by one reach (the progressive-extension
+scheme ops/pallas_generic.py introduced in 2D).  Amortized traffic per
+step drops from ``reads + writes`` to roughly
+``(reads * (b + 2*K*reach) / b + writes) / K`` planes, which is why the
+fused 2D engines sit at ~0.9x roofline while unfused band kernels are
+read-amplification bound.
+
+This module holds the *planning* logic — picking the fusion depth ``K``
+(and slab depth ``bz`` in 3D) from the VMEM budget and the traffic
+model — so the 2D band engine, the 3D generic slab engine and the tuned
+d3q slab engine all make the same decision the same way.  It also holds
+the in-kernel zonal-plane reconstruction used by the lean aux flavors
+(flags are DMA'd; zonal settings are a pure function of the zone bits
+and the SMEM zone table, so shipping them as planes is wasted HBM
+traffic).
+"""
+
+from __future__ import annotations
+
+from typing import Callable, Iterable, Optional, Tuple
+
+import jax.numpy as jnp
+
+FUSE_MAX = 4   # diminishing returns + halo growth beyond 4 fused steps
+
+
+def choose_fuse_band(reach_of: Callable[[int], int], halo: int,
+                     fmax: int = FUSE_MAX) -> int:
+    """Largest fuse depth whose fused-plan reach fits a fixed band halo.
+
+    ``reach_of(f)`` returns the total stencil reach of the f-step fused
+    action plan (monotone in ``f``); ``halo`` is the rows the band
+    kernel DMAs per side.  Used by the 2D band engines, where the halo
+    is a fixed 8-row (sublane-aligned) block.
+    """
+    best = 1
+    for f in range(2, fmax + 1):
+        try:
+            r = reach_of(f)
+        except Exception:
+            break
+        if r > halo:
+            break
+        best = f
+    return best
+
+
+def choose_fuse_slab(nz: int, fits: Callable[[int, int], bool],
+                     cost: Callable[[int, int], float],
+                     base_cost: float, reach: int = 1,
+                     fmax: int = FUSE_MAX) -> Optional[Tuple[int, int]]:
+    """Pick ``(bz, K)`` minimizing amortized HBM traffic for a fused
+    z-slab kernel, or None when no ``K >= 2`` config is feasible and
+    cheaper than the best single-step engine.
+
+    ``fits(bz, K)`` is the VMEM-budget predicate (monotone in ``bz``);
+    ``cost(bz, K)`` the modeled planes-per-step traffic; ``base_cost``
+    the best available K=1 engine's traffic — a fused config must beat
+    it to be worth the wider halo.  For each K the largest feasible
+    band depth dividing ``nz`` is used (traffic is decreasing in bz).
+    """
+    best, best_c = None, base_cost
+    for K in range(2, fmax + 1):
+        if nz < 2 * K * max(reach, 1):
+            break
+        bz_best = None
+        for bz in range(1, nz + 1):
+            if nz % bz:
+                continue
+            if not fits(bz, K):
+                break
+            bz_best = bz
+        if bz_best is None:
+            continue
+        c = cost(bz_best, K)
+        if c < best_c:
+            best, best_c = (bz_best, K), c
+    return best
+
+
+def zone_plane(ztab, col: int, zone_max: int, zones,
+               zones_present: Optional[Iterable[int]] = None):
+    """Reconstruct one zonal-setting plane inside a kernel.
+
+    ``ztab`` is the flattened SMEM zone table (row ``col`` holds that
+    setting's per-zone values, ``ztab[col * zone_max + z]``); ``zones``
+    the flag-derived zone ids (``flags >> zone_shift``, always in
+    ``[0, zone_max)`` by bit width).  A where-chain over the present
+    zones reproduces the host-side ``zone_table[si][zones]`` gather
+    bit-exactly; ``zones_present=None`` means all zones (exact parity
+    with no host knowledge).
+    """
+    zs = list(zones_present) if zones_present is not None \
+        else list(range(zone_max))
+    v0 = ztab[col * zone_max + zs[0]]
+    plane = jnp.zeros(zones.shape, v0.dtype) + v0
+    for z in zs[1:]:
+        plane = jnp.where(zones == jnp.int32(z),
+                          ztab[col * zone_max + z], plane)
+    return plane

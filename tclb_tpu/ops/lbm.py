@@ -11,9 +11,34 @@ import math
 from typing import Optional
 
 import numpy as np
+import jax
 import jax.numpy as jnp
 
 CS2 = 1.0 / 3.0  # lattice speed of sound squared
+
+
+@jax.custom_vjp
+def pin(x):
+    """Identity that pins ``x`` to one canonical evaluation: the
+    compiler may not fuse ``x``'s producers into its consumers, so the
+    multiply-add contraction of the producing graph no longer depends on
+    where the value is used.  The engines' bit-parity contract (same
+    model arithmetic on the XLA path and inside a Pallas kernel) needs
+    this at fusion-sensitive seams.  Differentiable in reverse mode (the
+    cotangent is pinned the same way), which the raw
+    ``lax.optimization_barrier`` primitive is not."""
+    return jax.lax.optimization_barrier(x)
+
+
+def _pin_fwd(x):
+    return pin(x), None
+
+
+def _pin_bwd(_, g):
+    return (jax.lax.optimization_barrier(g),)
+
+
+pin.defvjp(_pin_fwd, _pin_bwd)
 
 
 def present_types(model, flags: np.ndarray) -> set:
@@ -106,7 +131,13 @@ def equilibrium(E: np.ndarray, W: np.ndarray, rho, u):
             common = 1.0 + eu / CS2 + eu * eu / (2 * CS2 * CS2) \
                 - usq / (2 * CS2)
         out.append(jnp.asarray(float(W[i]), dt) * rho * common)
-    return jnp.stack(out)
+    # pinned so f_eq gets ONE canonical evaluation: fused into its
+    # consumers (f - feq, relax + feq2, ...) the compiler contracts the
+    # multiply-add chains differently depending on the surrounding
+    # graph, so the same source gives 1-ULP-different values in the XLA
+    # step vs a Pallas kernel — which breaks the engines' bit-parity
+    # contract.  Costs one materialized (q, *shape) temp.
+    return pin(jnp.stack(out))
 
 
 def mrt_basis_d2q9(E: np.ndarray) -> np.ndarray:

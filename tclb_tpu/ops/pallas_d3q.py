@@ -51,11 +51,19 @@ from jax.experimental.pallas import tpu as pltpu
 from tclb_tpu.core.lattice import LatticeState, SimParams
 from tclb_tpu.core.registry import Model
 from tclb_tpu.models import family
-from tclb_tpu.ops import cumulant, lbm
+from tclb_tpu.ops import cumulant, fusion, lbm
+from tclb_tpu.ops.pallas_generic import _CompilerParams
 
 _SUPPORTED = ("d3q27_BGK", "d3q27_BGK_galcor", "d3q27_cumulant",
               "d3q19", "d3q19_les")
 _VMEM_BUDGET = 15 * 1024 * 1024
+# the fused (K>=2) kernel budgets against a raised Mosaic ceiling: its
+# scratch is deliberately larger (K halo slabs per side, 2 slots) and the
+# widest fused window's collision intermediates (~_TEMP_PLANES stacked
+# q-plane tensors) must coexist with it
+_FUSED_BUDGET = 80 * 1024 * 1024
+_FUSED_VMEM_LIMIT = 100 * 1024 * 1024
+_TEMP_PLANES = 6
 
 E = cumulant.velocity_set(3)
 W = lbm.weights(E)
@@ -113,6 +121,68 @@ def _slab_depth(model: Model, nz: int, ny: int, nx: int) -> Optional[int]:
     return best
 
 
+def _n_zonal(model: Model) -> int:
+    return 3 if model.name == "d3q27_cumulant" else 2
+
+
+def _fused_fits(model: Model, nz: int, ny: int, nx: int,
+                bz: int, K: int) -> bool:
+    """VMEM predicate for the fused kernel at (bz, K): 2-slot halo'd
+    f+aux buffers + 2-slot flag buffers + pipelined out blocks + the
+    widest fused window's collision intermediates."""
+    ns = model.n_storage
+    q = _q_of(model)
+    per = ny * nx * 4
+    H = bz + 2 * K
+    scratch = (2 * (ns + 1) * H + 2 * ns * bz) * per
+    temp = _TEMP_PLANES * q * (bz + 2 * (K - 1)) * per
+    return scratch + temp <= _FUSED_BUDGET
+
+
+def _fused_cost(model: Model, bz: int, K: int) -> float:
+    """Modeled HBM planes per lattice step of the fused kernel: the
+    f+aux stack and the flag plane are read with K halo slabs per side,
+    the ns output planes written halo-free, all amortized over K steps."""
+    ns = model.n_storage
+    return ((ns + 1) * (bz + 2 * K) + ns * bz) / (K * bz)
+
+
+def _base_cost(model: Model, nz: int, ny: int, nx: int) -> float:
+    """Best single-step engine's HBM planes per step (the bar a fused
+    config must beat): the ring kernel reads each plane once; the block
+    kernel pays (bz+2)/bz read amplification on the f planes."""
+    ns = model.n_storage
+    q = _q_of(model)
+    zn = _n_zonal(model)
+    if _ring_ok(model, nz, ny, nx):
+        return 2.0 * ns + 1 + zn
+    bz = _slab_depth(model, nz, ny, nx)
+    if bz is None:
+        return float("inf")
+    return (q * (bz + 2) + (ns - q) * bz + (1 + zn) * bz + ns * bz) / bz
+
+
+def fused_cfg(model: Model, shape) -> Optional[tuple]:
+    """Production fused-kernel config ``(bz, K)`` for this shape, or
+    None when single-step is the better (or only feasible) plan.
+    Shared with analysis/resources.py so the static VMEM check audits
+    exactly what the engine will build."""
+    if model.name not in _SUPPORTED or len(shape) != 3:
+        return None
+    nz, ny, nx = (int(s) for s in shape)
+    return fusion.choose_fuse_slab(
+        nz,
+        lambda bz, K: _fused_fits(model, nz, ny, nx, bz, K),
+        lambda bz, K: _fused_cost(model, bz, K),
+        _base_cost(model, nz, ny, nx))
+
+
+def choose_fuse(model: Model, shape) -> int:
+    """Fusion depth K the engine will run at (1 = single-step)."""
+    cfg = fused_cfg(model, shape)
+    return cfg[1] if cfg else 1
+
+
 def supports(model: Model, shape, dtype, ext_halo: bool = False) -> bool:
     """Whether the fused 3D kernel can run this configuration.
 
@@ -138,9 +208,19 @@ present_types = lbm.present_types   # shared helper (re-exported)
 def make_pallas_iterate(model: Model, shape, dtype=jnp.float32,
                         interpret: Optional[bool] = None,
                         present: Optional[Iterable[str]] = None,
-                        ext_halo: bool = False):
+                        ext_halo: bool = False,
+                        fuse: Optional[int] = None,
+                        fuse_bz: Optional[int] = None):
     """Build ``iterate(state, params, niter) -> state`` running the fused
     3D Pallas kernel.  Caller must check :func:`supports` first.
+
+    ``fuse=K`` runs K lattice steps per HBM round trip (temporal fusion:
+    K wrapped halo slabs per side, valid interior shrinking one slab per
+    step — the progressive-extension scheme the 2D band engines use);
+    ``fuse=None`` picks (bz, K) from the VMEM budget via the shared
+    planner (:func:`fused_cfg`), ``fuse=1`` forces the single-step
+    block/ring kernels.  ``fuse_bz`` overrides the fused band depth
+    (tests use it to exercise nz % (bz*K) != 0 layouts).
 
     ``ext_halo=True`` builds the sharded building block: ``shape`` is one
     device's z-block, the input stack carries ONE exchanged halo slab at
@@ -151,6 +231,23 @@ def make_pallas_iterate(model: Model, shape, dtype=jnp.float32,
         raise ValueError(f"pallas path unsupported for {model.name} {shape}")
     nz, ny, nx = (int(s) for s in shape)
     bz = _slab_depth(model, nz, ny, nx) or 1
+    if ext_halo:
+        fuse = 1
+    if fuse is None:
+        cfg = fused_cfg(model, shape)
+    else:
+        cfg = None
+        if fuse >= 2:
+            bzf = fuse_bz
+            if bzf is None:
+                bzf = max(b for b in range(1, nz + 1) if nz % b == 0
+                          and (b == 1
+                               or _fused_fits(model, nz, ny, nx, b, fuse)))
+            if nz % bzf:
+                raise ValueError(f"fused band depth {bzf} must divide {nz}")
+            cfg = (bzf, fuse)
+    K = cfg[1] if cfg else 1
+    bzK = cfg[0] if cfg else bz
     if interpret is None:
         interpret = jax.default_backend() != "tpu"
     is_cumulant = model.name == "d3q27_cumulant"
@@ -182,6 +279,9 @@ def make_pallas_iterate(model: Model, shape, dtype=jnp.float32,
     # not merely covering it (a model registering avg/SynthT densities in
     # a different order would silently read wrong planes)
     assert f_idx + aux_idx == list(range(ns))
+    zshift = model.zone_shift
+    zone_max = model.zone_max
+    zonal_si = [si[n] for n in zonal_names]
 
     def _is(flags, name):
         mask, val = nt[name]
@@ -218,10 +318,17 @@ def make_pallas_iterate(model: Model, shape, dtype=jnp.float32,
             f = jnp.where(coll[None], Fp.reshape(f.shape), f)
             return f, ((rho - 1.0) / 3.0, (ux, uy, uz))
         if q == 19:
-            from tclb_tpu.ops.pallas_d2q9 import _sparse_matvec
-            rho = sum(f[k] for k in range(19))
-            u = tuple(sum(float(E19[k, a]) * f[k] for k in range(19)
-                          if E19[k, a]) / rho for a in range(3))
+            # rho/u spelled exactly as models/d3q19.py computes them
+            # (jnp.sum reduce + edot) so the kernel is bit-identical to
+            # the XLA path, not merely allclose.  The barriers pin the
+            # collision's input (the boundary select chain) and output
+            # (before the coll select): fused, either select alters the
+            # FMA contraction of the relaxation arithmetic, which in the
+            # XLA path lowers contraction-free — same 1-ULP class as the
+            # streaming-roll barrier above
+            f = jax.lax.optimization_barrier(f)
+            rho = jnp.sum(f, axis=0)
+            u = tuple(lbm.edot(E19[:, a], f) / rho for a in range(3))
             feq = lbm.equilibrium(E19, W19, rho, u)
             g = tuple(sett[si[f"Gravitation{a}"]] for a in "XYZ")
             u2 = tuple(u[a] + g[a] for a in range(3))
@@ -242,11 +349,11 @@ def make_pallas_iterate(model: Model, shape, dtype=jnp.float32,
                     M19, 4, 10, fneq,
                     1.0 - sett[si["omega"]], 1.0 - sett[si["S_high"]])
                 fc = jnp.stack([relax[k] + feq2[k] for k in range(19)])
+            fc = jax.lax.optimization_barrier(fc)
             return jnp.where(coll[None], fc, f), None
         from tclb_tpu.models.d3q27_bgk import _equilibrium
-        rho = sum(f[k] for k in range(27))
-        u = tuple(sum(float(E[k, a]) * f[k] for k in range(27)
-                      if E[k, a]) / rho for a in range(3))
+        rho = jnp.sum(f, axis=0)
+        u = tuple(lbm.edot(E[:, a], f) / rho for a in range(3))
         om = sett[si["omega"]]
         feq = _equilibrium(rho, u, galcor)
         fc = f + om * (feq - f)
@@ -337,7 +444,11 @@ def make_pallas_iterate(model: Model, shape, dtype=jnp.float32,
             if dx:
                 sl = pltpu.roll(sl, dx % nx, axis=2)
             pulled.append(sl)
-        f = jnp.stack(pulled)
+        # the barrier pins the streamed values before collision: without
+        # it the compiler fuses the rolls into the collide arithmetic,
+        # changing FMA contraction and breaking bit-parity with the XLA
+        # path (where streaming materializes before the collide fusion)
+        f = jax.lax.optimization_barrier(jnp.stack(pulled))
         flags = flags_ref[:]
         zonal = zonal_ref[:]
         synth = [scra[aslot, aux_idx.index(j)] for j in synth_idx] \
@@ -422,7 +533,11 @@ def make_pallas_iterate(model: Model, shape, dtype=jnp.float32,
             if dx:
                 sl = pltpu.roll(sl, dx % nx, axis=2)
             pulled.append(sl)
-        f = jnp.stack(pulled)
+        # the barrier pins the streamed values before collision: without
+        # it the compiler fuses the rolls into the collide arithmetic,
+        # changing FMA contraction and breaking bit-parity with the XLA
+        # path (where streaming materializes before the collide fusion)
+        f = jax.lax.optimization_barrier(jnp.stack(pulled))
         flags = flags_ref[:]
         zonal = zonal_ref[:]
         synth = [scra[slot, aux_idx.index(j)] for j in synth_idx] \
@@ -493,8 +608,153 @@ def make_pallas_iterate(model: Model, shape, dtype=jnp.float32,
         # exactly the order this kernel's zonal_ref expects
         return call, bz, zonal_names
 
-    zshift = model.zone_shift
-    zonal_si = [si[n] for n in zonal_names]
+    H = bzK + 2 * K   # fused buffer depth: band + K wrapped halo slabs/side
+
+    def kernel_fused(sett, ztab, f_hbm, flags_hbm, out_ref, scrf, scrg,
+                     sems):
+        """Multi-step fused band kernel: K lattice steps per HBM round
+        trip.  The DMA'd buffer carries K wrapped halo slabs per side
+        (f + aux stack AND flags — boundary dispatch in the halo region
+        needs true node types so the recomputed halo sites agree with
+        their home band's values); step j (0-based) computes buffer rows
+        [j+1, H-(j+1)) from rows [j, H-j) of the step-(j-1) state, so
+        after K steps rows [K, K+bz) hold the valid K-step-advanced
+        band.  Zonal settings never ride the DMA: they are a pure
+        function of the flag zone bits and the SMEM zone table, so they
+        are reconstructed in-kernel (fusion.zone_plane) — the same aux
+        diet the generic engine runs.  The 2-slot double-buffered band
+        pipeline is kept: band i+1's (wider) blocks prefetch under band
+        i's K-step compute."""
+        i = pl.program_id(0)
+        n = pl.num_programs(0)
+
+        def band_dmas(slot, band):
+            base = band * jnp.int32(bzK)
+            copies = [
+                pltpu.make_async_copy(
+                    f_hbm.at[:, pl.ds(base, bzK)],
+                    scrf.at[slot, :, pl.ds(K, bzK)], sems.at[slot, 0]),
+                pltpu.make_async_copy(
+                    flags_hbm.at[pl.ds(base, bzK)],
+                    scrg.at[slot, pl.ds(K, bzK)], sems.at[slot, 1]),
+            ]
+            # halo slabs copied one at a time with individual wrapped
+            # indices (a block copy would straddle the periodic seam)
+            for h in range(1, K + 1):
+                zm = jax.lax.rem(base - jnp.int32(h) + jnp.int32(nz),
+                                 jnp.int32(nz))
+                zp = jax.lax.rem(base + jnp.int32(bzK - 1 + h),
+                                 jnp.int32(nz))
+                s = 2 + 4 * (h - 1)
+                copies += [
+                    pltpu.make_async_copy(
+                        f_hbm.at[:, pl.ds(zm, 1)],
+                        scrf.at[slot, :, pl.ds(K - h, 1)],
+                        sems.at[slot, s]),
+                    pltpu.make_async_copy(
+                        f_hbm.at[:, pl.ds(zp, 1)],
+                        scrf.at[slot, :, pl.ds(K + bzK - 1 + h, 1)],
+                        sems.at[slot, s + 1]),
+                    pltpu.make_async_copy(
+                        flags_hbm.at[pl.ds(zm, 1)],
+                        scrg.at[slot, pl.ds(K - h, 1)],
+                        sems.at[slot, s + 2]),
+                    pltpu.make_async_copy(
+                        flags_hbm.at[pl.ds(zp, 1)],
+                        scrg.at[slot, pl.ds(K + bzK - 1 + h, 1)],
+                        sems.at[slot, s + 3]),
+                ]
+            return copies
+
+        slot = jax.lax.rem(i, jnp.int32(2))
+        nxt = jax.lax.rem(i + jnp.int32(1), jnp.int32(2))
+
+        @pl.when(i == 0)
+        def _():
+            for c in band_dmas(jnp.int32(0), i):
+                c.start()
+
+        @pl.when(i + 1 < n)
+        def _():
+            for c in band_dmas(nxt, i + jnp.int32(1)):
+                c.start()
+
+        for c in band_dmas(slot, i):
+            c.wait()
+
+        flagbuf = scrg[slot]
+        zones = flagbuf >> zshift
+        zonalbuf = [fusion.zone_plane(ztab, c, zone_max, zones)
+                    for c in range(len(zonal_names))]
+        synthbuf = [scrf[slot, j] for j in synth_idx] if is_cumulant \
+            else None
+        if is_cumulant:
+            acc_p = scrf[slot, avgp_idx, K:K + bzK]
+            acc_u = [scrf[slot, j, K:K + bzK] for j in avgu_idx]
+
+        cur = [scrf[slot, k] for k in range(q)]   # rows [0, H)
+        for j in range(K):
+            lo = j + 1                       # output window in buffer rows
+            n_j = bzK + 2 * (K - 1 - j)
+            pulled = []
+            for k in range(q):
+                dx, dy, dz = int(E_[k, 0]), int(E_[k, 1]), int(E_[k, 2])
+                a = lo - dz - j              # cur[k] covers rows [j, H-j)
+                sl = cur[k][a:a + n_j]
+                if dy:
+                    sl = jnp.roll(sl, dy, axis=1)
+                if dx:
+                    sl = pltpu.roll(sl, dx % nx, axis=2)
+                pulled.append(sl)
+            # barrier before collision, same reason as the single-step
+            # kernels: keep the rolls out of the collide fusion so every
+            # fused step's arithmetic is bit-identical to an XLA step
+            f = jax.lax.optimization_barrier(jnp.stack(pulled))
+            flags = flagbuf[lo:lo + n_j]
+            zonal = [zb[lo:lo + n_j] for zb in zonalbuf]
+            synth = [sb[lo:lo + n_j] for sb in synthbuf] \
+                if is_cumulant else None
+            fnew, extras = _step(f, flags, zonal, synth, sett)
+            cur = [fnew[k] for k in range(q)]   # now rows [lo, lo + n_j)
+            if is_cumulant:
+                # running averages accumulate on the central band only,
+                # in the same left-fold order as K single XLA steps
+                c0 = K - lo
+                p_inc, us = extras
+                acc_p = acc_p + p_inc[c0:c0 + bzK]
+                acc_u = [au + u[c0:c0 + bzK] for au, u in zip(acc_u, us)]
+
+        for k in range(q):
+            out_ref[k] = cur[k]
+        if is_cumulant:
+            for j in synth_idx:
+                out_ref[j] = scrf[slot, j, K:K + bzK]
+            out_ref[avgp_idx] = acc_p
+            for j, au in zip(avgu_idx, acc_u):
+                out_ref[j] = au
+
+    if K >= 2:
+        call_f = pl.pallas_call(
+            kernel_fused,
+            grid=(nz // bzK,),
+            in_specs=[
+                pl.BlockSpec(memory_space=pltpu.SMEM),
+                pl.BlockSpec(memory_space=pltpu.SMEM),
+                pl.BlockSpec(memory_space=pl.ANY),
+                pl.BlockSpec(memory_space=pl.ANY),
+            ],
+            out_specs=pl.BlockSpec((ns, bzK, ny, nx), lambda i: (0, i, 0, 0),
+                                   memory_space=pltpu.VMEM),
+            out_shape=jax.ShapeDtypeStruct((ns, nz, ny, nx), dtype),
+            scratch_shapes=[
+                pltpu.VMEM((2, ns, H, ny, nx), dtype),
+                pltpu.VMEM((2, H, ny, nx), jnp.int32),
+                pltpu.SemaphoreType.DMA((2, 2 + 4 * K)),
+            ],
+            interpret=interpret,
+            compiler_params=_CompilerParams(
+                vmem_limit_bytes=_FUSED_VMEM_LIMIT),
+        )
 
     @partial(jax.jit, static_argnames=("niter",), donate_argnums=0)
     def _iterate_jit(state: LatticeState, params: SimParams,
@@ -504,11 +764,23 @@ def make_pallas_iterate(model: Model, shape, dtype=jnp.float32,
         zonal = jnp.stack([params.zone_table[j].astype(dtype)[zones]
                            for j in zonal_si])
         sett = params.settings.astype(dtype)
+        fields = state.fields
+
+        if K >= 2:
+            ztab = jnp.concatenate(
+                [params.zone_table[j].astype(dtype) for j in zonal_si])
+
+            def body_f(fields, _):
+                return call_f(sett, ztab, fields, flags_i32), None
+
+            fields, _ = jax.lax.scan(body_f, fields, None,
+                                     length=niter // K)
 
         def body(fields, _):
             return call(sett, fields, flags_i32, zonal), None
 
-        fields, _ = jax.lax.scan(body, state.fields, None, length=niter)
+        rem = niter % K if K >= 2 else niter
+        fields, _ = jax.lax.scan(body, fields, None, length=rem)
         return LatticeState(
             fields=fields,
             flags=state.flags,

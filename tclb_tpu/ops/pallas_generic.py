@@ -49,6 +49,7 @@ from jax.experimental.pallas import tpu as pltpu
 from tclb_tpu.core.lattice import (LatticeState, NodeCtx, SimParams,
                                    series_dt_overrides, series_overrides)
 from tclb_tpu.core.registry import Model
+from tclb_tpu.ops import fusion
 from tclb_tpu.ops.lbm import present_types  # noqa: F401  (re-export)
 
 # jax < 0.5 names the Pallas TPU params dataclass TPUCompilerParams
@@ -105,6 +106,15 @@ def action_plan(model: Model, action: str = "Iteration", fuse: int = 1
         plan[i] = (names[i], ext)
         ext += _stage_reach(model, names[i])
     return plan, ext
+
+
+def choose_fuse(model: Model, fmax: int = fusion.FUSE_MAX) -> int:
+    """Fusion depth for the 2D band engine: the deepest fuse whose
+    fused-plan reach still fits the fixed 8-row DMA halo.  The halo
+    (and so the per-call HBM traffic) is constant in fuse, so the win
+    is linear — K steps amortize one band round trip."""
+    return fusion.choose_fuse_band(
+        lambda f: action_plan(model, "Iteration", fuse=f)[1], _HALO, fmax)
 
 
 # --------------------------------------------------------------------------- #
@@ -560,6 +570,17 @@ def make_pallas_iterate(model: Model, shape, dtype=jnp.float32,
 
     n_storage = model.n_storage
     zonal_names = list(model.zonal_settings)
+    zshift = model.zone_shift
+    zone_max = model.zone_max
+    si = model.setting_index
+    zonal_si = [si[nm] for nm in zonal_names]
+    # aux diet: the non-series flavors DMA ONLY the flag plane — zonal
+    # settings are iteration-invariant there, a pure function of the
+    # flag zone bits, so they are reconstructed in-kernel from the SMEM
+    # zone table (fusion.zone_plane) instead of riding every HBM round
+    # trip as full planes.  Series flavors keep the full aux stack (the
+    # per-iteration _DT overrides genuinely change per step).
+    lean_aux = len(zonal_names) > 0
     nt_present = set(model.node_types) if present is None else set(present)
     if pad > 2 * mirror:
         nt_present = nt_present | {"Wall"}   # middle ghost rows are walls
@@ -567,7 +588,7 @@ def make_pallas_iterate(model: Model, shape, dtype=jnp.float32,
         import os
         full_band = os.environ.get("TCLB_FULLBAND", "0") == "1"
 
-    def _mk_kernel(plan, with_dt=False, with_globals=False):
+    def _mk_kernel(plan, with_dt=False, with_globals=False, lean=False):
         """Kernel flavor factory: ``with_dt`` adds per-iteration _DT
         planes to the aux stack (the Control-series flavor), and
         ``with_globals`` accumulates the model's SUM Globals in-kernel
@@ -576,18 +597,25 @@ def make_pallas_iterate(model: Model, shape, dtype=jnp.float32,
         ``with_globals="split"`` emits a (2, 8, 128) block instead —
         [0] the whole fused chunk's sums (the objective increment), [1]
         the LAST repetition's only (last-iteration globals semantics,
-        used by the chunked diff step)."""
-        def kern(sett, it_ref, f_hbm, aux_hbm, *refs):
+        used by the chunked diff step).  ``lean`` is the aux-diet
+        flavor: an extra SMEM zone-table input, flags-only aux stack,
+        zonal planes rebuilt in-kernel."""
+        def kern(sett, it_ref, *rest):
+            if lean:
+                ztab, f_hbm, aux_hbm, *refs = rest
+            else:
+                ztab = None
+                f_hbm, aux_hbm, *refs = rest
             if with_globals:
                 out_ref, g_ref, buff, bufa, sems = refs
             else:
                 (out_ref, buff, bufa, sems), g_ref = refs, None
-            kernel(plan, with_dt, with_globals, sett, it_ref, f_hbm,
+            kernel(plan, with_dt, with_globals, ztab, sett, it_ref, f_hbm,
                    aux_hbm, out_ref, g_ref, buff, bufa, sems)
         return kern
 
-    def kernel(plan, with_dt, with_globals, sett, it_ref, f_hbm, aux_hbm,
-               out_ref, g_ref, buff, bufa, sems):
+    def kernel(plan, with_dt, with_globals, ztab, sett, it_ref, f_hbm,
+               aux_hbm, out_ref, g_ref, buff, bufa, sems):
         """One band pass = the whole Iteration action (x fuse).  The band
         plus 8-row halo blocks land in ONE contiguous (by+16)-row buffer
         per stack, so every extended-row access below is a single slice;
@@ -656,10 +684,18 @@ def make_pallas_iterate(model: Model, shape, dtype=jnp.float32,
         # (functionally — row-concat), later stages read the updates.
         work = [buff[slot, k] for k in range(n_storage)]
         flags_full = bufa[slot, 0].astype(jnp.int32)
-        zonal_full = {nm: bufa[slot, 1 + j]
-                      for j, nm in enumerate(zonal_names)}
-        dt_full = {nm: bufa[slot, 1 + len(zonal_names) + j]
-                   for j, nm in enumerate(zonal_names)} if with_dt else {}
+        if ztab is not None:
+            zones_full = flags_full >> zshift
+            zonal_full = {nm: fusion.zone_plane(ztab, j, zone_max,
+                                                zones_full)
+                          for j, nm in enumerate(zonal_names)}
+            dt_full = {}
+        else:
+            zonal_full = {nm: bufa[slot, 1 + j]
+                          for j, nm in enumerate(zonal_names)}
+            dt_full = {nm: bufa[slot, 1 + len(zonal_names) + j]
+                       for j, nm in enumerate(zonal_names)} \
+                if with_dt else {}
 
         work, g_acc, g_last = run_action_plan(
             model, plan, work, flags_full, zonal_full, dt_full, sett,
@@ -702,8 +738,9 @@ def make_pallas_iterate(model: Model, shape, dtype=jnp.float32,
 
     grid = (ny // by,)
 
-    def _mk_call(plan_n, with_dt=False, with_globals=False):
-        n_aux_k = 1 + (2 if with_dt else 1) * len(zonal_names)
+    def _mk_call(plan_n, with_dt=False, with_globals=False, lean=False):
+        n_aux_k = 1 if lean \
+            else 1 + (2 if with_dt else 1) * len(zonal_names)
         out_specs = pl.BlockSpec((n_storage, by, nx), lambda i: (0, i, 0),
                                  memory_space=pltpu.VMEM)
         out_shape = jax.ShapeDtypeStruct((n_storage, ny, nx), dtype)
@@ -719,11 +756,13 @@ def make_pallas_iterate(model: Model, shape, dtype=jnp.float32,
         import os
         vmem_mb = int(os.environ.get("TCLB_VMEM_LIMIT_MB", "0"))
         return pl.pallas_call(
-            _mk_kernel(plan_n, with_dt, with_globals),
+            _mk_kernel(plan_n, with_dt, with_globals, lean),
             grid=grid,
             in_specs=[
                 pl.BlockSpec(memory_space=pltpu.SMEM),
                 pl.BlockSpec(memory_space=pltpu.SMEM),
+            ] + ([pl.BlockSpec(memory_space=pltpu.SMEM)] if lean else [])
+            + [
                 pl.BlockSpec(memory_space=pl.ANY),
                 pl.BlockSpec(memory_space=pl.ANY),
             ],
@@ -740,20 +779,21 @@ def make_pallas_iterate(model: Model, shape, dtype=jnp.float32,
             interpret=interpret,
         )
 
-    call = _mk_call(plan)
-
     if ext_halo:
-        return call, by, zonal_names
+        # the sharded building block keeps the full-aux convention: the
+        # halo composer assembles + exchanges aux planes host-side
+        return _mk_call(plan), by, zonal_names
 
+    call = _mk_call(plan, lean=lean_aux)
     plan1 = plan if fuse == 1 \
         else action_plan(model, "Iteration", fuse=1)[0]
-    call1 = call if fuse == 1 else _mk_call(plan1)
+    call1 = call if fuse == 1 else _mk_call(plan1, lean=lean_aux)
     # in-kernel globals flavor (final step of an iterate call): SUM only —
     # MAX would need max-combining across bands/stages (no model uses MAX)
     can_globals = (nx % 128 == 0
                    and model.n_globals <= 8   # the (8, 128) partials block
                    and all(g.op == "SUM" for g in model.globals_))
-    call_g = _mk_call(plan1, with_globals=True) \
+    call_g = _mk_call(plan1, with_globals=True, lean=lean_aux) \
         if can_globals and model.n_globals else None
     # Control-series flavors: per-iteration zonal + _DT planes, fuse=1
     # (fused steps would reuse iteration t's settings at t+1)
@@ -763,9 +803,6 @@ def make_pallas_iterate(model: Model, shape, dtype=jnp.float32,
     # one action rep advances the iteration counter iff any stage streams
     adv = int(any(model.stages[s].load_densities
                   for s in model.actions["Iteration"]))
-    zshift = model.zone_shift
-    si = model.setting_index
-    zonal_si = [si[nm] for nm in zonal_names]
 
     @partial(jax.jit, static_argnames=("niter",), donate_argnums=0)
     def _iterate_jit(state: LatticeState, params: SimParams, niter: int
@@ -823,28 +860,43 @@ def make_pallas_iterate(model: Model, shape, dtype=jnp.float32,
             (fields, it), _ = jax.lax.scan(
                 body_s, (fields, state.iteration), None, length=main)
         else:
-            aux = aux_of(state.iteration)
+            if lean_aux:
+                # aux diet: the DMA'd aux stack is the flag plane alone;
+                # the zone table rides in SMEM and the kernel rebuilds
+                # the (iteration-invariant) zonal planes itself
+                ztab = jnp.concatenate(
+                    [params.zone_table[k].astype(dtype) for k in zonal_si])
+                aux = flags_f[None]
+
+                def invoke(c, it, fields):
+                    return c(sett, it[None], ztab, refresh(fields), aux)
+            else:
+                aux = aux_of(state.iteration)
+
+                def invoke(c, it, fields):
+                    return c(sett, it[None], refresh(fields), aux)
 
             def body(carry, _):
                 fields, it = carry
-                out = call(sett, it[None], refresh(fields), aux)
-                return (out, it + adv * fuse), None
+                return (invoke(call, it, fields), it + adv * fuse), None
 
             (fields, it), _ = jax.lax.scan(
                 body, (fields, state.iteration), None, length=main // fuse)
 
             def body1(carry, _):
                 fields, it = carry
-                out = call1(sett, it[None], refresh(fields), aux)
-                return (out, it + adv), None
+                return (invoke(call1, it, fields), it + adv), None
 
             (fields, it), _ = jax.lax.scan(
                 body1, (fields, it), None, length=main % fuse)
 
         globals_ = jnp.zeros_like(state.globals_)
         if final_g is not None:
-            fields, gpart = final_g(sett, it[None], refresh(fields),
-                                    aux_of(it))
+            if has_series:
+                fields, gpart = final_g(sett, it[None], refresh(fields),
+                                        aux_of(it))
+            else:
+                fields, gpart = invoke(final_g, it, fields)
             it = it + adv
             globals_ = gpart[:model.n_globals].sum(axis=1).astype(
                 state.globals_.dtype)
@@ -1069,23 +1121,65 @@ def make_resident_iterate(model: Model, shape, dtype=jnp.float32,
 # --------------------------------------------------------------------------- #
 
 
+# fused (fuse>=2) 3D calls budget a larger scratch against the raised
+# 100 MB scoped-vmem ceiling they always compile with — the wider K*R
+# halo is what buys the K-fold traffic amortization
+_FUSED3D_BUDGET = 28 * 1024 * 1024
+
+
 def _slab_depth_gen(model: Model, nz: int, ny: int, nx: int,
-                    reach: int, cap: Optional[int] = None) -> Optional[int]:
+                    reach: int, cap: Optional[int] = None,
+                    n_aux: Optional[int] = None,
+                    budget: Optional[int] = None) -> Optional[int]:
     """Largest slab depth BZ dividing nz whose double-slotted scratch
     (state + aux, band + ``reach`` halo slabs each side) fits the budget.
     Unlike the 2D rows, z is NOT a tiled axis, so halos are exactly
     ``reach`` slabs — no 8-alignment games."""
-    n_aux = 1 + 2 * len(model.zonal_settings)   # series flavor's aux
+    if n_aux is None:
+        n_aux = 1 + 2 * len(model.zonal_settings)   # series flavor's aux
     per_slab = (model.n_storage + n_aux) * ny * nx * 4
+    if budget is None:
+        budget = 12 * 1024 * 1024
     best = None
     for bz in range(1, (nz if cap is None else min(nz, cap)) + 1):
         if nz % bz:
             continue
         # double-slotted scratch; compute temporaries live in the rest of
         # VMEM (the same ~15 MB working budget the tuned 3D kernel uses)
-        if 2 * (bz + 2 * reach) * per_slab > 12 * 1024 * 1024:
+        if 2 * (bz + 2 * reach) * per_slab > budget:
             break
         best = bz
+    return best
+
+
+def choose_fuse_3d(model: Model, shape,
+                   fmax: int = fusion.FUSE_MAX) -> int:
+    """Fusion depth for the 3D generic z-slab engine: deepest K whose
+    fused plan both fits the (raised-ceiling) VMEM budget at some slab
+    depth AND beats the single-step engine's modeled traffic.  3D halos
+    are real slabs (not fixed-height row blocks), so unlike 2D the halo
+    cost grows with K and the planner must weigh it."""
+    nz, ny, nx = (int(s) for s in shape)
+    _, r1 = action_plan(model, "Iteration", fuse=1)
+    R1 = max(r1, 1)
+    ns = model.n_storage
+    bz1 = _slab_depth_gen(model, nz, ny, nx, R1)
+    if bz1 is None:
+        return 1
+    # lean aux: the non-series kernels move ns + 1 planes per slab
+    best, best_c = 1, ((ns + 1) * (bz1 + 2 * R1) + ns * bz1) / bz1
+    for K in range(2, fmax + 1):
+        _, rK = action_plan(model, "Iteration", fuse=K)
+        RK = max(rK, 1)
+        if nz < 2 * RK:
+            break
+        bzK = _slab_depth_gen(model, nz, ny, nx, RK, n_aux=1,
+                              budget=_FUSED3D_BUDGET)
+        if bzK is None:
+            continue
+        c = ((ns + 1) * (bzK + 2 * RK) + ns * bzK) / (K * bzK)
+        if c < best_c:
+            best, best_c = K, c
     return best
 
 
@@ -1143,40 +1237,69 @@ def make_pallas_iterate_3d(model: Model, shape, dtype=jnp.float32,
     """3D generic engine: the model's full Iteration action per z-slab
     band pass, with the same registry-driven machinery as the 2D builder
     (multi-stage extension plan, zonal aux planes, in-kernel SUM globals
-    flavor, Control-series flavor).  ``fuse``/``by_cap`` accepted for
-    dispatch-signature parity; temporal fusion is not implemented in 3D
-    (the kernels are VPU-compute-bound — halving traffic buys nothing)."""
+    flavor, Control-series flavor).  ``fuse=K`` runs K action reps per
+    HBM round trip: the fused action plan's progressive windows already
+    encode the shrinking interiors, so the kernel machinery is identical
+    — only the halo widens to the fused plan's reach and the non-series
+    scan advances K iterations per call (remainder steps use a fuse=1
+    flavor)."""
     if not supports_3d(model, shape, dtype, probe=False):
         raise ValueError(f"pallas_generic 3d unsupported: {model.name} "
                          f"{shape}")
-    plan, reach = action_plan(model, "Iteration", fuse=1)
+    plan, reach = action_plan(model, "Iteration", fuse=fuse)
     R = max(reach, 1)
+    plan1, r1 = (plan, reach) if fuse == 1 \
+        else action_plan(model, "Iteration", fuse=1)
+    R1 = max(r1, 1)
     nz, ny, nx = (int(s) for s in shape)
+    if nz < 2 * R:
+        raise ValueError(f"fuse={fuse} needs nz >= {2 * R}")
     # the Lattice probe ladder passes row-oriented caps (16, 8); for
     # z-slabs interpret them as a slab-depth cap (8 rows ~ 1 slab) so the
     # retry actually shrinks the scoped-VMEM working set.  NEGATIVE caps
     # are the last-resort rungs: |cap| plus a raised scoped-vmem ceiling
     # (the big ceiling costs ~2x in Mosaic codegen quality, so it is
     # never the default — only what rescues temporaries-heavy models
-    # like d3q19_kuper that OOM even at bz=1)
-    vmem_ceiling = by_cap is not None and by_cap < 0
+    # like d3q19_kuper that OOM even at bz=1).  Fused (K>=2) builds
+    # always compile with the raised ceiling: their K*R halo scratch is
+    # budgeted against it (_FUSED3D_BUDGET).
+    vmem_ceiling = (by_cap is not None and by_cap < 0) or fuse >= 2
     cap = None if by_cap is None else max(1, abs(by_cap) // 8)
-    bz = _slab_depth_gen(model, nz, ny, nx, R, cap)
+    bz = _slab_depth_gen(model, nz, ny, nx, R, cap, n_aux=1,
+                         budget=_FUSED3D_BUDGET) if fuse >= 2 \
+        else _slab_depth_gen(model, nz, ny, nx, R, cap)
+    if bz is None:
+        raise ValueError(f"no slab depth fits fuse={fuse} for "
+                         f"{model.name} {shape}")
     if interpret is None:
         interpret = jax.default_backend() != "tpu"
 
     ns = model.n_storage
     zonal_names = list(model.zonal_settings)
+    zshift = model.zone_shift
+    zone_max = model.zone_max
+    si = model.setting_index
+    zonal_si = [si[nm] for nm in zonal_names]
+    # same aux diet as 2D: non-series flavors DMA flags only and rebuild
+    # zonal planes in-kernel from the SMEM zone table
+    lean_aux = len(zonal_names) > 0
     ei = model.ei
     stage_fns = {nm: model.stage_fns[model.stages[nm].main]
-                 for nm, _ in plan}
-    loads_density = {nm: model.stages[nm].load_densities for nm, _ in plan}
+                 for nm in model.actions["Iteration"]}
+    loads_density = {nm: model.stages[nm].load_densities
+                     for nm in model.actions["Iteration"]}
     nt_present = set(model.node_types) if present is None else set(present)
 
-    def _mk_kernel(with_dt=False, with_globals=False):
-        n_aux_k = 1 + (2 if with_dt else 1) * len(zonal_names)
+    def _mk_kernel(plan, R, with_dt=False, with_globals=False, lean=False):
+        n_aux_k = 1 if lean \
+            else 1 + (2 if with_dt else 1) * len(zonal_names)
 
-        def kern(sett, it_ref, f_hbm, aux_hbm, *refs):
+        def kern(sett, it_ref, *rest):
+            if lean:
+                ztab, f_hbm, aux_hbm, *refs = rest
+            else:
+                ztab = None
+                f_hbm, aux_hbm, *refs = rest
             if with_globals:
                 out_ref, g_ref, buff, bufa, sems = refs
             else:
@@ -1240,11 +1363,18 @@ def make_pallas_iterate_3d(model: Model, shape, dtype=jnp.float32,
 
             work = [buff[slot, k] for k in range(ns)]
             flags_full = bufa[slot, 0].astype(jnp.int32)
-            zonal_full = {nm: bufa[slot, 1 + j]
-                          for j, nm in enumerate(zonal_names)}
-            dt_full = {nm: bufa[slot, 1 + len(zonal_names) + j]
-                       for j, nm in enumerate(zonal_names)} \
-                if with_dt else {}
+            if ztab is not None:
+                zones_full = flags_full >> zshift
+                zonal_full = {nm: fusion.zone_plane(ztab, j, zone_max,
+                                                    zones_full)
+                              for j, nm in enumerate(zonal_names)}
+                dt_full = {}
+            else:
+                zonal_full = {nm: bufa[slot, 1 + j]
+                              for j, nm in enumerate(zonal_names)}
+                dt_full = {nm: bufa[slot, 1 + len(zonal_names) + j]
+                           for j, nm in enumerate(zonal_names)} \
+                    if with_dt else {}
             g_acc: dict = {}
 
             n_per_rep = len(model.actions["Iteration"])
@@ -1316,8 +1446,10 @@ def make_pallas_iterate_3d(model: Model, shape, dtype=jnp.float32,
 
         return kern, n_aux_k
 
-    def _mk_call(with_dt=False, with_globals=False):
-        kern, n_aux_k = _mk_kernel(with_dt, with_globals)
+    def _mk_call(plan_k, R_k, with_dt=False, with_globals=False,
+                 lean=False):
+        kern, n_aux_k = _mk_kernel(plan_k, R_k, with_dt, with_globals,
+                                   lean)
         out_specs = pl.BlockSpec((ns, bz, ny, nx), lambda i: (0, i, 0, 0),
                                  memory_space=pltpu.VMEM)
         out_shape = jax.ShapeDtypeStruct((ns, nz, ny, nx), dtype)
@@ -1333,15 +1465,17 @@ def make_pallas_iterate_3d(model: Model, shape, dtype=jnp.float32,
             in_specs=[
                 pl.BlockSpec(memory_space=pltpu.SMEM),
                 pl.BlockSpec(memory_space=pltpu.SMEM),
+            ] + ([pl.BlockSpec(memory_space=pltpu.SMEM)] if lean else [])
+            + [
                 pl.BlockSpec(memory_space=pl.ANY),
                 pl.BlockSpec(memory_space=pl.ANY),
             ],
             out_specs=out_specs,
             out_shape=out_shape,
             scratch_shapes=[
-                pltpu.VMEM((2, ns, bz + 2 * R, ny, nx), dtype),
-                pltpu.VMEM((2, n_aux_k, bz + 2 * R, ny, nx), dtype),
-                pltpu.SemaphoreType.DMA((2, 2 * (1 + 2 * R))),
+                pltpu.VMEM((2, ns, bz + 2 * R_k, ny, nx), dtype),
+                pltpu.VMEM((2, n_aux_k, bz + 2 * R_k, ny, nx), dtype),
+                pltpu.SemaphoreType.DMA((2, 2 * (1 + 2 * R_k))),
             ],
             compiler_params=_CompilerParams(
                 vmem_limit_bytes=100 * 1024 * 1024)
@@ -1349,19 +1483,17 @@ def make_pallas_iterate_3d(model: Model, shape, dtype=jnp.float32,
             interpret=interpret,
         )
 
-    call = _mk_call()
+    call = _mk_call(plan, R, lean=lean_aux)
+    call1 = call if fuse == 1 else _mk_call(plan1, R1, lean=lean_aux)
     can_globals = (nx % 128 == 0 and model.n_globals <= 8
                    and all(g.op == "SUM" for g in model.globals_))
-    call_g = _mk_call(with_globals=True) \
+    call_g = _mk_call(plan1, R1, with_globals=True, lean=lean_aux) \
         if can_globals and model.n_globals else None
-    call_s = _mk_call(with_dt=True)
-    call_sg = _mk_call(with_dt=True, with_globals=True) \
+    call_s = _mk_call(plan1, R1, with_dt=True)
+    call_sg = _mk_call(plan1, R1, with_dt=True, with_globals=True) \
         if can_globals and model.n_globals else None
     adv = int(any(model.stages[s].load_densities
                   for s in model.actions["Iteration"]))
-    zshift = model.zone_shift
-    si = model.setting_index
-    zonal_si = [si[nm] for nm in zonal_names]
 
     @partial(jax.jit, static_argnames=("niter",), donate_argnums=0)
     def _iterate_jit(state: LatticeState, params: SimParams, niter: int
@@ -1384,21 +1516,59 @@ def make_pallas_iterate_3d(model: Model, shape, dtype=jnp.float32,
             return state
         main = niter - (1 if final_g is not None else 0)
 
-        body_call = call_s if has_series else call
-        aux_static = None if has_series else aux_of(state.iteration)
+        if has_series:
+            # series flavors keep the full host-assembled aux stack: the
+            # dt planes depend on the Control series, not just zone bits
+            def body_s(carry, _):
+                fields, it = carry
+                out = call_s(sett, it[None], fields, aux_of(it))
+                return (out, it + adv), None
 
-        def body(carry, _):
-            fields, it = carry
-            aux = aux_of(it) if has_series else aux_static
-            out = body_call(sett, it[None], fields, aux)
-            return (out, it + adv), None
+            (fields, it), _ = jax.lax.scan(
+                body_s, (fields, state.iteration), None, length=main)
+        else:
+            # lean aux: iteration-invariant zonal planes are rebuilt
+            # in-kernel from the SMEM zone table — the aux DMA leg
+            # carries exactly one flags plane, every step, regardless of
+            # how many zonal settings the model declares
+            if lean_aux:
+                ztab = jnp.concatenate(
+                    [params.zone_table[k].astype(dtype)
+                     for k in zonal_si])
+                aux = flags_f[None]
 
-        (fields, it), _ = jax.lax.scan(
-            body, (fields, state.iteration), None, length=main)
+                def invoke(c, it, fields):
+                    return c(sett, it[None], ztab, fields, aux)
+            else:
+                aux = aux_of(state.iteration)
+
+                def invoke(c, it, fields):
+                    return c(sett, it[None], fields, aux)
+
+            def body(carry, _):
+                fields, it = carry
+                out = invoke(call, it, fields)
+                return (out, it + adv * fuse), None
+
+            def body1(carry, _):
+                fields, it = carry
+                out = invoke(call1, it, fields)
+                return (out, it + adv), None
+
+            (fields, it), _ = jax.lax.scan(
+                body, (fields, state.iteration), None,
+                length=main // fuse)
+            if fuse > 1:
+                (fields, it), _ = jax.lax.scan(
+                    body1, (fields, it), None, length=main % fuse)
 
         globals_ = jnp.zeros_like(state.globals_)
         if final_g is not None:
-            fields, gpart = final_g(sett, it[None], fields, aux_of(it))
+            if has_series:
+                fields, gpart = final_g(sett, it[None], fields,
+                                        aux_of(it))
+            else:
+                fields, gpart = invoke(final_g, it, fields)
             it = it + adv
             globals_ = gpart[:model.n_globals].sum(axis=1).astype(
                 state.globals_.dtype)

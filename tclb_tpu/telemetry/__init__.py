@@ -20,4 +20,5 @@ from tclb_tpu.telemetry.events import (  # noqa: F401
     counter, counters, disable, enable, enabled, engine_fallback,
     engine_selected, event, failcheck, path)
 from tclb_tpu.telemetry.spans import (  # noqa: F401
-    HBM_GBS, NOOP_SPAN, Span, device_kind, roofline_mlups, span)
+    HBM_GBS, NOOP_SPAN, Span, device_kind, fuse_of, roofline_mlups,
+    span)

@@ -23,6 +23,7 @@ concurrent ``jax.profiler`` capture shows the same region names.
 
 from __future__ import annotations
 
+import re
 import time
 from typing import Any, Optional
 
@@ -62,6 +63,19 @@ def roofline_mlups(bytes_per_node: float,
     if hbm is None:
         hbm = HBM_GBS_FALLBACK
     return hbm * 1e9 / float(bytes_per_node) / 1e6, known
+
+
+def fuse_of(engine: Optional[str]) -> int:
+    """Temporal-fusion depth encoded in an engine name (the
+    ``,fuse=K`` tag every fused engine carries, e.g.
+    ``pallas_d3q[d3q19,fuse=3]``); 1 when absent (XLA, unfused
+    engines).  bench.py and the report CLI key their per-engine
+    credibility caps off this, so the tag format lives next to the
+    roofline table it feeds."""
+    if not engine:
+        return 1
+    m = re.search(r"[\[,]fuse=(-?\d+)", engine)
+    return int(m.group(1)) if m else 1
 
 
 class Span:

@@ -168,6 +168,21 @@ def test_footprint_fires_on_undeclared_read():
     assert not analysis.kernel_safety_ok(m)
 
 
+def test_footprint_fires_on_fusion_halo_overreach():
+    """A model whose NAME makes it eligible for the tuned fused z-slab
+    kernel but whose declarations reach 2 z-slabs per step: the fused
+    engine's K-slab halo grants exactly one reach-slab per fused step,
+    so this must surface as an error-severity fusion_halo finding (the
+    kernel would silently compute on stale halo slabs)."""
+    d = ModelDef("d3q19", ndim=3)       # spoofs the kernel allowlist
+    d.add_density("g", group="g")
+    d.add_field("phi", dz=(-2, 2))      # 2-slab z-stencil
+    run = _passthrough(["g", "phi"])
+    m = d.finalize().bind(run=run, init=run)
+    from tclb_tpu.analysis.footprint import check_footprint
+    assert "footprint.fusion_halo" in _error_checks(check_footprint(m))
+
+
 def test_resources_fire_on_vmem_overflow():
     d = ModelDef("fx_vmem", ndim=2)
     for i in range(120):

@@ -46,6 +46,14 @@ BENCH_FLOORS = {
     "d3q27_vs_roofline": 0.75,
     "d3q19_vs_roofline": 0.75,
     "d3q19_heat_vs_roofline": 0.62,
+    # serving: batched-32 aggregate throughput vs cached batch-1 serial
+    # dispatches of the same cases (a speedup ratio, not a roofline
+    # fraction) — the ensemble engine's reason to exist is amortizing
+    # the per-dispatch host round trip across the batch, so a batch of
+    # 32 tiny cases under 2x the serial rate means the lax.map engine
+    # or the compiled-executable cache regressed.  TPU-gated like every
+    # floor; the CPU smoke run prints the number informationally.
+    "ensemble_speedup_b32": 2.0,
 }
 
 
@@ -415,6 +423,67 @@ def bench_d3q27(results):
     return checks
 
 
+def bench_ensemble(results):
+    """Serving throughput: N independent tiny-d2q9 cases per dispatch
+    through serve.EnsemblePlan (the bit-parity ``mode="map"`` engine,
+    AOT-compiled via CompiledCache) vs the same cases served as cached
+    batch-1 dispatches.  Tiny grids are the serving regime — dispatch
+    latency dominates the per-case kernel time, and batching pays one
+    round trip for the whole batch.  Reports aggregate and per-case
+    MLUPS for batch sizes 1/8/32 plus the throughput-oriented
+    ``mode="vmap"`` engine at batch 8 as an informational extra."""
+    import jax.numpy as jnp
+    from tclb_tpu.models import get_model
+    from tclb_tpu.serve import Case, CompiledCache, EnsemblePlan
+
+    ny = nx = int(os.environ.get("TCLB_BENCH_ENSEMBLE_N", 64))
+    iters = int(os.environ.get("TCLB_BENCH_ITERS_ENSEMBLE", 50))
+    m = get_model("d2q9")
+    flags = np.full((ny, nx), m.flag_for("MRT"), dtype=np.uint16)
+    flags[0, :] = flags[-1, :] = m.flag_for("Wall")
+    base_settings = {"nu": 0.02, "Velocity": 0.01}
+    cases = [Case(settings={"nu": 0.02 + 0.0005 * i}, name=f"c{i}")
+             for i in range(32)]
+    nodes = float(ny * nx)
+    cache = CompiledCache(capacity=8)
+    plan = EnsemblePlan(m, (ny, nx), flags=flags,
+                        base_settings=base_settings)
+
+    def timed_run(p, batch_cases):
+        # same protocol as timed(): warmup compiles (and fills the
+        # cache); plan.run pulls per-case globals to host, so the timed
+        # region cannot return before the batch actually executed
+        p.run(batch_cases, iters, cache=cache)
+        t0 = time.perf_counter()
+        res = p.run(batch_cases, iters, cache=cache)
+        dt = time.perf_counter() - t0
+        assert all(np.isfinite(v) for r in res for v in r.globals.values())
+        return nodes * len(batch_cases) * iters / dt / 1e6
+
+    # serial baseline: the 8-case workload as batch-1 dispatches of the
+    # SAME cached executable (what serving looks like without binning)
+    plan.run(cases[:1], iters, cache=cache)      # compile batch-1 once
+    t0 = time.perf_counter()
+    for c in cases[:8]:
+        plan.run([c], iters, cache=cache)
+    dt = time.perf_counter() - t0
+    seq = nodes * 8 * iters / dt / 1e6
+    results["ensemble_seq_mlups"] = round(seq, 2)
+
+    for b in (1, 8, 32):
+        v = timed_run(plan, cases[:b])
+        results[f"ensemble_b{b}_mlups"] = round(v, 2)
+        results[f"ensemble_b{b}_per_case_mlups"] = round(v / b, 2)
+        if b > 1:
+            results[f"ensemble_speedup_b{b}"] = round(v / seq, 2)
+
+    vplan = EnsemblePlan(m, (ny, nx), flags=flags,
+                         base_settings=base_settings, mode="vmap")
+    results["ensemble_vmap_b8_mlups"] = round(timed_run(vplan, cases[:8]), 2)
+    results["ensemble_cache"] = cache.stats()
+    return []
+
+
 def main():
     import jax
 
@@ -430,6 +499,8 @@ def main():
         checks3d += bench_baseline_cases(results)
     with telemetry.span("bench.adjoint"):
         checks3d += bench_adjoint(results)
+    with telemetry.span("bench.ensemble"):
+        checks3d += bench_ensemble(results)
 
     dev = jax.devices()[0]
     hbm = HBM_GBS.get(dev.device_kind)

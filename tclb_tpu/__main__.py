@@ -134,6 +134,12 @@ def main(argv=None) -> int:
                    "coordinator:port,num_processes,process_id")
     r.set_defaults(fn=_cmd_run)
 
+    sw = sub.add_parser("sweep", help="batched parameter sweep over an "
+                        "XML base case")
+    from tclb_tpu.serve.__main__ import add_sweep_arguments, run_sweep
+    add_sweep_arguments(sw)
+    sw.set_defaults(fn=run_sweep)
+
     ls = sub.add_parser("models", help="list the model catalogue")
     ls.add_argument("--verbose", "-v", action="store_true")
     ls.set_defaults(fn=_cmd_models)

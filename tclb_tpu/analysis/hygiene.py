@@ -371,11 +371,161 @@ def scan_unrestorable_handlers(paths=None) -> list:
     return findings
 
 
+_CTX_TAINT_ATTRS = ("setting", "setting_dt")
+_HOST_CASTS = ("float", "int", "bool")
+
+
+def _is_ctx_setting_call(node) -> bool:
+    return (isinstance(node, ast.Call)
+            and isinstance(node.func, ast.Attribute)
+            and node.func.attr in _CTX_TAINT_ATTRS
+            and isinstance(node.func.value, ast.Name)
+            and node.func.value.id == "ctx")
+
+
+def _assigned_names(target) -> list:
+    """Names an assignment target binds.  A subscript store taints only
+    the container (``out[i] = tainted`` taints ``out``, never the index
+    ``i`` — an index is read, not bound)."""
+    if isinstance(target, ast.Name):
+        return [target.id]
+    if isinstance(target, (ast.Tuple, ast.List)):
+        return [x for e in target.elts for x in _assigned_names(e)]
+    if isinstance(target, (ast.Subscript, ast.Starred)):
+        return _assigned_names(target.value)
+    return []
+
+
+def scan_ensemble_unsafe(paths=None) -> list:
+    """Python-level branching/host-casting on per-case setting values in
+    model stage code.
+
+    Under the batched ensemble engine every case carries its *own*
+    ``SimParams``, so a setting is a traced per-case value — a
+    ``float(...)``/``int(...)``/``bool(...)`` cast, an ``.item()`` pull
+    or an ``if``-test on anything derived from ``ctx.setting``/
+    ``ctx.setting_dt`` freezes one case's value into the compiled
+    program (or fails outright under vmap) and silently breaks the
+    bit-parity contract for every other case in the batch.  Casts of
+    genuine host constants (``float(E[i, 0])`` on a numpy stencil
+    table) are fine and not flagged: taint starts at the ctx setting
+    accessors and propagates only through assigned names."""
+    if paths is None:
+        paths = _py_files(os.path.join(_PKG_ROOT, "models"))
+    findings = []
+    for path in paths:
+        try:
+            with open(path) as fh:
+                tree = ast.parse(fh.read())
+        except (OSError, SyntaxError) as e:
+            findings.append(Finding(
+                "hygiene.unparseable", "error", "",
+                f"cannot parse {path}: {e}", path))
+            continue
+        rel = os.path.relpath(path, _REPO_ROOT)
+        ctx_fns = [n for n in ast.walk(tree)
+                   if isinstance(n, (ast.FunctionDef, ast.AsyncFunctionDef))
+                   and n.args.args and n.args.args[0].arg == "ctx"]
+        seen: set = set()
+        for fn in ctx_fns:
+            # assignment events in source order.  Taint is replayed as a
+            # forward flow: a plain Name assignment from a clean RHS
+            # CLEARS the name (models reuse short names like ``c`` for
+            # both stencil constants and setting-derived arrays), a
+            # subscript store only ever adds taint to the container, and
+            # an augmented assignment keeps the old value's taint.
+            events: list = []
+            for n in ast.walk(fn):
+                if not isinstance(n, (ast.Assign, ast.AugAssign,
+                                      ast.AnnAssign)):
+                    continue
+                if n.value is None:
+                    continue
+                targets = (n.targets if isinstance(n, ast.Assign)
+                           else [n.target])
+                updates = []
+                for t in targets:
+                    strong = isinstance(n, (ast.Assign, ast.AnnAssign)) \
+                        and isinstance(t, (ast.Name, ast.Tuple, ast.List))
+                    for name in _assigned_names(t):
+                        updates.append((name, strong))
+                if updates:
+                    events.append((n.lineno, updates, n.value))
+            events.sort(key=lambda e: e[0])
+
+            def expr_tainted(e, tset) -> bool:
+                for n in ast.walk(e):
+                    if _is_ctx_setting_call(n):
+                        return True
+                    if isinstance(n, ast.Name) \
+                            and isinstance(n.ctx, ast.Load) \
+                            and n.id in tset:
+                        return True
+                return False
+
+            def taint_at(lineno: int) -> set:
+                tset: set = set()
+                for ln, updates, rhs in events:
+                    if ln >= lineno:
+                        break
+                    hot = expr_tainted(rhs, tset)
+                    for name, strong in updates:
+                        if hot:
+                            tset.add(name)
+                        elif strong:
+                            tset.discard(name)
+                return tset
+
+            def flag(lineno: int, what: str) -> None:
+                key = (rel, lineno, what)
+                if key in seen:
+                    return
+                seen.add(key)
+                findings.append(Finding(
+                    "hygiene.ensemble_unsafe", "error", "",
+                    f"{rel}:{lineno} {fn.name}: {what} on a "
+                    "ctx.setting-derived value — per-case settings are "
+                    "traced under the batched ensemble engine; this "
+                    "freezes one case's value into the compiled step "
+                    "(keep the computation in jax ops instead)",
+                    f"{rel}:{lineno}"))
+
+            def is_none_test(e) -> bool:
+                # ``x is None`` / ``x is not None`` are host-structural
+                # dispatch, not branching on the setting's value
+                return isinstance(e, ast.Compare) \
+                    and all(isinstance(op, (ast.Is, ast.IsNot))
+                            for op in e.ops)
+
+            for n in ast.walk(fn):
+                if isinstance(n, ast.Call):
+                    f = n.func
+                    if isinstance(f, ast.Name) and f.id in _HOST_CASTS \
+                            and n.args \
+                            and expr_tainted(n.args[0], taint_at(n.lineno)):
+                        flag(n.lineno, f"host cast {f.id}(...)")
+                    elif isinstance(f, ast.Attribute) and f.attr == "item" \
+                            and not n.args \
+                            and expr_tainted(f.value, taint_at(n.lineno)):
+                        flag(n.lineno, ".item() pull")
+                elif isinstance(n, (ast.If, ast.While)) \
+                        and not is_none_test(n.test) \
+                        and expr_tainted(n.test, taint_at(n.lineno)):
+                    flag(n.lineno,
+                         f"python {type(n).__name__.lower()}-branch")
+                elif isinstance(n, ast.IfExp) \
+                        and not is_none_test(n.test) \
+                        and expr_tainted(n.test, taint_at(n.lineno)):
+                    flag(n.lineno, "python conditional expression")
+    return findings
+
+
 def check_repo(engine_dir=None, sources=None) -> list:
     return (scan_dead_entry_points(engine_dir, sources)
             + scan_id_keyed_caches()
             + scan_dispatch_telemetry()
-            + scan_unrestorable_handlers())
+            + scan_unrestorable_handlers()
+            + scan_ensemble_unsafe())
 
 
 def check_model_hygiene(model: Model, shape=None) -> list:

@@ -33,10 +33,14 @@ class CheckpointManager:
     """Keep-last-N checkpoints of one run under ``root``."""
 
     def __init__(self, root: str, keep_last: int = 3,
-                 async_saves: bool = True):
+                 async_saves: bool = True,
+                 compress: Optional[str] = None):
         self.root = root
         self.keep_last = int(keep_last)
         self.async_saves = bool(async_saves)
+        # resolved once: a zstd request without the package degrades to
+        # uncompressed here (with a warning), not on every save
+        self.codec = writer.resolve_codec(compress)
         self._writer = writer.AsyncWriter()
 
     # -- naming / discovery -------------------------------------------------- #
@@ -109,17 +113,20 @@ class CheckpointManager:
                 shutil.rmtree(tmp)
             self._barrier(f"checkpoint_clean_{step}")
             os.makedirs(tmp, exist_ok=True)
-            rst.write_shard_fragment(tmp, captured, jax.process_index())
+            rst.write_shard_fragment(tmp, captured, jax.process_index(),
+                                     codec=self.codec)
             self._barrier(f"checkpoint_write_{step}")
             if not main:
                 return
             nbytes = rst.write_checkpoint_files(tmp, captured,
-                                                merge_fragments=True)
+                                                merge_fragments=True,
+                                                codec=self.codec)
         else:
             if os.path.isdir(tmp):
                 shutil.rmtree(tmp)
             os.makedirs(tmp)
-            nbytes = rst.write_checkpoint_files(tmp, captured)
+            nbytes = rst.write_checkpoint_files(tmp, captured,
+                                                codec=self.codec)
         writer.commit_dir(tmp, final)
         telemetry.event("checkpoint_committed", step=step, path=final,
                         bytes=nbytes,

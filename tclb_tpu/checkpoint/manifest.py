@@ -8,9 +8,10 @@ A committed checkpoint is a directory::
       flags.npy
       settings.npy  zone_table.npy  globals.npy  [time_series.npy ...]
 
-``manifest.json`` records, per array, the file name, CRC32 of the file
-bytes, dtype and shape — plus the saving model's ``Model.fingerprint``,
-the mesh/shard layout and a schema version.  Verification recomputes the
+``manifest.json`` records, per array, the file name, CRC32 of the
+uncompressed ``.npy`` bytes, dtype, shape and (when compressed) the
+shard codec — plus the saving model's ``Model.fingerprint``, the
+mesh/shard layout and a schema version.  Verification recomputes the
 CRCs; restore refuses a fingerprint that does not match the live model
 (a checkpoint is only meaningful against the exact structural model that
 produced it, the same contract ``supports_diff`` keys on).
@@ -94,27 +95,46 @@ def is_checkpoint_dir(path: str) -> bool:
         and os.path.isfile(os.path.join(path, MANIFEST_NAME))
 
 
-def _npy_header(path: str) -> tuple[str, tuple]:
-    """(dtype, shape) from an ``.npy`` header without loading the data."""
-    arr = np.load(path, mmap_mode="r")
+def _npy_header(path: str, codec: str = "none") -> tuple[str, tuple]:
+    """(dtype, shape) from an ``.npy`` header — mmap'd for plain files,
+    via decompression for codec'd shards (no cheaper way to reach the
+    header inside a compressed stream)."""
+    if codec == "none":
+        arr = np.load(path, mmap_mode="r")
+    else:
+        arr = writer.read_npy(path, codec)
     return str(arr.dtype), tuple(int(s) for s in arr.shape)
 
 
 def _check_record(dirpath: str, name: str, rec: dict, deep: bool,
                   problems: list) -> None:
     path = os.path.join(dirpath, rec["file"])
+    codec = rec.get("codec", "none")
     if not os.path.isfile(path):
         problems.append(f"{name}: missing file {rec['file']}")
         return
     if deep:
-        crc = writer.crc32_file(path)
+        # the manifest CRC covers the UNCOMPRESSED npy bytes, so codec'd
+        # shards are decompressed before hashing (writer.write_npy)
+        try:
+            if codec == "none":
+                crc = writer.crc32_file(path)
+            else:
+                import zlib
+                with open(path, "rb") as f:
+                    raw = writer.decompress_bytes(f.read(), codec)
+                crc = zlib.crc32(raw) & 0xFFFFFFFF
+        except Exception as e:  # noqa: BLE001 — torn/garbled stream
+            problems.append(f"{name}: undecodable {codec} shard "
+                            f"{rec['file']}: {e!r}")
+            return
         if crc != int(rec["crc32"]):
             problems.append(
                 f"{name}: CRC mismatch in {rec['file']} "
                 f"(manifest {int(rec['crc32']):#010x}, file {crc:#010x})")
             return
     try:
-        dtype, shape = _npy_header(path)
+        dtype, shape = _npy_header(path, codec)
     except Exception as e:  # noqa: BLE001 — truncated/garbled header
         problems.append(f"{name}: unreadable npy {rec['file']}: {e!r}")
         return

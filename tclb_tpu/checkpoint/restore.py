@@ -100,12 +100,13 @@ def _shard_tag(coords: dict) -> str:
     return "".join(f"{a}{coords[a]}" for a in sorted(coords)) or "p0"
 
 
-def _write_shards(dirpath: str, name: str, val: ShardedCapture
-                  ) -> tuple[list, int]:
+def _write_shards(dirpath: str, name: str, val: ShardedCapture,
+                  codec: str = "none") -> tuple[list, int]:
     shards, total = [], 0
     for sh in val.shards:
         fname = f"{name}@{_shard_tag(sh['coords'])}.npy"
-        rec = writer.write_npy(os.path.join(dirpath, fname), sh["data"])
+        rec = writer.write_npy(os.path.join(dirpath, fname), sh["data"],
+                               codec=codec)
         rec["coords"] = sh["coords"]
         rec["index"] = [[int(a), int(b)] for a, b in sh["index"]]
         shards.append(rec)
@@ -113,7 +114,8 @@ def _write_shards(dirpath: str, name: str, val: ShardedCapture
     return shards, total
 
 
-def write_shard_fragment(dirpath: str, captured: dict, proc: int) -> int:
+def write_shard_fragment(dirpath: str, captured: dict, proc: int,
+                         codec: str = "none") -> int:
     """Multi-host: write this process's addressable shards plus a JSON
     fragment of their manifest records (merged by the main process)."""
     import json
@@ -121,7 +123,7 @@ def write_shard_fragment(dirpath: str, captured: dict, proc: int) -> int:
     total = 0
     for name, val in captured["arrays"].items():
         if isinstance(val, ShardedCapture):
-            frag[name], nb = _write_shards(dirpath, name, val)
+            frag[name], nb = _write_shards(dirpath, name, val, codec=codec)
             total += nb
     with open(os.path.join(dirpath, f"fragment.{proc}.json"), "w") as f:
         json.dump(frag, f)
@@ -129,14 +131,18 @@ def write_shard_fragment(dirpath: str, captured: dict, proc: int) -> int:
 
 
 def write_checkpoint_files(dirpath: str, captured: dict,
-                           merge_fragments: bool = False) -> int:
+                           merge_fragments: bool = False,
+                           codec: str = "none") -> int:
     """Serialize a capture into ``dirpath`` (already existing, typically a
     temp step dir) + its manifest; returns total array bytes written.
 
-    With ``merge_fragments`` (multi-host main process), sharded arrays
-    are assumed already written — this process's via
-    :func:`write_shard_fragment`, peers' via theirs — and their records
-    are merged from the fragment files instead of re-written."""
+    ``codec`` compresses the shard files (``"zlib"``/``"zstd"``; resolve
+    it with :func:`writer.resolve_codec` first — this layer assumes the
+    codec is usable).  With ``merge_fragments`` (multi-host main
+    process), sharded arrays are assumed already written — this
+    process's via :func:`write_shard_fragment`, peers' via theirs — and
+    their records are merged from the fragment files instead of
+    re-written."""
     import json
     records: dict[str, dict] = {}
     total = 0
@@ -160,13 +166,14 @@ def write_checkpoint_files(dirpath: str, captured: dict,
                         shards.append(rec)
                 total += sum(int(r["nbytes"]) for r in shards)
             else:
-                shards, nb = _write_shards(dirpath, name, val)
+                shards, nb = _write_shards(dirpath, name, val, codec=codec)
                 total += nb
             records[name] = {"dtype": val.dtype,
                              "shape": [int(s) for s in val.shape],
                              "shards": shards}
         else:
-            rec = writer.write_npy(os.path.join(dirpath, f"{name}.npy"), val)
+            rec = writer.write_npy(os.path.join(dirpath, f"{name}.npy"),
+                                   val, codec=codec)
             records[name] = rec
             total += rec["nbytes"]
     man = mf.build_manifest(
@@ -182,11 +189,14 @@ def write_checkpoint_files(dirpath: str, captured: dict,
     return total
 
 
-def save_checkpoint(dirpath: str, lattice, extra: Optional[dict] = None
-                    ) -> str:
+def save_checkpoint(dirpath: str, lattice, extra: Optional[dict] = None,
+                    compress: Optional[str] = None) -> str:
     """One-shot synchronous checkpoint of ``lattice`` into directory
-    ``dirpath`` (atomic: written to a temp dir, then committed)."""
+    ``dirpath`` (atomic: written to a temp dir, then committed).
+    ``compress`` optionally codecs the shard files ("zlib"/"zstd";
+    zstd degrades to uncompressed with a warning when unavailable)."""
     import shutil
+    codec = writer.resolve_codec(compress)
     with telemetry.span("checkpoint.save", mode="sync",
                         path=dirpath) as sp:
         captured = capture_lattice(lattice, extra)
@@ -194,7 +204,7 @@ def save_checkpoint(dirpath: str, lattice, extra: Optional[dict] = None
         if os.path.isdir(tmp):
             shutil.rmtree(tmp)
         os.makedirs(tmp)
-        nbytes = write_checkpoint_files(tmp, captured)
+        nbytes = write_checkpoint_files(tmp, captured, codec=codec)
         writer.commit_dir(tmp, dirpath)
         sp.add(bytes=nbytes, step=captured["iteration"])
         telemetry.counter("checkpoint.bytes_written", nbytes)
@@ -205,11 +215,13 @@ def save_checkpoint(dirpath: str, lattice, extra: Optional[dict] = None
 def _load_array(dirpath: str, rec: dict) -> np.ndarray:
     shards = rec.get("shards")
     if shards is None:
-        return np.load(os.path.join(dirpath, rec["file"]))
+        return writer.read_npy(os.path.join(dirpath, rec["file"]),
+                               rec.get("codec", "none"))
     out = np.empty(tuple(rec["shape"]), dtype=np.dtype(rec["dtype"]))
     for srec in shards:
         block = tuple(slice(int(a), int(b)) for a, b in srec["index"])
-        out[block] = np.load(os.path.join(dirpath, srec["file"]))
+        out[block] = writer.read_npy(os.path.join(dirpath, srec["file"]),
+                                     srec.get("codec", "none"))
     return out
 
 

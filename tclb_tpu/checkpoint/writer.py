@@ -98,19 +98,106 @@ def atomic_write_bytes(path: str, data: bytes) -> None:
             f.write(data)
 
 
-def write_npy(path: str, arr: np.ndarray) -> dict:
-    """Write one ``.npy`` file (no suffix games: the open file object is
-    handed to ``np.save``) and return its manifest record."""
+# -- shard codecs ------------------------------------------------------------- #
+# Optional compression of shard files.  The manifest records the codec
+# per array record and the CRC is ALWAYS over the uncompressed .npy
+# bytes — so verification proves the payload decodes to exactly what was
+# saved, not merely that the compressed envelope is intact, and a
+# checkpoint re-written with a different codec keeps the same CRC.
+
+CODEC_SUFFIX = {"zstd": ".zst", "zlib": ".zlib"}
+
+
+def resolve_codec(codec: Optional[str]) -> str:
+    """Normalize + availability-check a codec request.  Unknown names
+    raise; a ``zstd`` request without the ``zstandard`` package degrades
+    to uncompressed with a warning (a save must never fail because an
+    optional dependency is absent)."""
+    codec = (codec or "none").lower()
+    if codec not in ("none", "zlib", "zstd"):
+        raise ValueError(f"unknown checkpoint codec {codec!r} "
+                         "(known: none, zlib, zstd)")
+    if codec == "zstd":
+        try:
+            import zstandard  # noqa: F401
+        except ImportError:
+            from tclb_tpu.utils import log
+            log.warning("checkpoint: compress='zstd' requested but the "
+                        "zstandard package is not installed — saving "
+                        "uncompressed")
+            return "none"
+    return codec
+
+
+def compress_bytes(data: bytes, codec: str) -> bytes:
+    if codec == "none":
+        return data
+    if codec == "zlib":
+        return zlib.compress(data, level=1)
+    if codec == "zstd":
+        import zstandard
+        return zstandard.ZstdCompressor(level=3).compress(data)
+    raise ValueError(f"unknown checkpoint codec {codec!r}")
+
+
+def decompress_bytes(data: bytes, codec: str) -> bytes:
+    if codec == "none":
+        return data
+    if codec == "zlib":
+        return zlib.decompress(data)
+    if codec == "zstd":
+        try:
+            import zstandard
+        except ImportError as e:
+            raise RuntimeError(
+                "this checkpoint's shards are zstd-compressed but the "
+                "zstandard package is not installed") from e
+        return zstandard.ZstdDecompressor().decompress(data)
+    raise ValueError(f"unknown checkpoint codec {codec!r}")
+
+
+def npy_bytes(arr: np.ndarray) -> bytes:
+    """The exact ``.npy`` serialization of ``arr`` (what the CRC covers)."""
+    import io
+    buf = io.BytesIO()
+    np.save(buf, np.ascontiguousarray(arr))
+    return buf.getvalue()
+
+
+def write_npy(path: str, arr: np.ndarray, codec: str = "none") -> dict:
+    """Write one shard file and return its manifest record.
+
+    ``codec="none"`` writes a plain ``.npy``; compressed codecs append
+    their suffix (``fields.npy.zst``) and store the compressed stream.
+    The record's ``crc32`` covers the uncompressed npy bytes either way
+    (see CODEC_SUFFIX block comment)."""
     arr = np.ascontiguousarray(arr)
+    raw = npy_bytes(arr)
+    crc = zlib.crc32(raw) & 0xFFFFFFFF
+    if codec != "none":
+        path = path + CODEC_SUFFIX[codec]
     with open(path, "wb") as f:
-        np.save(f, arr)
+        f.write(compress_bytes(raw, codec))
         f.flush()
         os.fsync(f.fileno())
-    return {"file": os.path.basename(path),
-            "crc32": crc32_file(path),
-            "dtype": str(arr.dtype),
-            "shape": [int(s) for s in arr.shape],
-            "nbytes": int(arr.nbytes)}
+    rec = {"file": os.path.basename(path),
+           "crc32": crc,
+           "dtype": str(arr.dtype),
+           "shape": [int(s) for s in arr.shape],
+           "nbytes": int(arr.nbytes)}
+    if codec != "none":
+        rec["codec"] = codec
+    return rec
+
+
+def read_npy(path: str, codec: str = "none") -> np.ndarray:
+    """Load one shard file written by :func:`write_npy`."""
+    if codec == "none":
+        return np.load(path)
+    import io
+    with open(path, "rb") as f:
+        raw = decompress_bytes(f.read(), codec)
+    return np.load(io.BytesIO(raw))
 
 
 def crc32_file(path: str, chunk: int = 1 << 22) -> int:

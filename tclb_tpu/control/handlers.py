@@ -731,11 +731,13 @@ class acLoadBinary(Handler):
 
 
 class cbSaveCheckpoint(Handler):
-    """<SaveCheckpoint Iterations="N" [dir=...] [keep="3"] [mode="async"]>:
-    periodic full-run checkpoints through
+    """<SaveCheckpoint Iterations="N" [dir=...] [keep="3"] [mode="async"]
+    [compress="zstd"]>: periodic full-run checkpoints through
     :class:`tclb_tpu.checkpoint.CheckpointManager` — atomic, CRC-verified,
     keep-last-N, serialized off-thread (``mode="sync"`` forces blocking
-    saves).  Captures lattice state *plus* solver/handler run-state
+    saves).  ``compress`` codecs the shard files ("zlib"/"zstd"; a zstd
+    request without the zstandard package degrades to uncompressed with
+    a warning).  Captures lattice state *plus* solver/handler run-state
     (averaging origin, optimizer iteration, every stacked handler's
     ``restorable_state``).
 
@@ -760,7 +762,8 @@ class cbSaveCheckpoint(Handler):
         mode = (self.node.get("mode", "async") or "async").lower()
         self.manager = CheckpointManager(
             root, keep_last=int(self.node.get("keep", "3")),
-            async_saves=mode != "sync")
+            async_saves=mode != "sync",
+            compress=self.node.get("compress"))
         if s.resume_from is not None:
             self._resume()
         return 0

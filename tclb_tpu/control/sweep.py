@@ -1,0 +1,150 @@
+"""Cartesian parameter-sweep expansion from an example config XML.
+
+``python -m tclb_tpu sweep case.xml --param "nu=0.01:0.05:8"`` takes an
+ordinary run config as the *base case* — its Units, Geometry painting
+and <Model><Params> become the shared setup — and expands the --param
+grids into ensemble cases for the serve subsystem.  Only the setup
+subtree is executed; the action handlers (<Solve>, outputs,
+checkpoints) are NOT run — <Solve Iterations> is read as the default
+iteration count.
+
+Param specs (values go through the units engine, like <Params>):
+
+* ``nu=0.01:0.05:8``      — 8 values linspace'd over [0.01, 0.05]
+* ``nu=0.01,0.02,0.05``   — an explicit list
+* ``Velocity-zone=...``   — zonal: applies to the named settings-zone
+"""
+
+from __future__ import annotations
+
+import itertools
+import os
+import xml.etree.ElementTree as ET
+from dataclasses import dataclass, field
+from typing import Any, Optional
+
+import numpy as np
+
+from tclb_tpu.control.solver import Solver, _read_units
+from tclb_tpu.core.registry import Model
+from tclb_tpu.serve.ensemble import Case
+
+
+@dataclass
+class SweepSetup:
+    """The shared base every ensemble member starts from."""
+
+    solver: Solver
+    model: Model
+    shape: tuple[int, ...]
+    flags: np.ndarray
+    niter: int                      # <Solve Iterations> default
+    conf_name: str = "sweep"
+    zone_names: dict[str, int] = field(default_factory=dict)
+
+
+def parse_param(spec: str) -> tuple[str, list[str]]:
+    """``name=lo:hi:n`` or ``name=v1,v2,...`` -> (name, raw values).
+    Values stay strings so the units engine can read them (``0.01:1m/s:4``
+    is rejected — ranges must be plain numbers; lists may carry units)."""
+    name, sep, rhs = spec.partition("=")
+    name, rhs = name.strip(), rhs.strip()
+    if not sep or not name or not rhs:
+        raise ValueError(f"--param needs name=values, got {spec!r}")
+    if ":" in rhs:
+        parts = rhs.split(":")
+        if len(parts) != 3:
+            raise ValueError(f"range spec must be lo:hi:n, got {rhs!r}")
+        lo, hi, n = float(parts[0]), float(parts[1]), int(parts[2])
+        if n < 1:
+            raise ValueError(f"range count must be >= 1, got {n}")
+        return name, [repr(float(v)) for v in np.linspace(lo, hi, n)]
+    return name, [v.strip() for v in rhs.split(",") if v.strip()]
+
+
+def load_setup(path: str, model: Optional[Model] = None,
+               dtype: Any = None) -> SweepSetup:
+    """Execute just the setup subtree of a config: units, geometry
+    painting, base <Model><Params>.  The returned lattice is painted but
+    NOT initialized — the ensemble engine runs Init per case (init
+    depends on the swept settings)."""
+    from tclb_tpu.control.handlers import acGeometry, acParams
+    root = ET.parse(path).getroot()
+    if root.tag != "CLBConfig":
+        raise ValueError(f"config root must be <CLBConfig>, got "
+                         f"<{root.tag}>")
+    if model is None:
+        name = root.get("model")
+        if not name:
+            raise ValueError("config has no model= attribute; pass --model")
+        from tclb_tpu.models import get_model
+        model = get_model(name)
+    solver = Solver(model, output=root.get("output", "output/"),
+                    dtype=dtype)
+    solver.conf_name = os.path.splitext(os.path.basename(path))[0]
+    _read_units(root, solver)
+    geom = root.find("Geometry")
+    if geom is None:
+        raise ValueError("config must contain a <Geometry> element")
+    if model.ndim == 2:
+        shape = (int(round(solver.units.alt(geom.get("ny", "1")))),
+                 int(round(solver.units.alt(geom.get("nx", "1")))))
+    else:
+        shape = (int(round(solver.units.alt(geom.get("nz", "1")))),
+                 int(round(solver.units.alt(geom.get("ny", "1")))),
+                 int(round(solver.units.alt(geom.get("nx", "1")))))
+    solver.set_size(shape)
+    acGeometry(geom, solver).init()
+    model_node = root.find("Model")
+    if model_node is not None:
+        for child in model_node:
+            if child.tag == "Params":
+                acParams(child, solver).init()
+    solve = root.find("Solve")
+    niter = (int(round(solver.units.alt(solve.get("Iterations", "0"))))
+             if solve is not None else 0)
+    return SweepSetup(solver=solver, model=model, shape=solver.shape,
+                      flags=solver.lattice._flags_host(), niter=niter,
+                      conf_name=solver.conf_name,
+                      zone_names=dict(solver.geometry.setting_zones))
+
+
+def expand_cases(setup: SweepSetup, param_specs: list[str]) -> list[Case]:
+    """Cartesian product of the --param grids -> ensemble cases.
+
+    Values go through the solver's units engine (the same ``alt`` path
+    <Params> uses); ``name-zone`` specs resolve the zone against the
+    geometry's settings-zones and land in the case's zonal table."""
+    m = setup.model
+    axes: list[tuple[str, Optional[int], list[float]]] = []
+    for spec in param_specs:
+        name, raws = parse_param(spec)
+        zone: Optional[int] = None
+        par = name
+        if "-" in name:
+            par, zname = name.split("-", 1)
+            if zname not in setup.zone_names:
+                raise ValueError(f"unknown settings-zone {zname!r} "
+                                 f"(have {sorted(setup.zone_names)})")
+            zone = setup.zone_names[zname]
+        if par not in m.setting_index:
+            raise ValueError(f"model {m.name} has no setting {par!r}")
+        values = [float(setup.solver.units.alt(r)) for r in raws]
+        axes.append((par, zone, values))
+    if not axes:
+        return [Case(name="case0")]
+    cases = []
+    for combo in itertools.product(*(vals for _, _, vals in axes)):
+        settings: dict[str, float] = {}
+        zonal: dict[tuple[str, int], float] = {}
+        tags = []
+        for (par, zone, _), v in zip(axes, combo):
+            if zone is None:
+                settings[par] = v
+                tags.append(f"{par}={v:g}")
+            else:
+                zonal[(par, zone)] = v
+                tags.append(f"{par}@{zone}={v:g}")
+        cases.append(Case(settings=settings, zonal=zonal,
+                          name=",".join(tags)))
+    return cases

@@ -509,6 +509,90 @@ def make_iterate(model: Model, action: str = "Iteration",
     return iterate
 
 
+def make_ensemble_step(model: Model, action: str = "Init",
+                       present: Optional[set] = None) -> Callable:
+    """Batched single-action step for an ensemble of independent cases:
+    ``step(states, params) -> states`` over a leading case axis.
+
+    Runs the cases through ``lax.map`` (a scan over the batch), NOT
+    ``vmap``: a scan body is compiled as its own isolated computation, so
+    the per-case arithmetic clusters exactly like the sequential
+    ``jit(step)`` program and the result is bit-identical to running the
+    cases one by one — the ensemble contract (serve/ensemble.py).  One
+    action per run (Init, a globals-reducing final step) is cheap; the
+    niter-step bulk goes through :func:`make_ensemble_iterate` instead."""
+    step = make_action_step(model, action, present=present)
+
+    def batched(states: LatticeState, params: SimParams) -> LatticeState:
+        return jax.lax.map(lambda sp: step(sp[0], sp[1]), (states, params))
+
+    return batched
+
+
+def make_ensemble_iterate(model: Model, action: str = "Iteration",
+                          unroll: int = 1,
+                          present: Optional[set] = None,
+                          mode: str = "map") -> Callable:
+    """Batched counterpart of :func:`make_iterate`: advance N independent
+    cases (stacked ``LatticeState``s + per-case ``SimParams``) in ONE
+    device dispatch.
+
+    ``mode="map"`` (default) runs each case's whole niter-step loop as a
+    ``lax.map`` body: a map body is compiled as its own isolated
+    computation, so the per-case arithmetic clusters exactly like the
+    sequential ``jit(make_iterate(...))`` program and the output is
+    **bit-identical** to N sequential runs — the ensemble contract
+    (serve/ensemble.py).  The throughput win is dispatch/compile
+    amortization and cross-case pipelining, not SIMD over the batch.
+
+    ``mode="vmap"`` vmaps the NoGlobals bulk over the case axis inside
+    the time scan (XLA vectorizes the whole batch per step) and runs the
+    final full-globals step through ``lax.map``.  Faster where the
+    per-case work underfills the vector units, but NOT parity-safe in
+    general: under a batch dimension XLA:CPU re-clusters some models'
+    multiply-add chains (the same re-association ``lbm.pin`` fences
+    elsewhere) and drifts fields by 1 ulp — e.g. d2q9_kuper's forcing
+    stage on a painted cavity.  Opt in only where throughput beats
+    bit-reproducibility."""
+    if mode not in ("map", "vmap"):
+        raise ValueError(f"ensemble mode must be 'map' or 'vmap', "
+                         f"got {mode!r}")
+    step_ng = make_action_step(model, action, present=present,
+                               compute_globals=False)
+    step_full = make_action_step(model, action, present=present,
+                                 compute_globals=True)
+
+    def iterate_map(states: LatticeState, params: SimParams, niter: int
+                    ) -> LatticeState:
+        if niter <= 0:
+            return states
+
+        def one(sp):
+            s, p = sp
+
+            def body(st, _):
+                return step_ng(st, p), None
+            s, _ = jax.lax.scan(body, s, None, length=niter - 1,
+                                unroll=unroll)
+            return step_full(s, p)
+
+        return jax.lax.map(one, (states, params))
+
+    def iterate_vmap(states: LatticeState, params: SimParams, niter: int
+                     ) -> LatticeState:
+        if niter <= 0:
+            return states
+
+        def body(s, _):
+            return jax.vmap(step_ng)(s, params), None
+        states, _ = jax.lax.scan(body, states, None, length=niter - 1,
+                                 unroll=unroll)
+        return jax.lax.map(lambda sp: step_full(sp[0], sp[1]),
+                           (states, params))
+
+    return iterate_map if mode == "map" else iterate_vmap
+
+
 def make_sampled_iterate(model: Model, points: np.ndarray,
                          quantities: Sequence[str],
                          action: str = "Iteration",

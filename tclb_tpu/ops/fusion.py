@@ -82,6 +82,39 @@ def choose_fuse_slab(nz: int, fits: Callable[[int, int], bool],
     return best
 
 
+ENSEMBLE_BATCH_MAX = 256   # scheduling sanity cap, not a memory bound
+
+
+def ensemble_batch_cap(n_storage: int, shape: Tuple[int, ...],
+                       itemsize: int,
+                       budget_bytes: Optional[int] = None,
+                       bmax: int = ENSEMBLE_BATCH_MAX) -> int:
+    """Largest ensemble batch whose working set fits the serving budget.
+
+    The same shape of reasoning as the slab engines' VMEM predicates
+    (pallas_d3q ``_fused_fits``), applied at the device-memory level the
+    batched XLA engine lives at: per case the scan keeps the stacked
+    fields twice (carry in + carry out — donation collapses the steady
+    state to ~2x) plus one streamed temporary, and flags ride along.
+
+    ``budget_bytes`` defaults to ``TCLB_SERVE_BUDGET_MB`` (MB) or 2 GiB —
+    deliberately a fraction of any real device so a full sweep never
+    OOMs the executor that also holds the compiled-executable cache.
+    Always returns at least 1 (a single case must run regardless; if even
+    that thrashes, the budget was a lie the allocator will report).
+    """
+    if budget_bytes is None:
+        import os
+        mb = os.environ.get("TCLB_SERVE_BUDGET_MB")
+        budget_bytes = (int(mb) * 1024 * 1024 if mb
+                        else 2 * 1024 * 1024 * 1024)
+    nodes = 1
+    for s in shape:
+        nodes *= int(s)
+    per_case = nodes * (3 * n_storage * itemsize + 2)
+    return max(1, min(int(bmax), budget_bytes // max(per_case, 1)))
+
+
 def zone_plane(ztab, col: int, zone_max: int, zones,
                zones_present: Optional[Iterable[int]] = None):
     """Reconstruct one zonal-setting plane inside a kernel.

@@ -41,6 +41,29 @@ def _pin_bwd(_, g):
 pin.defvjp(_pin_fwd, _pin_bwd)
 
 
+def _register_pin_batching() -> None:
+    # optimization_barrier ships without a vmap rule in the pinned jax
+    # version, which would make every pinned model un-batchable by the
+    # ensemble engine (serve/ensemble.py).  A barrier is rank-polymorphic:
+    # batching it is binding it on the batched operands with the batch
+    # dims passed through unchanged.
+    try:
+        from jax._src.lax.lax import optimization_barrier_p
+        from jax.interpreters import batching
+    except ImportError:      # pragma: no cover - future jax relocations
+        return
+    if optimization_barrier_p in batching.primitive_batchers:
+        return               # pragma: no cover - newer jax grew a rule
+
+    def _barrier_batcher(args, dims):
+        return optimization_barrier_p.bind(*args), dims
+
+    batching.primitive_batchers[optimization_barrier_p] = _barrier_batcher
+
+
+_register_pin_batching()
+
+
 def present_types(model, flags: np.ndarray) -> set:
     """Node-type names actually present in a host flag field — used by the
     Pallas kernels to skip absent boundary cases (the reference gets the

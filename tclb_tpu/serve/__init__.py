@@ -1,0 +1,38 @@
+"""High-throughput case serving: batched ensembles, compiled-executable
+caching, and a fault-tolerant job scheduler.
+
+The serving stack turns the one-case ``Lattice`` runtime into a
+many-case engine:
+
+* :mod:`tclb_tpu.serve.ensemble` — run N independent cases of one
+  ``(model, shape, engine)`` class in a single device dispatch, with
+  per-case output bit-identical to N sequential runs;
+* :mod:`tclb_tpu.serve.cache` — LRU cache of AOT-compiled ensemble
+  executables keyed on ``Model.fingerprint`` (+ JAX's persistent
+  compilation cache via ``TCLB_COMPILE_CACHE``);
+* :mod:`tclb_tpu.serve.scheduler` — in-process queue that bins
+  compatible jobs into batches, retries failed batched runs and
+  degrades to the sequential path rather than failing a whole batch.
+
+CLI: ``python -m tclb_tpu sweep case.xml --param "nu=0.01:0.05:8"``.
+"""
+
+from tclb_tpu.serve.cache import (CompiledCache, default_cache,
+                                  wire_persistent_cache)
+from tclb_tpu.serve.ensemble import (Case, EnsemblePlan, EnsembleResult,
+                                     run_ensemble)
+from tclb_tpu.serve.scheduler import Job, JobSpec, JobTimeout, Scheduler
+
+__all__ = [
+    "Case",
+    "CompiledCache",
+    "EnsemblePlan",
+    "EnsembleResult",
+    "Job",
+    "JobSpec",
+    "JobTimeout",
+    "Scheduler",
+    "default_cache",
+    "run_ensemble",
+    "wire_persistent_cache",
+]

@@ -1,0 +1,125 @@
+"""Sweep runner: expand an XML base case over --param grids and serve
+the ensemble through the scheduler.
+
+``python -m tclb_tpu sweep case.xml --param "nu=0.01:0.05:8"`` (also
+reachable as ``python -m tclb_tpu.serve``).  The config's Units,
+Geometry painting and <Model><Params> become the shared base; the
+cartesian product of the --param axes becomes the case list; cases run
+batched (bit-identical to sequential runs) through the compiled-
+executable cache, and the result is one JSON document on stdout with
+per-case globals and the cache/scheduler statistics CI asserts on.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+from typing import Optional
+
+
+def run_sweep(args) -> int:
+    import jax.numpy as jnp
+
+    from tclb_tpu import telemetry
+    from tclb_tpu.control.sweep import expand_cases, load_setup
+    from tclb_tpu.serve.cache import default_cache
+    from tclb_tpu.serve.ensemble import EnsemblePlan
+    from tclb_tpu.serve.scheduler import JobSpec, Scheduler
+
+    model = None
+    if args.model:
+        from tclb_tpu.models import get_model
+        model = get_model(args.model)
+    dtype = {"f32": jnp.float32, "f64": jnp.float64}[args.precision]
+    if dtype is jnp.float64:
+        import jax
+        jax.config.update("jax_enable_x64", True)
+
+    setup = load_setup(args.case, model=model, dtype=dtype)
+    cases = expand_cases(setup, args.param or [])
+    niter = args.iters if args.iters is not None else setup.niter
+    if niter <= 0:
+        print("error: no <Solve Iterations> in the config and no --iters",
+              file=sys.stderr)
+        return 2
+
+    # one plan for the whole sweep: the painted (un-inited) base lattice
+    # carries the XML's zonal base params, which a settings dict cannot
+    plan = EnsemblePlan(setup.model, setup.shape, base=setup.solver.lattice)
+    cache = default_cache()
+    sched = Scheduler(max_batch=args.batch, retries=args.retries,
+                      cache=cache, autostart=False)
+    specs = [JobSpec(model=setup.model, shape=setup.shape, case=c,
+                     niter=niter, dtype=plan.dtype, plan=plan,
+                     timeout_s=args.timeout, name=c.name or f"case{i}")
+             for i, c in enumerate(cases)]
+    jobs = sched.run(specs)
+    sched.close()
+
+    out = {
+        "config": args.case,
+        "model": setup.model.name,
+        "shape": list(setup.shape),
+        "iterations": int(niter),
+        "cases": [],
+        "cache": cache.stats(),
+        "counters": {k: v for k, v in telemetry.counters().items()
+                     if k.startswith("serve.")},
+    }
+    failed = 0
+    for job in jobs:
+        rec: dict = {"name": job.spec.name, "status": job.status,
+                     "attempts": job.attempts, "degraded": job.degraded}
+        if job.status == "done":
+            r = job._result
+            rec["settings"] = dict(r.case.settings)
+            if r.case.zonal:
+                rec["zonal"] = {f"{n}@{z}": v
+                                for (n, z), v in r.case.zonal.items()}
+            rec["globals"] = r.globals
+        else:
+            failed += 1
+            rec["error"] = repr(job.error)
+        out["cases"].append(rec)
+    print(json.dumps(out, indent=2))
+    if failed:
+        print(f"sweep: {failed}/{len(jobs)} case(s) failed",
+              file=sys.stderr)
+        return 1
+    return 0
+
+
+def add_sweep_arguments(sp: argparse.ArgumentParser) -> None:
+    sp.add_argument("case", help="base case.xml config")
+    sp.add_argument("--param", action="append", default=[],
+                    metavar="NAME=SPEC",
+                    help="sweep axis: 'nu=0.01:0.05:8' (linspace) or "
+                    "'nu=0.01,0.02' (list); 'Name-zone=...' for zonal "
+                    "settings; repeatable (axes combine cartesian)")
+    sp.add_argument("--model", "-m", default=None,
+                    help="model name (or model= attr in the config)")
+    sp.add_argument("--iters", type=int, default=None,
+                    help="iterations per case (default: <Solve "
+                    "Iterations> from the config)")
+    sp.add_argument("--batch", type=int, default=None,
+                    help="max cases per batched dispatch (default: the "
+                    "memory-predicated cap)")
+    sp.add_argument("--retries", type=int, default=1,
+                    help="batched-run retries before degrading to the "
+                    "sequential path (default 1)")
+    sp.add_argument("--timeout", type=float, default=None,
+                    help="per-job timeout in seconds")
+    sp.add_argument("--precision", choices=("f32", "f64"), default="f32")
+
+
+def main(argv: Optional[list] = None) -> int:
+    p = argparse.ArgumentParser(
+        prog="tclb-sweep",
+        description="batched parameter sweep over an XML base case")
+    add_sweep_arguments(p)
+    return run_sweep(p.parse_args(argv))
+
+
+if __name__ == "__main__":
+    sys.exit(main())

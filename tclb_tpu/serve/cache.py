@@ -1,0 +1,129 @@
+"""AOT compiled-executable cache for the ensemble engine.
+
+Every distinct (model, shape, engine tag, batch size, dtype) class costs
+one trace + XLA compile; a sweep that re-uses the class must not pay it
+again.  The cache AOT-compiles via ``jax.jit(...).lower().compile()``
+and keys on ``Model.fingerprint`` — never ``id()`` (the
+``hygiene.id_keyed_cache`` scan errors on any id()-keyed cache: ids
+recycle and would alias unrelated models) — plus the trace-shaping
+extras the spec'd key implies: the present-node-type set (the trace
+specializes on painted types), the static ``niter`` and whether Init is
+fused in.
+
+Process-persistent compiles: ``TCLB_COMPILE_CACHE=<dir>`` wires JAX's
+persistent compilation cache so a *new* process warm-starts from disk
+(the serving analogue of a model-server's compiled-artifact store).
+"""
+
+from __future__ import annotations
+
+import os
+from collections import OrderedDict
+from typing import Any, Callable, Optional
+
+import jax
+
+from tclb_tpu import telemetry
+from tclb_tpu.utils import log
+
+_persistent_wired = False
+
+
+def wire_persistent_cache() -> Optional[str]:
+    """Point JAX's persistent compilation cache at ``TCLB_COMPILE_CACHE``
+    (idempotent; no-op when the env is unset).  Returns the directory
+    when wired."""
+    global _persistent_wired
+    cache_dir = os.environ.get("TCLB_COMPILE_CACHE")
+    if not cache_dir:
+        return None
+    if not _persistent_wired:
+        try:
+            jax.config.update("jax_compilation_cache_dir", cache_dir)
+            # serving compiles are worth persisting regardless of their
+            # compile time; the default threshold would skip tiny cases
+            jax.config.update("jax_persistent_cache_min_compile_time_secs",
+                              0)
+        except Exception as e:  # noqa: BLE001 - knob names drift across jax
+            log.warning(f"TCLB_COMPILE_CACHE: could not wire the "
+                        f"persistent compilation cache ({e!r})")
+            return None
+        _persistent_wired = True
+        log.info(f"serve: persistent compilation cache at {cache_dir}")
+    return cache_dir
+
+
+class CompiledCache:
+    """LRU cache of AOT-compiled ensemble executables.
+
+    ``capacity`` bounds live executables (each pins device memory for
+    its program); default from ``TCLB_SERVE_CACHE_CAP`` or 16.  Hits and
+    misses are counted on the instance and mirrored to telemetry
+    (``serve.cache.hit``/``serve.cache.miss`` counters + a
+    ``serve.compile`` span per lookup carrying ``cache="hit"|"miss"`` —
+    the report CLI derives the serving hit rate from those spans)."""
+
+    def __init__(self, capacity: Optional[int] = None):
+        if capacity is None:
+            capacity = int(os.environ.get("TCLB_SERVE_CACHE_CAP", "16"))
+        self.capacity = max(1, int(capacity))
+        self._entries: OrderedDict[tuple, Callable] = OrderedDict()
+        self.hits = 0
+        self.misses = 0
+        self.evictions = 0
+        wire_persistent_cache()
+
+    def key_for(self, plan, batch: int, niter: int, init: bool) -> tuple:
+        return (plan.model.fingerprint,
+                plan.shape,
+                plan.engine_tag(batch),
+                int(batch),
+                str(jax.numpy.dtype(plan.dtype)),
+                int(niter),
+                bool(init),
+                frozenset(plan.present or ()))
+
+    def get(self, plan, batch: int, niter: int, fn: Callable,
+            init: bool = True) -> Callable:
+        """Compiled ``(states, params) -> states`` executable for this
+        plan/batch/niter class, compiling on miss."""
+        key = self.key_for(plan, batch, niter, init)
+        hit = key in self._entries
+        with telemetry.span("serve.compile",
+                            cache="hit" if hit else "miss",
+                            engine=plan.engine_tag(batch),
+                            model=plan.model.name, batch=int(batch),
+                            niter=int(niter)):
+            if hit:
+                self._entries.move_to_end(key)
+                self.hits += 1
+                telemetry.counter("serve.cache.hit")
+                return self._entries[key]
+            self.misses += 1
+            telemetry.counter("serve.cache.miss")
+            states, params = plan.abstract_inputs(batch)
+            lowered = jax.jit(fn, static_argnames=("niter",)).lower(
+                states, params, niter=niter)
+            compiled = lowered.compile()
+        self._entries[key] = compiled
+        while len(self._entries) > self.capacity:
+            self._entries.popitem(last=False)
+            self.evictions += 1
+            telemetry.counter("serve.cache.evict")
+        return compiled
+
+    def stats(self) -> dict[str, int]:
+        return {"hits": self.hits, "misses": self.misses,
+                "evictions": self.evictions, "size": len(self._entries),
+                "capacity": self.capacity}
+
+
+_default_cache: Optional[CompiledCache] = None
+
+
+def default_cache() -> CompiledCache:
+    """Process-wide cache shared by the sweep CLI and the scheduler."""
+    global _default_cache
+    if _default_cache is None:
+        _default_cache = CompiledCache()
+    return _default_cache

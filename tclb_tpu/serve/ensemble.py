@@ -1,0 +1,211 @@
+"""Batched ensemble engine: N independent cases in one device dispatch.
+
+Parameter sweeps, UQ ensembles and optimization line-searches are all
+"N cases of the same (model, shape, engine) class, different settings"
+workloads (the reference TCLB amortizes these one-case-per-MPI-job
+through its NLopt loop).  Here the whole ensemble is ONE executable:
+stacked ``LatticeState``s and per-case ``SimParams`` go through
+:func:`tclb_tpu.core.lattice.make_ensemble_iterate`, keeping the
+contract that matters:
+
+    **bit-parity** — the batched run's per-case output is bit-identical
+    to running each case alone through ``Lattice.iterate``'s XLA engine.
+
+The default ``mode="map"`` engine guarantees parity by compiling each
+case's whole loop as an isolated ``lax.map`` body (the exact clustering
+of the sequential program); ``mode="vmap"`` vectorizes the batch per
+step for throughput but lets XLA re-cluster some models' FMA chains by
+1 ulp (see make_ensemble_iterate's docstring).  tests/test_serve.py
+enforces parity for a plain and a zonal-settings model.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Any, Callable, Optional, Sequence
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from tclb_tpu.core.lattice import (Lattice, LatticeState, SimParams,
+                                   make_ensemble_iterate,
+                                   make_ensemble_step)
+from tclb_tpu.core.registry import Model
+
+
+@dataclasses.dataclass(frozen=True)
+class Case:
+    """One ensemble member: setting overrides on top of the shared base.
+
+    ``settings`` are plain ``name -> value`` assignments (derived
+    settings update exactly like ``Lattice.set_setting``); ``zonal``
+    maps ``(name, zone_id) -> value`` into the case's zone table."""
+
+    settings: dict[str, float] = dataclasses.field(default_factory=dict)
+    zonal: dict[tuple[str, int], float] = dataclasses.field(
+        default_factory=dict)
+    name: str = ""
+
+
+@dataclasses.dataclass
+class EnsembleResult:
+    case: Case
+    state: LatticeState            # this case's final (unstacked) state
+    globals: dict[str, float]
+
+
+def case_params(model: Model, base: SimParams, case: Case,
+                dtype: Any) -> SimParams:
+    """Per-case SimParams, derived with the same float64 host arithmetic
+    as ``Lattice.set_setting`` (same order: scalar settings with their
+    derived updates first, then zonal table entries) — any drift here
+    would silently break the bit-parity contract."""
+    vec = np.array(base.settings, dtype=np.float64)
+    table = np.array(base.zone_table, dtype=np.float64)
+    for name, value in case.settings.items():
+        model._set_with_derived(vec, name, float(value))
+        table[model.setting_index[name], :] = vec[model.setting_index[name]]
+    for (name, zone), value in case.zonal.items():
+        table[model.setting_index[name], int(zone)] = float(value)
+    return base.replace(
+        settings=jnp.asarray(vec, dtype=dtype),
+        zone_table=jnp.asarray(table, dtype=dtype))
+
+
+def stack_trees(trees: Sequence[Any]) -> Any:
+    """Stack a list of identical pytrees along a new leading case axis."""
+    return jax.tree.map(lambda *xs: jnp.stack(xs), *trees)
+
+
+def unstack_tree(tree: Any, n: int) -> list:
+    """Split a case-stacked pytree back into n per-case pytrees."""
+    return [jax.tree.map(lambda x: x[k], tree) for k in range(n)]
+
+
+class EnsemblePlan:
+    """The compiled shape of one ensemble class: a model + lattice shape
+    + painted flags + dtype, ready to run any batch of setting cases.
+
+    Built once per (fingerprint, shape, flags) class — the scheduler
+    keeps one per batch bin — and stateless across runs: ``run`` is a
+    pure dispatch.  ``engine_tag`` names the batched engine the way the
+    Lattice names its fast paths (telemetry + cache key component)."""
+
+    def __init__(self, model: Model, shape: Sequence[int],
+                 flags: Optional[np.ndarray] = None,
+                 dtype: Any = jnp.float32,
+                 base_settings: Optional[dict[str, float]] = None,
+                 base: Optional[Lattice] = None,
+                 mode: str = "map"):
+        from tclb_tpu.ops.lbm import present_types
+        if base is None:
+            base = Lattice(model, tuple(int(s) for s in shape), dtype=dtype,
+                           settings=base_settings)
+            if flags is not None:
+                base.set_flags(np.asarray(flags, dtype=np.uint16))
+        self.model = base.model
+        self.shape = base.shape
+        self.dtype = base.dtype
+        self.mode = mode
+        self.flags = base._flags_host()
+        self.base_state = base.state
+        self.base_params = base.params
+        self.present = present_types(self.model, self.flags)
+        self._init = make_ensemble_step(self.model, "Init", present=None)
+        self._iterate = make_ensemble_iterate(self.model,
+                                              present=self.present,
+                                              mode=mode)
+
+    def engine_tag(self, batch: int) -> str:
+        return f"ensemble_xla[{self.model.name},{self.mode},b={batch}]"
+
+    # -- pieces the cache compiles ----------------------------------------- #
+
+    def build_fn(self, init: bool = True) -> Callable:
+        """The whole ensemble program as one jittable
+        ``fn(states, params, niter) -> states`` (init + bulk + final)."""
+        def fn(states: LatticeState, params: SimParams, niter: int
+               ) -> LatticeState:
+            if init:
+                states = self._init(states, params)
+            return self._iterate(states, params, niter)
+        return fn
+
+    def abstract_inputs(self, batch: int) -> tuple:
+        """``jax.ShapeDtypeStruct`` pytrees matching a batch-of-``batch``
+        call — what AOT lowering sees instead of real arrays."""
+        def sds(x):
+            return jax.ShapeDtypeStruct((batch,) + tuple(x.shape), x.dtype)
+        states = jax.tree.map(sds, self.base_state)
+        params = jax.tree.map(sds, self.base_params)
+        return states, params
+
+    def stack_cases(self, cases: Sequence[Case]) -> tuple:
+        states = stack_trees([self.base_state] * len(cases))
+        params = stack_trees([case_params(self.model, self.base_params, c,
+                                          self.dtype) for c in cases])
+        return states, params
+
+    def run(self, cases: Sequence[Case], niter: int,
+            cache=None, init: bool = True) -> list[EnsembleResult]:
+        """Run the batch; returns per-case results in input order."""
+        cases = [c if isinstance(c, Case) else Case(settings=dict(c))
+                 for c in cases]
+        states, params = self.stack_cases(cases)
+        fn = self.build_fn(init=init)
+        if cache is not None:
+            compiled = cache.get(self, batch=len(cases), niter=niter,
+                                 fn=fn, init=init)
+            out = compiled(states, params)
+        else:
+            out = jax.jit(fn, static_argnames=("niter",))(
+                states, params, niter)
+        finals = unstack_tree(out, len(cases))
+        m = self.model
+        results = []
+        for case, st in zip(cases, finals):
+            vals = np.asarray(st.globals_)
+            results.append(EnsembleResult(
+                case=case, state=st,
+                globals={g.name: float(vals[i])
+                         for i, g in enumerate(m.globals_)}))
+        return results
+
+    # -- sequential reference path ----------------------------------------- #
+
+    def run_sequential(self, case: Case, niter: int) -> EnsembleResult:
+        """One case through the plain ``Lattice`` path (auto-selected
+        engine) — the scheduler's degradation target when a batched
+        compile fails, and the parity reference in tests."""
+        case = case if isinstance(case, Case) else Case(settings=dict(case))
+        lat = Lattice(self.model, self.shape, dtype=self.dtype)
+        lat.set_flags(self.flags.copy())
+        lat.params = case_params(self.model, self.base_params, case,
+                                 self.dtype)
+        lat.init()
+        if niter > 0:
+            lat.iterate(niter)
+        return EnsembleResult(case=case, state=lat.state,
+                              globals=lat.get_globals())
+
+
+def run_ensemble(model: Model, cases: Sequence[Case | dict], niter: int,
+                 *, shape: Optional[Sequence[int]] = None,
+                 flags: Optional[np.ndarray] = None,
+                 dtype: Any = jnp.float32,
+                 base_settings: Optional[dict[str, float]] = None,
+                 base: Optional[Lattice] = None,
+                 cache=None, init: bool = True) -> list[EnsembleResult]:
+    """Run N independent cases of one model/shape class in one dispatch.
+
+    ``base`` reuses an existing (painted, un-inited) Lattice as the
+    shared starting point; otherwise ``shape``/``flags``/
+    ``base_settings`` build one.  Per-case output is bit-identical to
+    running each case alone on the XLA engine (see module docstring).
+    """
+    if base is None and shape is None:
+        raise ValueError("run_ensemble needs `shape` (or `base`)")
+    plan = EnsemblePlan(model, shape or (), flags=flags, dtype=dtype,
+                        base_settings=base_settings, base=base)
+    return plan.run(cases, niter, cache=cache, init=init)

@@ -1,0 +1,342 @@
+"""In-process job scheduler: admit, bin, batch, retry, degrade.
+
+The serving loop a parameter-sweep or many-tenant deployment needs on
+top of the ensemble engine:
+
+* **admission** — ``submit()`` returns a :class:`Job` handle
+  immediately; a single worker thread drains the queue;
+* **binning** — jobs of the same ``(Model.fingerprint, shape, dtype,
+  flags, niter)`` class batch into one ensemble dispatch, up to the
+  memory-predicated cap (``ops/fusion.py:ensemble_batch_cap``, the same
+  working-set arithmetic the slab engines' VMEM predicates use);
+* **fault tolerance** — a failed batched run is retried a bounded
+  number of times, then *degrades* to the per-case sequential path so a
+  single poisoned compile never takes the whole batch down; per-job
+  timeouts surface as failed jobs, never hung callers;
+* **observability** — every batch runs under a ``serve.batch`` span
+  (batch size, capacity, per-job queue waits) and the compile cache
+  stamps ``serve.compile`` spans; ``telemetry report`` renders both as
+  the Serving table.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import hashlib
+import queue
+import threading
+import time
+from typing import Any, Callable, Optional, Sequence
+
+import jax.numpy as jnp
+import numpy as np
+
+from tclb_tpu import telemetry
+from tclb_tpu.core.registry import Model
+from tclb_tpu.ops import fusion
+from tclb_tpu.serve.cache import CompiledCache
+from tclb_tpu.serve.ensemble import Case, EnsemblePlan, EnsembleResult
+from tclb_tpu.utils import log
+
+PENDING, RUNNING, DONE, FAILED = "pending", "running", "done", "failed"
+
+
+class JobTimeout(TimeoutError):
+    """A job missed its deadline (queued too long, or the caller's wait
+    expired while the worker was stuck)."""
+
+
+@dataclasses.dataclass
+class JobSpec:
+    """One case to serve: the ensemble class it belongs to + its case."""
+
+    model: Model
+    shape: tuple[int, ...]
+    case: Case
+    niter: int
+    flags: Optional[np.ndarray] = None
+    dtype: Any = jnp.float32
+    base_settings: Optional[dict[str, float]] = None
+    # a prebuilt plan (e.g. the sweep CLI's XML-derived base, whose zonal
+    # base params a plain settings dict cannot express); must describe
+    # the same (model, shape, flags, dtype) class as the fields above
+    plan: Optional[EnsemblePlan] = None
+    timeout_s: Optional[float] = None
+    name: str = ""
+
+
+class Job:
+    """Handle returned by ``Scheduler.submit``: poll ``status`` or block
+    on ``result()``."""
+
+    def __init__(self, spec: JobSpec, jid: int):
+        self.spec = spec
+        self.id = jid
+        self.status = PENDING
+        self.error: Optional[BaseException] = None
+        self.attempts = 0
+        self.degraded = False
+        self.submitted = time.monotonic()
+        self.finished_at: Optional[float] = None
+        self._result: Optional[EnsembleResult] = None
+        self._done = threading.Event()
+
+    def _finish(self, result: Optional[EnsembleResult],
+                error: Optional[BaseException]) -> None:
+        self._result = result
+        self.error = error
+        self.status = DONE if error is None else FAILED
+        self.finished_at = time.monotonic()
+        self._done.set()
+
+    def result(self, timeout: Optional[float] = None) -> EnsembleResult:
+        """Block for the outcome.  ``timeout`` defaults to the job's own
+        ``timeout_s``; expiring raises :class:`JobTimeout` and marks the
+        job failed — a stuck worker surfaces as a failed job, never a
+        hung caller (the worker may still finish it in the background,
+        but this handle's verdict stands)."""
+        if timeout is None:
+            timeout = self.spec.timeout_s
+        if not self._done.wait(timeout):
+            err = JobTimeout(
+                f"job {self.id} ({self.spec.name or self.spec.model.name}) "
+                f"timed out after {timeout}s")
+            if not self._done.is_set():
+                self.status = FAILED
+                self.error = err
+            raise err
+        if self.error is not None:
+            raise self.error
+        return self._result
+
+
+def _bin_key(spec: JobSpec) -> tuple:
+    """Jobs sharing this key run in one batched dispatch.  Keys on the
+    model *fingerprint* (never id()) + everything that shapes the
+    compiled program: lattice shape, dtype, painted flags, niter."""
+    flags_digest = ("none" if spec.flags is None else
+                    hashlib.sha1(
+                        np.ascontiguousarray(spec.flags).tobytes()
+                    ).hexdigest()[:16])
+    if spec.plan is not None:
+        # content digest of the plan's base params, NOT id(): two plans
+        # built from the same config bin together
+        h = hashlib.sha1()
+        h.update(np.asarray(spec.plan.base_params.settings).tobytes())
+        h.update(np.asarray(spec.plan.base_params.zone_table).tobytes())
+        base: tuple = ("plan", h.hexdigest()[:16])
+    else:
+        base = tuple(sorted((spec.base_settings or {}).items()))
+    return (spec.model.fingerprint, tuple(spec.shape),
+            str(jnp.dtype(spec.dtype)), flags_digest, int(spec.niter), base)
+
+
+class Scheduler:
+    """Local in-process queue + worker loop over the ensemble engine.
+
+    ``retries`` bounds re-attempts of a failed *batched* run before it
+    degrades to the sequential per-case path; ``max_batch`` caps the bin
+    size on top of the memory predicate.  ``batch_runner`` /
+    ``sequential_runner`` are injectable for fault testing: signatures
+    ``(plan, cases, niter) -> [EnsembleResult]`` and
+    ``(plan, case, niter) -> EnsembleResult``."""
+
+    def __init__(self, max_batch: Optional[int] = None, retries: int = 1,
+                 cache: Optional[CompiledCache] = None,
+                 batch_runner: Optional[Callable] = None,
+                 sequential_runner: Optional[Callable] = None,
+                 on_result: Optional[Callable[[Job], None]] = None,
+                 autostart: bool = True):
+        self.max_batch = max_batch
+        self.autostart = autostart
+        self.retries = max(0, int(retries))
+        self.cache = cache if cache is not None else CompiledCache()
+        self._batch_runner = batch_runner or self._run_batched
+        self._seq_runner = sequential_runner or (
+            lambda plan, case, niter: plan.run_sequential(case, niter))
+        self._on_result = on_result
+        self._queue: queue.Queue[Job] = queue.Queue()
+        self._plans: dict[tuple, EnsemblePlan] = {}
+        self._jobs = 0
+        self._lock = threading.Lock()
+        self._closing = False
+        self._worker: Optional[threading.Thread] = None
+
+    # -- admission ---------------------------------------------------------- #
+
+    def start(self) -> None:
+        """Start the worker thread (idempotent).  With
+        ``autostart=False``, call after queueing a burst so the binning
+        sees the whole burst instead of racing the submitter —
+        deterministic batch sizes, deterministic cache keys."""
+        with self._lock:
+            if self._worker is None:
+                self._worker = threading.Thread(
+                    target=self._loop, name="tclb-serve-worker", daemon=True)
+                self._worker.start()
+
+    def submit(self, spec: JobSpec) -> Job:
+        if self._closing:
+            raise RuntimeError("scheduler is closed")
+        with self._lock:
+            self._jobs += 1
+            job = Job(spec, self._jobs)
+        self._queue.put(job)
+        telemetry.counter("serve.jobs.submitted")
+        if self.autostart:
+            self.start()
+        return job
+
+    def run(self, specs: Sequence[JobSpec]) -> list[Job]:
+        """Submit all, wait for all; returns the job handles (failed
+        jobs keep their error on the handle instead of raising)."""
+        jobs = [self.submit(s) for s in specs]
+        self.start()
+        for j in jobs:
+            try:
+                j.result()
+            except Exception:  # noqa: BLE001 - surfaced on the handle
+                pass
+        return jobs
+
+    def close(self, wait: bool = True) -> None:
+        self._closing = True
+        if wait and self._worker is not None:
+            self._worker.join(timeout=60.0)
+
+    def __enter__(self) -> "Scheduler":
+        return self
+
+    def __exit__(self, *exc) -> None:
+        self.close()
+
+    # -- worker loop -------------------------------------------------------- #
+
+    def _plan_for(self, spec: JobSpec, key: tuple) -> EnsemblePlan:
+        plan = self._plans.get(key)
+        if plan is None:
+            plan = spec.plan if spec.plan is not None else EnsemblePlan(
+                spec.model, spec.shape, flags=spec.flags, dtype=spec.dtype,
+                base_settings=spec.base_settings)
+            self._plans[key] = plan
+        return plan
+
+    def batch_cap(self, spec: JobSpec) -> int:
+        cap = fusion.ensemble_batch_cap(
+            spec.model.n_storage, tuple(spec.shape),
+            jnp.dtype(spec.dtype).itemsize)
+        if self.max_batch is not None:
+            cap = min(cap, int(self.max_batch))
+        return max(1, cap)
+
+    def _take_batch(self) -> Optional[list[Job]]:
+        """One compatible batch off the queue (blocks briefly for the
+        first job; non-compatible jobs are requeued for the next lap)."""
+        try:
+            first = self._queue.get(timeout=0.1)
+        except queue.Empty:
+            return None
+        key = _bin_key(first.spec)
+        cap = self.batch_cap(first.spec)
+        batch, requeue = [first], []
+        while len(batch) < cap:
+            try:
+                j = self._queue.get_nowait()
+            except queue.Empty:
+                break
+            (batch if _bin_key(j.spec) == key else requeue).append(j)
+        for j in requeue:
+            self._queue.put(j)
+        return batch
+
+    def _loop(self) -> None:
+        while True:
+            batch = self._take_batch()
+            if batch is None:
+                if self._closing:
+                    return
+                continue
+            try:
+                self._serve_batch(batch)
+            except BaseException as e:  # noqa: BLE001 - never kill the loop
+                for j in batch:
+                    if not j._done.is_set():
+                        j._finish(None, e)
+
+    def _run_batched(self, plan: EnsemblePlan, cases: Sequence[Case],
+                     niter: int) -> list[EnsembleResult]:
+        return plan.run(cases, niter, cache=self.cache)
+
+    def _serve_batch(self, batch: list[Job]) -> None:
+        now = time.monotonic()
+        live: list[Job] = []
+        for j in batch:
+            deadline = (None if j.spec.timeout_s is None
+                        else j.submitted + j.spec.timeout_s)
+            if deadline is not None and now > deadline:
+                j._finish(None, JobTimeout(
+                    f"job {j.id} expired in queue "
+                    f"(waited {now - j.submitted:.2f}s)"))
+                telemetry.counter("serve.jobs.timeout")
+            else:
+                live.append(j)
+        if not live:
+            return
+        spec = live[0].spec
+        key = _bin_key(spec)
+        plan = self._plan_for(spec, key)
+        cap = self.batch_cap(spec)
+        waits = [round(now - j.submitted, 6) for j in live]
+        for j in live:
+            j.status = RUNNING
+        with telemetry.span("serve.batch", batch=len(live), capacity=cap,
+                            model=spec.model.name, niter=int(spec.niter),
+                            engine=plan.engine_tag(len(live)),
+                            wait_s=waits) as sp:
+            results: Optional[list[EnsembleResult]] = None
+            err: Optional[BaseException] = None
+            for attempt in range(1 + self.retries):
+                for j in live:
+                    j.attempts += 1
+                try:
+                    results = self._batch_runner(
+                        plan, [j.spec.case for j in live], spec.niter)
+                    break
+                except Exception as e:  # noqa: BLE001 - degrade below
+                    err = e
+                    if attempt < self.retries:
+                        telemetry.counter("serve.batch.retry")
+                        log.warning(f"serve: batched run failed "
+                                    f"(attempt {attempt + 1}): {e!r}; "
+                                    "retrying")
+            if results is not None:
+                sp.add(outcome="ok")
+                for j, r in zip(live, results):
+                    j._finish(r, None)
+                    self._stream(j)
+                return
+            # bounded retries exhausted: degrade to the sequential path
+            # per job — one bad case (or a batched-compile failure) must
+            # not take down its batch-mates
+            sp.add(outcome="degraded", error=repr(err))
+            telemetry.counter("serve.batch.degraded")
+            log.warning(f"serve: batched run failed after "
+                        f"{1 + self.retries} attempts ({err!r}); "
+                        f"degrading {len(live)} job(s) to sequential")
+        for j in live:
+            j.degraded = True
+            try:
+                r = self._seq_runner(plan, j.spec.case, spec.niter)
+                j._finish(r, None)
+            except Exception as e:  # noqa: BLE001 - per-job verdict
+                j._finish(None, e)
+            self._stream(j)
+
+    def _stream(self, job: Job) -> None:
+        telemetry.counter("serve.jobs.done" if job.status == DONE
+                          else "serve.jobs.failed")
+        if self._on_result is not None:
+            try:
+                self._on_result(job)
+            except Exception as e:  # noqa: BLE001 - callback is advisory
+                log.warning(f"serve: on_result callback failed: {e!r}")

@@ -45,6 +45,51 @@ def load(path: str) -> list[dict]:
     return out
 
 
+def _percentile(vals: list, q: float) -> Optional[float]:
+    if not vals:
+        return None
+    vals = sorted(vals)
+    i = (len(vals) - 1) * q
+    lo = int(i)
+    hi = min(lo + 1, len(vals) - 1)
+    return vals[lo] + (vals[hi] - vals[lo]) * (i - lo)
+
+
+def _serving_summary(evts: list[dict]) -> dict:
+    """The serving health numbers (from ``serve.batch``/``serve.compile``
+    spans): batch occupancy, queue wait percentiles, compile-cache hit
+    rate.  Empty dict when the trace has no serving activity."""
+    batches = [e for e in evts if e.get("kind") == "span"
+               and e.get("name") == "serve.batch"]
+    compiles = [e for e in evts if e.get("kind") == "span"
+                and e.get("name") == "serve.compile"]
+    if not batches and not compiles:
+        return {}
+    out: dict = {}
+    if batches:
+        jobs = sum(int(b.get("batch", 0)) for b in batches)
+        cap = sum(int(b.get("capacity", 0)) for b in batches)
+        waits = [float(w) for b in batches
+                 for w in (b.get("wait_s") or [])]
+        out["batches"] = len(batches)
+        out["jobs"] = jobs
+        out["occupancy_pct"] = (round(100.0 * jobs / cap, 2)
+                                if cap else None)
+        out["degraded_batches"] = sum(
+            1 for b in batches if b.get("outcome") == "degraded")
+        p50, p95 = _percentile(waits, 0.50), _percentile(waits, 0.95)
+        out["queue_wait_p50_s"] = None if p50 is None else round(p50, 6)
+        out["queue_wait_p95_s"] = None if p95 is None else round(p95, 6)
+    if compiles:
+        hits = sum(1 for c in compiles if c.get("cache") == "hit")
+        out["compile_lookups"] = len(compiles)
+        out["cache_hit_rate_pct"] = round(100.0 * hits / len(compiles), 2)
+        out["compile_miss_s"] = round(sum(
+            float(c.get("dur_s", 0.0)) for c in compiles
+            if c.get("cache") == "miss"), 6)
+    return out
+
+
 def summarize(evts: list[dict]) -> dict:
     """Aggregate one trace into the report structure (all plain dicts,
     JSON-serializable as-is)."""
@@ -119,6 +164,7 @@ def summarize(evts: list[dict]) -> dict:
             engines[eng]["vs_roofline"] = round(
                 sum(nu * r for nu, r in rows) / tot, 4)
     return {"engines": engines, "spans": spans,
+            "serving": _serving_summary(evts),
             "engine_selected": [
                 {k: v for k, v in e.items() if k not in ("kind",)}
                 for e in selected],
@@ -173,6 +219,28 @@ def compare(base: dict, other: dict, threshold: float = 0.05) -> dict:
                     "base_mean_s": a["mean_s"], "other_mean_s": b["mean_s"],
                     "delta_pct": row["mean_delta_pct"]})
         out["spans"][name] = row
+    # serving health: flag occupancy and cache-hit-rate drops (an
+    # ensemble fleet quietly falling back to singleton batches is a
+    # throughput regression timing alone may hide behind retries)
+    sa = base.get("serving") or {}
+    sb = other.get("serving") or {}
+    if sa or sb:
+        row = {"base_occupancy_pct": sa.get("occupancy_pct"),
+               "other_occupancy_pct": sb.get("occupancy_pct"),
+               "base_cache_hit_rate_pct": sa.get("cache_hit_rate_pct"),
+               "other_cache_hit_rate_pct": sb.get("cache_hit_rate_pct")}
+        for what, key in (("batch_occupancy", "occupancy_pct"),
+                          ("compile_cache_hit_rate",
+                           "cache_hit_rate_pct")):
+            av, bv = sa.get(key), sb.get(key)
+            if av and bv is not None:
+                delta = (bv - av) / av
+                row[f"{key}_delta_pct"] = round(100 * delta, 2)
+                if delta < -threshold:
+                    out["regressions"].append({
+                        "what": what, "base": av, "other": bv,
+                        "delta_pct": row[f"{key}_delta_pct"]})
+        out["serving"] = row
     # fallback-chain drift is a regression signal of its own (an engine
     # newly failing to compile shows up here before any timing does)
     fb_a = [(f.get("from"), f.get("to")) for f in base.get("fallbacks", [])]
@@ -223,6 +291,23 @@ def format_text(summary: dict) -> str:
                          f"{_fmt(s['total_s'], 4):>10} "
                          f"{_fmt(s['mean_s'], 4):>10} "
                          f"{_fmt(s['max_s'], 4):>10}")
+        lines.append("")
+    if summary.get("serving"):
+        sv = summary["serving"]
+        lines.append("serving")
+        if "batches" in sv:
+            lines.append(
+                f"  batches {sv['batches']}  jobs {sv['jobs']}  "
+                f"occupancy {_fmt(sv['occupancy_pct'], 1)}%  "
+                f"degraded {sv['degraded_batches']}")
+            lines.append(
+                f"  queue wait p50 {_fmt(sv['queue_wait_p50_s'], 4)}s  "
+                f"p95 {_fmt(sv['queue_wait_p95_s'], 4)}s")
+        if "compile_lookups" in sv:
+            lines.append(
+                f"  compile cache: {sv['compile_lookups']} lookups, "
+                f"hit rate {_fmt(sv['cache_hit_rate_pct'], 1)}%, "
+                f"{_fmt(sv['compile_miss_s'], 3)}s compiling")
         lines.append("")
     if summary["engine_selected"]:
         lines.append("engine selections")
@@ -275,6 +360,14 @@ def format_compare_text(diff: dict) -> str:
             lines.append(f"  {name:<44} {_fmt(row['base_mean_s'], 4):>12} "
                          f"{_fmt(row['other_mean_s'], 4):>12} "
                          f"{_fmt(row['mean_delta_pct'], 2):>8}%")
+    if diff.get("serving"):
+        sv = diff["serving"]
+        lines.append(
+            "  serving: occupancy "
+            f"{_fmt(sv['base_occupancy_pct'], 1)}% -> "
+            f"{_fmt(sv['other_occupancy_pct'], 1)}%, cache hit rate "
+            f"{_fmt(sv['base_cache_hit_rate_pct'], 1)}% -> "
+            f"{_fmt(sv['other_cache_hit_rate_pct'], 1)}%")
     if diff.get("fallback_drift"):
         lines.append("  fallback drift: "
                      f"base={diff['fallback_drift']['base']} "

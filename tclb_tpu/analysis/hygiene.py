@@ -521,11 +521,13 @@ def scan_ensemble_unsafe(paths=None) -> list:
 
 
 def check_repo(engine_dir=None, sources=None) -> list:
+    from tclb_tpu.analysis.precision import scan_unsafe_accum
     return (scan_dead_entry_points(engine_dir, sources)
             + scan_id_keyed_caches()
             + scan_dispatch_telemetry()
             + scan_unrestorable_handlers()
-            + scan_ensemble_unsafe())
+            + scan_ensemble_unsafe()
+            + scan_unsafe_accum())
 
 
 def check_model_hygiene(model: Model, shape=None) -> list:

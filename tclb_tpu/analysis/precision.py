@@ -1,0 +1,207 @@
+"""Mixed-precision safety: kernels must accumulate in f32, never in the
+storage dtype.
+
+The precision ladder (``Lattice(storage_dtype=...)``) stores
+distribution fields narrow (bf16) but contracts that every kernel
+widens planes to the compute dtype at the read and narrows only on the
+output write — bf16's 8-bit mantissa makes direct accumulation
+(moment sums, in-kernel Globals reductions) lose mass at ~1e-2
+relative error per few hundred steps, which is exactly the silent
+wrong-physics failure the error harness (``tclb_tpu.precision``) exists
+to bound.
+
+This check makes the contract static: in every engine module that
+*declares* narrowed-storage support (a module-level ``STORAGE_DTYPES``
+tuple containing ``bfloat16``), kernel functions may not feed a raw
+(un-``astype``-ed) read of a field buffer into a reduction or an
+additive accumulation.  Aux/flag buffers are exempt — they are
+allocated in the compute dtype regardless of the storage knob.
+"""
+
+from __future__ import annotations
+
+import ast
+import os
+
+from tclb_tpu.analysis.findings import Finding
+
+_PKG_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+_REPO_ROOT = os.path.dirname(_PKG_ROOT)
+
+# buffer/ref names that carry STORAGE-dtype field planes inside the
+# narrowed-capable kernels (aux stacks — bufa/scra/aux_ref — are
+# compute-dtype by construction and deliberately absent)
+_FIELD_REFS = frozenset({
+    "buff", "buf", "ring", "scrf", "f_ref", "f_hbm", "src", "out_ref",
+})
+
+_REDUCTIONS = frozenset({
+    "sum", "mean", "prod", "cumsum", "dot", "matmul", "tensordot",
+})
+
+
+def _declares_narrow_storage(tree) -> bool:
+    """Module-level ``STORAGE_DTYPES = (..., jnp.bfloat16, ...)``."""
+    for node in ast.iter_child_nodes(tree):
+        if not isinstance(node, ast.Assign):
+            continue
+        if not any(isinstance(t, ast.Name) and t.id == "STORAGE_DTYPES"
+                   for t in node.targets):
+            continue
+        for n in ast.walk(node.value):
+            if isinstance(n, ast.Attribute) and n.attr == "bfloat16":
+                return True
+            if isinstance(n, ast.Constant) and n.value == "bfloat16":
+                return True
+    return False
+
+
+def _base_name(expr):
+    """The root ``Name`` under a chain of subscripts; ``None`` through
+    attribute access (``buff.at[...]`` is a DMA ref handle, not a value
+    read)."""
+    while isinstance(expr, ast.Subscript):
+        expr = expr.value
+    return expr.id if isinstance(expr, ast.Name) else None
+
+
+def _expr_tainted(expr, tainted: set) -> bool:
+    """Whether evaluating ``expr`` reads a storage-dtype value: a raw
+    subscript of a field ref, or a name taint already flowed into.
+    ``.astype(...)`` widens — its whole subtree is clean."""
+    if isinstance(expr, ast.Call) and isinstance(expr.func, ast.Attribute) \
+            and expr.func.attr == "astype":
+        return False
+    if isinstance(expr, ast.Subscript) \
+            and isinstance(expr.ctx, ast.Load) \
+            and _base_name(expr) in _FIELD_REFS:
+        return True
+    if isinstance(expr, ast.Name) and isinstance(expr.ctx, ast.Load) \
+            and expr.id in tainted:
+        return True
+    for child in ast.iter_child_nodes(expr):
+        if _expr_tainted(child, tainted):
+            return True
+    return False
+
+
+def _target_names(target) -> list:
+    if isinstance(target, ast.Name):
+        return [target.id]
+    if isinstance(target, (ast.Tuple, ast.List)):
+        return [x for e in target.elts for x in _target_names(e)]
+    if isinstance(target, (ast.Subscript, ast.Starred)):
+        return _target_names(target.value)
+    return []
+
+
+def scan_unsafe_accum(paths=None) -> list:
+    """Storage-dtype accumulation in narrowed-capable kernel code.
+
+    For each ``kernel*`` function in a ``STORAGE_DTYPES``-declaring ops
+    module, a forward taint pass marks names bound from raw field-buffer
+    reads (no ``.astype``); any reduction call (``jnp.sum``, ``.sum()``,
+    dot products) or additive accumulation (``x += tainted``,
+    ``x = x + tainted``) over tainted values is an error finding."""
+    if paths is None:
+        paths = sorted(
+            os.path.join(_PKG_ROOT, "ops", f)
+            for f in os.listdir(os.path.join(_PKG_ROOT, "ops"))
+            if f.endswith(".py"))
+    findings = []
+    for path in paths:
+        try:
+            with open(path) as fh:
+                tree = ast.parse(fh.read())
+        except (OSError, SyntaxError) as e:
+            findings.append(Finding(
+                "precision.unparseable", "error", "",
+                f"cannot parse {path}: {e}", path))
+            continue
+        if not _declares_narrow_storage(tree):
+            continue
+        rel = os.path.relpath(path, _REPO_ROOT)
+        kernels = [n for n in ast.walk(tree)
+                   if isinstance(n, ast.FunctionDef) and "kernel" in n.name]
+        seen: set = set()   # one finding per source line, even when a
+        #                     kernel nests inside a kernel-named factory
+        for fn in kernels:
+            findings += _scan_kernel(fn, rel, seen)
+    return findings
+
+
+def _scan_kernel(fn, rel: str, seen: set) -> list:
+    findings = []
+    tainted: set = set()
+
+    def flag(lineno: int, what: str) -> None:
+        key = (rel, lineno)
+        if key in seen:
+            return
+        seen.add(key)
+        findings.append(Finding(
+            "precision.unsafe_accum", "error", "",
+            f"{rel}:{lineno} {fn.name}: {what} over a storage-dtype "
+            "field read — widen with .astype(<compute dtype>) at the "
+            "read so narrowed (bf16) storage never accumulates in "
+            "8 mantissa bits", f"{rel}:{lineno}"))
+
+    def check_expr(expr) -> None:
+        """Reductions anywhere inside ``expr``."""
+        for n in ast.walk(expr):
+            if not isinstance(n, ast.Call):
+                continue
+            f = n.func
+            name = f.attr if isinstance(f, ast.Attribute) else (
+                f.id if isinstance(f, ast.Name) else None)
+            if name not in _REDUCTIONS:
+                continue
+            operands = list(n.args)
+            # method form (``x.sum()``): the receiver is the operand
+            if isinstance(f, ast.Attribute):
+                operands.append(f.value)
+            if any(_expr_tainted(a, tainted) for a in operands):
+                flag(n.lineno, f"reduction {name}(...)")
+
+    def ordered_stmts(node):
+        """Statements in source order, recursing into nested bodies
+        (taint must flow forward; ``ast.walk`` is breadth-first)."""
+        for child in ast.iter_child_nodes(node):
+            if isinstance(child, ast.stmt):
+                yield child
+            yield from ordered_stmts(child)
+
+    for stmt in ordered_stmts(fn):
+        if isinstance(stmt, ast.AugAssign):
+            if isinstance(stmt.op, (ast.Add, ast.Sub)) \
+                    and _expr_tainted(stmt.value, tainted):
+                flag(stmt.lineno, "additive accumulation (augmented)")
+            check_expr(stmt.value)
+            if _expr_tainted(stmt.value, tainted):
+                tainted.update(_target_names(stmt.target))
+        elif isinstance(stmt, (ast.Assign, ast.AnnAssign)):
+            if stmt.value is None:
+                continue
+            check_expr(stmt.value)
+            targets = (stmt.targets if isinstance(stmt, ast.Assign)
+                       else [stmt.target])
+            names = [x for t in targets for x in _target_names(t)]
+            # self-accumulation: x = x + <tainted>
+            if isinstance(stmt.value, ast.BinOp) \
+                    and isinstance(stmt.value.op, (ast.Add, ast.Sub)) \
+                    and _expr_tainted(stmt.value, tainted) \
+                    and any(isinstance(n, ast.Name) and n.id in names
+                            for n in ast.walk(stmt.value)):
+                flag(stmt.lineno, "additive accumulation")
+            hot = _expr_tainted(stmt.value, tainted)
+            for t in targets:
+                strong = isinstance(t, (ast.Name, ast.Tuple, ast.List))
+                for name in _target_names(t):
+                    if hot:
+                        tainted.add(name)
+                    elif strong:
+                        tainted.discard(name)
+        elif isinstance(stmt, (ast.Expr, ast.Return)):
+            if stmt.value is not None:
+                check_expr(stmt.value)
+    return findings

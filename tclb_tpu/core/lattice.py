@@ -479,7 +479,8 @@ def make_action_step(model: Model, action: str = "Iteration",
 def make_iterate(model: Model, action: str = "Iteration",
                  unroll: int = 1,
                  streaming: Optional[Streaming] = None,
-                 present: Optional[set] = None) -> Callable:
+                 present: Optional[set] = None,
+                 storage_dtype: Any = None) -> Callable:
     """niter-step loop as a ``lax.scan`` (reference Lattice::Iterate,
     src/Lattice.cu.Rt:780-869).  Differentiable; wrap with ``jax.checkpoint``
     policies for long-horizon adjoints (reference SnapLevel tape,
@@ -489,22 +490,44 @@ def make_iterate(model: Model, action: str = "Iteration",
     (each action step zeroes them), so the first niter-1 steps run the
     NoGlobals specialization — the reductions are pure waste there (the
     reference's Globals-mode template parameter, src/cuda.cu.Rt:81) —
-    and only the final step reduces."""
+    and only the final step reduces.
+
+    ``storage_dtype`` (precision ladder) narrows the scan CARRY to that
+    dtype: each step widens the fields to the compute dtype (taken from
+    ``params.settings.dtype``), runs the action, and narrows the result
+    back, so the HBM-resident state between steps is genuinely
+    ``storage_dtype`` — the same round-trip truncation the Pallas
+    engines apply per DMA, which is what the error-vs-f32 harness
+    (tclb_tpu/precision.py) must measure.  ``None`` keeps today's exact
+    path (the casts never enter the trace)."""
     step_ng = make_action_step(model, action, streaming, present=present,
                                compute_globals=False)
     step_full = make_action_step(model, action, streaming, present=present,
                                  compute_globals=True)
+    sdt = None if storage_dtype is None else jnp.dtype(storage_dtype)
 
     def iterate(state: LatticeState, params: SimParams, niter: int
                 ) -> LatticeState:
         if niter <= 0:
             return state
+        if sdt is None:
+            def body(s, _):
+                return step_ng(s, params), None
+            state, _ = jax.lax.scan(body, state, None, length=niter - 1,
+                                    unroll=unroll)
+            return step_full(state, params)
+
+        cdt = params.settings.dtype
 
         def body(s, _):
-            return step_ng(s, params), None
-        state, _ = jax.lax.scan(body, state, None, length=niter - 1,
-                                unroll=unroll)
-        return step_full(state, params)
+            out = step_ng(s.replace(fields=s.fields.astype(cdt)), params)
+            return out.replace(fields=out.fields.astype(sdt)), None
+        state, _ = jax.lax.scan(
+            body, state.replace(fields=state.fields.astype(sdt)),
+            None, length=niter - 1, unroll=unroll)
+        out = step_full(state.replace(fields=state.fields.astype(cdt)),
+                        params)
+        return out.replace(fields=out.fields.astype(sdt))
 
     return iterate
 
@@ -532,7 +555,8 @@ def make_ensemble_step(model: Model, action: str = "Init",
 def make_ensemble_iterate(model: Model, action: str = "Iteration",
                           unroll: int = 1,
                           present: Optional[set] = None,
-                          mode: str = "map") -> Callable:
+                          mode: str = "map",
+                          storage_dtype: Any = None) -> Callable:
     """Batched counterpart of :func:`make_iterate`: advance N independent
     cases (stacked ``LatticeState``s + per-case ``SimParams``) in ONE
     device dispatch.
@@ -553,7 +577,13 @@ def make_ensemble_iterate(model: Model, action: str = "Iteration",
     multiply-add chains (the same re-association ``lbm.pin`` fences
     elsewhere) and drifts fields by 1 ulp — e.g. d2q9_kuper's forcing
     stage on a painted cavity.  Opt in only where throughput beats
-    bit-reproducibility."""
+    bit-reproducibility.
+
+    ``storage_dtype`` narrows each case's carry between steps exactly
+    like :func:`make_iterate`'s precision ladder — the serving tier's
+    doubled batch caps come from genuinely bf16-resident ensemble
+    state, so the per-step round trip must match the single-case
+    engines' truncation."""
     if mode not in ("map", "vmap"):
         raise ValueError(f"ensemble mode must be 'map' or 'vmap', "
                          f"got {mode!r}")
@@ -561,6 +591,17 @@ def make_ensemble_iterate(model: Model, action: str = "Iteration",
                                compute_globals=False)
     step_full = make_action_step(model, action, present=present,
                                  compute_globals=True)
+    sdt = None if storage_dtype is None else jnp.dtype(storage_dtype)
+
+    def _wrap(step, params):
+        if sdt is None:
+            return step
+
+        def stepped(st, p=params):
+            cdt = p.settings.dtype
+            out = step(st.replace(fields=st.fields.astype(cdt)), p)
+            return out.replace(fields=out.fields.astype(sdt))
+        return stepped
 
     def iterate_map(states: LatticeState, params: SimParams, niter: int
                     ) -> LatticeState:
@@ -569,12 +610,13 @@ def make_ensemble_iterate(model: Model, action: str = "Iteration",
 
         def one(sp):
             s, p = sp
+            ng, fl = _wrap(step_ng, p), _wrap(step_full, p)
 
             def body(st, _):
-                return step_ng(st, p), None
+                return ng(st, p) if sdt is None else ng(st), None
             s, _ = jax.lax.scan(body, s, None, length=niter - 1,
                                 unroll=unroll)
-            return step_full(s, p)
+            return fl(s, p) if sdt is None else fl(s)
 
         return jax.lax.map(one, (states, params))
 
@@ -583,12 +625,29 @@ def make_ensemble_iterate(model: Model, action: str = "Iteration",
         if niter <= 0:
             return states
 
-        def body(s, _):
-            return jax.vmap(step_ng)(s, params), None
+        if sdt is None:
+            def body(s, _):
+                return jax.vmap(step_ng)(s, params), None
+        else:
+            def narrow_step(st, p):
+                out = step_ng(
+                    st.replace(fields=st.fields.astype(p.settings.dtype)),
+                    p)
+                return out.replace(fields=out.fields.astype(sdt))
+
+            def body(s, _):
+                return jax.vmap(narrow_step)(s, params), None
         states, _ = jax.lax.scan(body, states, None, length=niter - 1,
                                  unroll=unroll)
-        return jax.lax.map(lambda sp: step_full(sp[0], sp[1]),
-                           (states, params))
+
+        def final(sp):
+            s, p = sp
+            if sdt is None:
+                return step_full(s, p)
+            out = step_full(
+                s.replace(fields=s.fields.astype(p.settings.dtype)), p)
+            return out.replace(fields=out.fields.astype(sdt))
+        return jax.lax.map(final, (states, params))
 
     return iterate_map if mode == "map" else iterate_vmap
 
@@ -649,13 +708,34 @@ class Lattice:
     def __init__(self, model: Model, shape: Sequence[int],
                  dtype: Any = jnp.float32,
                  settings: Optional[dict[str, float]] = None,
-                 mesh: Any = None):
+                 mesh: Any = None,
+                 storage_dtype: Any = None):
         if len(shape) != model.ndim:
             raise ValueError(f"model {model.name} is {model.ndim}D; "
                              f"got shape {shape}")
         self.model = model
         self.shape = tuple(int(s) for s in shape)
         self.dtype = dtype
+        # precision ladder: ``storage_dtype`` narrows the HBM-resident
+        # distribution fields only — every kernel still accumulates in
+        # the compute dtype (``dtype``), settings/zone tables/globals
+        # stay wide, and flags are untouched.  Strictly OPT-IN: the
+        # default is the compute dtype and nothing ever narrows
+        # silently.  Validated by the error-vs-reference harness
+        # (tclb_tpu/precision.py), not by bit-parity.
+        sdt = jnp.dtype(dtype) if storage_dtype is None \
+            else jnp.dtype(storage_dtype)
+        if sdt != jnp.dtype(dtype):
+            if not jnp.issubdtype(sdt, jnp.floating) \
+                    or sdt.itemsize > jnp.dtype(dtype).itemsize:
+                raise ValueError(
+                    f"storage_dtype {sdt} must be a float dtype no wider "
+                    f"than the compute dtype {jnp.dtype(dtype)}")
+            if mesh is not None:
+                raise ValueError("narrowed storage_dtype is not supported "
+                                 "on sharded (mesh) lattices: the halo "
+                                 "building block is f32-only")
+        self.storage_dtype = sdt
         self.mesh = mesh
         vec = model.settings_vector(settings)
         self._series: dict[tuple[int, int], np.ndarray] = {}
@@ -666,7 +746,7 @@ class Lattice:
                 dtype=dtype),
         )
         self.state = LatticeState(
-            fields=jnp.zeros((model.n_storage,) + self.shape, dtype=dtype),
+            fields=jnp.zeros((model.n_storage,) + self.shape, dtype=sdt),
             flags=jnp.zeros(self.shape, dtype=FLAG_DTYPE),
             globals_=jnp.zeros((model.n_globals,), dtype=dtype),
             iteration=jnp.zeros((), dtype=jnp.int32),
@@ -684,7 +764,15 @@ class Lattice:
         # flags span non-addressable devices and cannot be fetched back
         self._iterate_cached = None
         self._host_flags: Optional[np.ndarray] = None
-        self._init = jax.jit(make_action_step(model, "Init"), donate_argnums=0)
+        step_init = make_action_step(model, "Init")
+        if sdt != jnp.dtype(dtype):
+            def _init_narrow(state, params, _step=step_init,
+                             _cdt=jnp.dtype(dtype), _sdt=sdt):
+                out = _step(state.replace(fields=state.fields.astype(_cdt)),
+                            params)
+                return out.replace(fields=out.fields.astype(_sdt))
+            step_init = _init_narrow
+        self._init = jax.jit(step_init, donate_argnums=0)
         self.sampler = None
         self._iterate_sampled = None
         self.avg_start = 0    # iteration of the last <Average> reset
@@ -784,8 +872,11 @@ class Lattice:
                 self._iterate_cached = make_sharded_iterate(
                     self.model, self.mesh, present=present)
             else:
+                narrowed = self.storage_dtype != jnp.dtype(self.dtype)
                 self._iterate_cached = jax.jit(
-                    make_iterate(self.model, present=present),
+                    make_iterate(self.model, present=present,
+                                 storage_dtype=(self.storage_dtype
+                                                if narrowed else None)),
                     static_argnames=("niter",), donate_argnums=0)
         return self._iterate_cached
 
@@ -809,6 +900,13 @@ class Lattice:
         # only the generic engine implements — skip the tuned kernels
         # (set_setting_series invalidates the engine so this re-runs)
         has_series = self.params.time_series is not None
+        # engines receive the STORAGE dtype: their HBM stacks and DMA
+        # scratch narrow with it while their compute stays f32 (each
+        # kernel family widens on read / narrows on write); f32-only
+        # families (pallas_d2q9, sharded) reject it in supports() and
+        # dispatch falls through to the d3q/generic families
+        sdt = self.storage_dtype
+        s_itemsize = jnp.dtype(sdt).itemsize
         if self.mesh is not None:
             from tclb_tpu.ops.lbm import present_types
             from tclb_tpu.parallel.halo import make_sharded_pallas_iterate
@@ -822,7 +920,7 @@ class Lattice:
             return None, None
         if (not has_series
                 and pallas_d2q9.supports_resident(self.model, self.shape,
-                                                  self.dtype)):
+                                                  sdt)):
             # small domains: whole lattice VMEM-resident, 8 steps per
             # kernel call — (1R+1W)/8 HBM traffic per step.  First call
             # is probed (the budget cannot see Mosaic's temporaries);
@@ -832,30 +930,40 @@ class Lattice:
                 self.model, self._flags_host())
             self._fast_probing = True
             return (pallas_d2q9.make_resident_iterate(
-                self.model, self.shape, self.dtype, present=present),
+                self.model, self.shape, sdt, present=present),
                 f"pallas_resident[{self.model.name},fuse=8]")
         if (not has_series
-                and pallas_d2q9.supports(self.model, self.shape,
-                                         self.dtype)):
+                and pallas_d2q9.supports(self.model, self.shape, sdt)):
             present = pallas_d2q9.present_types(
                 self.model, self._flags_host())
             return (pallas_d2q9.make_pallas_iterate(
-                self.model, self.shape, self.dtype, fuse=2,
+                self.model, self.shape, sdt, fuse=2,
                 present=present),
                 f"pallas_2d[{self.model.name},fuse=2]")
         if not has_series and pallas_d3q.supports(
-                self.model, self.shape, self.dtype):
+                self.model, self.shape, sdt):
             present = pallas_d3q.present_types(
                 self.model, self._flags_host())
             # K>=2 multi-step fusion (one HBM round trip per K steps)
             # compiles against the raised scoped-vmem ceiling: first TPU
             # compile may still hit Mosaic temporaries the planner can't
             # see, so the fused build is probed (fallback: fuse=1)
-            k3 = pallas_d3q.choose_fuse(self.model, self.shape)
+            k3 = pallas_d3q.choose_fuse(self.model, self.shape,
+                                        itemsize=s_itemsize)
             if k3 >= 2:
                 self._fast_probing = True
+            else:
+                # single-step demotion must never be silent: record WHY
+                # the fused planner rejected every (bz, K) so a floor
+                # regression can be triaged from telemetry alone
+                _, why = pallas_d3q.fused_cfg_explain(
+                    self.model, self.shape, itemsize=s_itemsize)
+                telemetry.event(
+                    "fused_rejected", engine="pallas_d3q",
+                    model=self.model.name, shape=list(self.shape),
+                    reason=why or "unknown")
             return (pallas_d3q.make_pallas_iterate(
-                self.model, self.shape, self.dtype, present=present),
+                self.model, self.shape, sdt, present=present),
                 f"pallas_d3q[{self.model.name},fuse={k3}]")
         from tclb_tpu.ops import pallas_generic
         # the static analyzer's kernel-safety verdict gates EVERY
@@ -867,7 +975,7 @@ class Lattice:
             return None, None
         if (not has_series
                 and pallas_generic.supports_resident(self.model, self.shape,
-                                                     self.dtype)
+                                                     sdt)
                 and pallas_generic.mosaic_ok(self.model, self.shape)):
             # generic counterpart of the tuned d2q9 resident engine
             # (checked above): whole lattice VMEM-resident, 8 steps per
@@ -878,9 +986,9 @@ class Lattice:
             present = present_types(self.model, self._flags_host())
             self._fast_probing = True
             return (pallas_generic.make_resident_iterate(
-                self.model, self.shape, self.dtype, present=present),
+                self.model, self.shape, sdt, present=present),
                 f"pallas_resident_generic[{self.model.name},fuse=8]")
-        if (pallas_generic.supports(self.model, self.shape, self.dtype)
+        if (pallas_generic.supports(self.model, self.shape, sdt)
                 and pallas_generic.mosaic_ok(self.model, self.shape)):
             from tclb_tpu.ops.lbm import present_types
             present = present_types(self.model, self._flags_host())
@@ -898,13 +1006,14 @@ class Lattice:
                 # traffic model vs the K=1 engine (3D: slab halos grow
                 # with K, so the win must be priced)
                 fz = (pallas_generic.choose_fuse_3d(self.model,
-                                                    self.shape)
+                                                    self.shape,
+                                                    itemsize=s_itemsize)
                       if self.model.ndim == 3
                       else pallas_generic.choose_fuse(self.model))
                 cap = None
             self._fast_cfg = (fz, cap)
             return (pallas_generic.make_pallas_iterate(  # lowering gap
-                self.model, self.shape, self.dtype, fuse=fz,
+                self.model, self.shape, sdt, fuse=fz,
                 present=present, by_cap=cap),
                 f"pallas_generic[{self.model.name},fuse={fz}]")
         return None, None
@@ -945,6 +1054,7 @@ class Lattice:
                 bytes_per_node=(2 * self.model.n_storage
                                 * np.dtype(self.state.fields.dtype).itemsize
                                 + 2),
+                storage_dtype=np.dtype(self.state.fields.dtype).name,
                 model=self.model.name,
                 iteration=int(self.state.iteration)) as sp:
             self._iterate_impl(niter)
@@ -1014,7 +1124,7 @@ class Lattice:
                             self.model, self._flags_host())
                         self._fast = fast = \
                             pallas_d3q.make_pallas_iterate(
-                                self.model, self.shape, self.dtype,
+                                self.model, self.shape, self.storage_dtype,
                                 present=present, fuse=1)
                         self._fast_name = (
                             f"pallas_d3q[{self.model.name},fuse=1]")
@@ -1044,13 +1154,16 @@ class Lattice:
                             present = present_types(self.model,
                                                     self._flags_host())
                             fz = (pallas_generic.choose_fuse_3d(
-                                self.model, self.shape)
+                                self.model, self.shape,
+                                itemsize=jnp.dtype(
+                                    self.storage_dtype).itemsize)
                                 if self.model.ndim == 3
                                 else pallas_generic.choose_fuse(
                                     self.model))
                             self._fast = fast = \
                                 pallas_generic.make_pallas_iterate(
-                                    self.model, self.shape, self.dtype,
+                                    self.model, self.shape,
+                                    self.storage_dtype,
                                     fuse=fz, present=present)
                             self._fast_cfg = (fz, None)
                             self._fast_name = (
@@ -1100,7 +1213,7 @@ class Lattice:
                     for fz, cap in ladder:
                         try:
                             it2 = pallas_generic.make_pallas_iterate(
-                                self.model, self.shape, self.dtype,
+                                self.model, self.shape, self.storage_dtype,
                                 fuse=fz, present=present, by_cap=cap)
                             self.state = attempt(it2)
                         except Exception:  # noqa: BLE001
@@ -1165,7 +1278,9 @@ class Lattice:
         """Evaluate a registered Quantity over the lattice (reference
         Lattice::GetQuantity, src/Lattice.cu.Rt:1012-1036)."""
         fn = self.model.quantity_fns[name]
-        ctx = NodeCtx(self.model, self.state.fields, self.state.fields,
+        # quantities evaluate in the compute dtype (no-op cast at f32)
+        fields = self.state.fields.astype(self.dtype)
+        ctx = NodeCtx(self.model, fields, fields,
                       self.state.flags, self.params,
                       iteration=self.state.iteration,
                       avg_start=self.avg_start)
@@ -1197,7 +1312,7 @@ class Lattice:
         fields = self.state.fields
         for name, value in values.items():
             fields = fields.at[self.model.storage_index[name]].set(
-                jnp.asarray(value, dtype=self.dtype))
+                jnp.asarray(value, dtype=self.storage_dtype))
         self.state = dataclasses.replace(self.state, fields=fields)
         if self._place is not None:
             self.state, self.params = self._place()
@@ -1206,7 +1321,7 @@ class Lattice:
         self.state = dataclasses.replace(
             self.state, fields=self.state.fields.at[
                 self.model.storage_index[name]].set(
-                    jnp.asarray(value, dtype=self.dtype)))
+                    jnp.asarray(value, dtype=self.storage_dtype)))
         if self._place is not None:
             self.state, self.params = self._place()
 
@@ -1262,7 +1377,7 @@ class Lattice:
         self._iterate_cached = None
         self._host_flags = np.asarray(d["flags"], dtype=np.uint16)
         self.state = LatticeState(
-            fields=jnp.asarray(d["fields"], dtype=self.dtype),
+            fields=jnp.asarray(d["fields"], dtype=self.storage_dtype),
             flags=jnp.asarray(d["flags"], dtype=FLAG_DTYPE),
             globals_=self.state.globals_,
             iteration=jnp.asarray(d["iteration"], dtype=jnp.int32),

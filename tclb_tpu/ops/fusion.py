@@ -25,7 +25,9 @@ from typing import Callable, Iterable, Optional, Tuple
 
 import jax.numpy as jnp
 
-FUSE_MAX = 4   # diminishing returns + halo growth beyond 4 fused steps
+FUSE_MAX = 8   # halo growth is priced by the planners; beyond 8 the
+#                amortized read term (b + 2*K*reach)/b stops improving
+#                faster than the halo cost grows for every model we ship
 
 
 def choose_fuse_band(reach_of: Callable[[int], int], halo: int,

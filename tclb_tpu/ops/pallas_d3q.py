@@ -56,6 +56,15 @@ from tclb_tpu.ops.pallas_generic import _CompilerParams
 
 _SUPPORTED = ("d3q27_BGK", "d3q27_BGK_galcor", "d3q27_cumulant",
               "d3q19", "d3q19_les")
+# storage dtypes this family can keep in HBM.  Compute is ALWAYS f32:
+# fields are cast up right after the VMEM read and cast back down on the
+# output write, so bf16 halves HBM bytes per node without touching the
+# collision arithmetic (the precision-ladder contract; bf16 runs are
+# validated by the error-vs-f32 harness in tclb_tpu/precision.py, not
+# by bit-parity).  The marker is also what analysis/precision.py keys
+# its unsafe-accumulation scan on.
+STORAGE_DTYPES = (jnp.float32, jnp.bfloat16)
+_COMPUTE_DTYPE = jnp.float32
 _VMEM_BUDGET = 15 * 1024 * 1024
 # the fused (K>=2) kernel budgets against a raised Mosaic ceiling: its
 # scratch is deliberately larger (K halo slabs per side, 2 slots) and the
@@ -82,7 +91,8 @@ def _q_of(model: Model) -> int:
 _RING = 4   # ring capacity: slab j lives in slot j % 4 for its 3-step life
 
 
-def _ring_ok(model: Model, nz: int, ny: int, nx: int) -> bool:
+def _ring_ok(model: Model, nz: int, ny: int, nx: int,
+             itemsize: int = 4) -> bool:
     """Whether the rolling-window (neighbor-slab reuse) kernel applies:
     one z-slab per grid step, ring of 4 resident slabs, each slab DMA'd
     from HBM ONCE per lattice step (vs (bz+2)/bz read amplification of
@@ -93,19 +103,20 @@ def _ring_ok(model: Model, nz: int, ny: int, nx: int) -> bool:
     ns = model.n_storage
     q = _q_of(model)
     naux = ns - q
-    per = ny * nx * 4
+    per = ny * nx * itemsize
     need = (_RING * q + 2 * naux + 2 * ns + 2 * 4) * per
     return nz % _RING == 0 and nz >= 2 * _RING and need <= _VMEM_BUDGET
 
 
-def _slab_depth(model: Model, nz: int, ny: int, nx: int) -> Optional[int]:
+def _slab_depth(model: Model, nz: int, ny: int, nx: int,
+                itemsize: int = 4) -> Optional[int]:
     """Largest band depth BZ dividing nz whose working set fits VMEM:
     scratch (ns, BZ+2) slabs + output block + flag/zonal blocks + the
     collision's live intermediates (~6 stacked q-plane tensors)."""
     ns = model.n_storage
     q = _q_of(model)
     naux = ns - q
-    per = ny * nx * 4
+    per = ny * nx * itemsize
     best = None
     for bz in range(1, nz + 1):
         if nz % bz:
@@ -126,17 +137,20 @@ def _n_zonal(model: Model) -> int:
 
 
 def _fused_fits(model: Model, nz: int, ny: int, nx: int,
-                bz: int, K: int) -> bool:
+                bz: int, K: int, itemsize: int = 4) -> bool:
     """VMEM predicate for the fused kernel at (bz, K): 2-slot halo'd
     f+aux buffers + 2-slot flag buffers + pipelined out blocks + the
-    widest fused window's collision intermediates."""
+    widest fused window's collision intermediates.  The DMA scratch
+    scales with the storage itemsize; the collision temporaries are
+    always compute-dtype (f32) planes."""
     ns = model.n_storage
     q = _q_of(model)
-    per = ny * nx * 4
+    per = ny * nx
     H = bz + 2 * K
-    scratch = (2 * (ns + 1) * H + 2 * ns * bz) * per
-    temp = _TEMP_PLANES * q * (bz + 2 * (K - 1)) * per
-    return scratch + temp <= _FUSED_BUDGET
+    scratch = (2 * ns * H + 2 * ns * bz) * per * itemsize
+    flagbuf = 2 * H * per * 4   # int32 flag buffer, itemsize-invariant
+    temp = _TEMP_PLANES * q * (bz + 2 * (K - 1)) * per * 4
+    return scratch + flagbuf + temp <= _FUSED_BUDGET
 
 
 def _fused_cost(model: Model, bz: int, K: int) -> float:
@@ -147,39 +161,76 @@ def _fused_cost(model: Model, bz: int, K: int) -> float:
     return ((ns + 1) * (bz + 2 * K) + ns * bz) / (K * bz)
 
 
-def _base_cost(model: Model, nz: int, ny: int, nx: int) -> float:
+def _base_cost(model: Model, nz: int, ny: int, nx: int,
+               itemsize: int = 4) -> float:
     """Best single-step engine's HBM planes per step (the bar a fused
     config must beat): the ring kernel reads each plane once; the block
     kernel pays (bz+2)/bz read amplification on the f planes."""
     ns = model.n_storage
     q = _q_of(model)
     zn = _n_zonal(model)
-    if _ring_ok(model, nz, ny, nx):
+    if _ring_ok(model, nz, ny, nx, itemsize):
         return 2.0 * ns + 1 + zn
-    bz = _slab_depth(model, nz, ny, nx)
+    bz = _slab_depth(model, nz, ny, nx, itemsize)
     if bz is None:
         return float("inf")
     return (q * (bz + 2) + (ns - q) * bz + (1 + zn) * bz + ns * bz) / bz
 
 
-def fused_cfg(model: Model, shape) -> Optional[tuple]:
+def fused_cfg(model: Model, shape, itemsize: int = 4) -> Optional[tuple]:
     """Production fused-kernel config ``(bz, K)`` for this shape, or
     None when single-step is the better (or only feasible) plan.
     Shared with analysis/resources.py so the static VMEM check audits
     exactly what the engine will build."""
+    cfg, _ = fused_cfg_explain(model, shape, itemsize)
+    return cfg
+
+
+def fused_cfg_explain(model: Model, shape, itemsize: int = 4
+                      ) -> tuple[Optional[tuple], Optional[str]]:
+    """Planner verdict WITH its reason: ``((bz, K), None)`` when a fused
+    config wins, else ``(None, reason)`` naming the failing predicate
+    term — either no (bz, K) fits ``_FUSED_BUDGET`` (VMEM) or the best
+    feasible fused traffic does not beat the single-step engine (cost).
+    The Lattice dispatch forwards the reason as a ``fused_rejected``
+    telemetry event so a silent single-step demotion (the PR-5 bench's
+    untagged d3q27 engine) can never recur unnoticed."""
     if model.name not in _SUPPORTED or len(shape) != 3:
-        return None
+        return None, "unsupported: model/shape outside the tuned 3D family"
     nz, ny, nx = (int(s) for s in shape)
-    return fusion.choose_fuse_slab(
+    base = _base_cost(model, nz, ny, nx, itemsize)
+    cfg = fusion.choose_fuse_slab(
         nz,
-        lambda bz, K: _fused_fits(model, nz, ny, nx, bz, K),
+        lambda bz, K: _fused_fits(model, nz, ny, nx, bz, K, itemsize),
         lambda bz, K: _fused_cost(model, bz, K),
-        _base_cost(model, nz, ny, nx))
+        base)
+    if cfg is not None:
+        return cfg, None
+    # no K >= 2 selected: re-walk the search recording WHY
+    feasible = []
+    for K in range(2, fusion.FUSE_MAX + 1):
+        if nz < 2 * K:
+            break
+        bzs = [bz for bz in range(1, nz + 1) if nz % bz == 0
+               and _fused_fits(model, nz, ny, nx, bz, K, itemsize)]
+        if bzs:
+            feasible.append((max(bzs), K))
+    if not feasible:
+        return None, (
+            f"vmem: no (bz, K) fits _FUSED_BUDGET="
+            f"{_FUSED_BUDGET // (1024 * 1024)}MB at shape "
+            f"{(nz, ny, nx)} (scratch + {_TEMP_PLANES} temp planes/q)")
+    bz_b, K_b = min(feasible,
+                    key=lambda c: _fused_cost(model, c[0], c[1]))
+    return None, (
+        f"cost: best fused (bz={bz_b}, K={K_b}) models "
+        f"{_fused_cost(model, bz_b, K_b):.2f} planes/step >= "
+        f"single-step {base:.2f}")
 
 
-def choose_fuse(model: Model, shape) -> int:
+def choose_fuse(model: Model, shape, itemsize: int = 4) -> int:
     """Fusion depth K the engine will run at (1 = single-step)."""
-    cfg = fused_cfg(model, shape)
+    cfg = fused_cfg(model, shape, itemsize)
     return cfg[1] if cfg else 1
 
 
@@ -192,14 +243,18 @@ def supports(model: Model, shape, dtype, ext_halo: bool = False) -> bool:
     cleanly instead of building a kernel Mosaic will reject."""
     if model.name not in _SUPPORTED:
         return False
-    if len(shape) != 3 or dtype != jnp.float32:
+    if len(shape) != 3 or jnp.dtype(dtype) not in (
+            jnp.dtype(d) for d in STORAGE_DTYPES):
         return False
+    if ext_halo and jnp.dtype(dtype) != jnp.dtype(jnp.float32):
+        return False   # the sharded composition is f32-only (bit-parity)
+    itemsize = jnp.dtype(dtype).itemsize
     nz, ny, nx = (int(s) for s in shape)
     if jax.default_backend() == "tpu" and (nx % 128 or ny % 8):
         return False  # (ny, nx) is the (sublane, lane) tile
-    if _slab_depth(model, nz, ny, nx) is not None:
+    if _slab_depth(model, nz, ny, nx, itemsize) is not None:
         return True
-    return (not ext_halo) and _ring_ok(model, nz, ny, nx)
+    return (not ext_halo) and _ring_ok(model, nz, ny, nx, itemsize)
 
 
 present_types = lbm.present_types   # shared helper (re-exported)
@@ -229,12 +284,20 @@ def make_pallas_iterate(model: Model, shape, dtype=jnp.float32,
     ``ppermute``."""
     if not supports(model, shape, dtype):
         raise ValueError(f"pallas path unsupported for {model.name} {shape}")
+    # storage dtype (what HBM holds) vs compute dtype (what the collision
+    # arithmetic runs in).  At f32 storage the casts below are traced
+    # no-ops, so the bit-parity contract with the XLA path is untouched;
+    # at bf16 every field value is widened right after the VMEM read and
+    # narrowed on the output write (accumulate-in-f32 — the
+    # precision.unsafe_accum contract)
+    cdtype = _COMPUTE_DTYPE
+    itemsize = jnp.dtype(dtype).itemsize
     nz, ny, nx = (int(s) for s in shape)
-    bz = _slab_depth(model, nz, ny, nx) or 1
+    bz = _slab_depth(model, nz, ny, nx, itemsize) or 1
     if ext_halo:
         fuse = 1
     if fuse is None:
-        cfg = fused_cfg(model, shape)
+        cfg = fused_cfg(model, shape, itemsize)
     else:
         cfg = None
         if fuse >= 2:
@@ -242,7 +305,8 @@ def make_pallas_iterate(model: Model, shape, dtype=jnp.float32,
             if bzf is None:
                 bzf = max(b for b in range(1, nz + 1) if nz % b == 0
                           and (b == 1
-                               or _fused_fits(model, nz, ny, nx, b, fuse)))
+                               or _fused_fits(model, nz, ny, nx, b, fuse,
+                                              itemsize)))
             if nz % bzf:
                 raise ValueError(f"fused band depth {bzf} must divide {nz}")
             cfg = (bzf, fuse)
@@ -363,7 +427,7 @@ def make_pallas_iterate(model: Model, shape, dtype=jnp.float32,
         return jnp.where(coll[None], fc, f), None
 
     naux = len(aux_idx)
-    ring_mode = (not ext_halo) and _ring_ok(model, nz, ny, nx)
+    ring_mode = (not ext_halo) and _ring_ok(model, nz, ny, nx, itemsize)
 
     def kernel_ring(sett, f_hbm, flags_ref, zonal_ref, out_ref, ring, scra,
                     sems, sems_a):
@@ -447,22 +511,28 @@ def make_pallas_iterate(model: Model, shape, dtype=jnp.float32,
         # the barrier pins the streamed values before collision: without
         # it the compiler fuses the rolls into the collide arithmetic,
         # changing FMA contraction and breaking bit-parity with the XLA
-        # path (where streaming materializes before the collide fusion)
-        f = jax.lax.optimization_barrier(jnp.stack(pulled))
+        # path (where streaming materializes before the collide fusion).
+        # astype widens bf16 storage to the f32 compute dtype (no-op at
+        # f32 storage, so the parity contract is untouched)
+        f = jax.lax.optimization_barrier(
+            jnp.stack(pulled).astype(cdtype))
         flags = flags_ref[:]
         zonal = zonal_ref[:]
-        synth = [scra[aslot, aux_idx.index(j)] for j in synth_idx] \
-            if is_cumulant else None
+        synth = [scra[aslot, aux_idx.index(j)].astype(cdtype)
+                 for j in synth_idx] if is_cumulant else None
         fnew, extras = _step(f, flags, zonal, synth, sett)
         for k in range(q):
-            out_ref[k] = fnew[k]
+            out_ref[k] = fnew[k].astype(dtype)
         if is_cumulant:
             for j in synth_idx:
                 out_ref[j] = scra[aslot, aux_idx.index(j)]
             p_inc, (ux, uy, uz) = extras
-            out_ref[avgp_idx] = scra[aslot, aux_idx.index(avgp_idx)] + p_inc
+            out_ref[avgp_idx] = (
+                scra[aslot, aux_idx.index(avgp_idx)].astype(cdtype)
+                + p_inc).astype(dtype)
             for j, u in zip(avgu_idx, (ux, uy, uz)):
-                out_ref[j] = scra[aslot, aux_idx.index(j)] + u
+                out_ref[j] = (scra[aslot, aux_idx.index(j)].astype(cdtype)
+                              + u).astype(dtype)
 
     def kernel(sett, f_hbm, flags_ref, zonal_ref, out_ref, scrf, scra, sems):
         # 2-slot double buffering: band i+1's DMAs are issued before band
@@ -536,24 +606,29 @@ def make_pallas_iterate(model: Model, shape, dtype=jnp.float32,
         # the barrier pins the streamed values before collision: without
         # it the compiler fuses the rolls into the collide arithmetic,
         # changing FMA contraction and breaking bit-parity with the XLA
-        # path (where streaming materializes before the collide fusion)
-        f = jax.lax.optimization_barrier(jnp.stack(pulled))
+        # path (where streaming materializes before the collide fusion);
+        # astype widens bf16 storage to the f32 compute dtype
+        f = jax.lax.optimization_barrier(
+            jnp.stack(pulled).astype(cdtype))
         flags = flags_ref[:]
         zonal = zonal_ref[:]
-        synth = [scra[slot, aux_idx.index(j)] for j in synth_idx] \
-            if is_cumulant else None
+        synth = [scra[slot, aux_idx.index(j)].astype(cdtype)
+                 for j in synth_idx] if is_cumulant else None
         fnew, extras = _step(f, flags, zonal, synth, sett)
         for k in range(q):
-            out_ref[k] = fnew[k]
+            out_ref[k] = fnew[k].astype(dtype)
         if is_cumulant:
             # SynthT passthrough; running averages accumulate per step
             # (reference average=T densities + Lattice::resetAverage)
             for j in synth_idx:
                 out_ref[j] = scra[slot, aux_idx.index(j)]
             p_inc, (ux, uy, uz) = extras
-            out_ref[avgp_idx] = scra[slot, aux_idx.index(avgp_idx)] + p_inc
+            out_ref[avgp_idx] = (
+                scra[slot, aux_idx.index(avgp_idx)].astype(cdtype)
+                + p_inc).astype(dtype)
             for j, u in zip(avgu_idx, (ux, uy, uz)):
-                out_ref[j] = scra[slot, aux_idx.index(j)] + u
+                out_ref[j] = (scra[slot, aux_idx.index(j)].astype(cdtype)
+                              + u).astype(dtype)
 
     if ring_mode:
         call = pl.pallas_call(
@@ -686,13 +761,17 @@ def make_pallas_iterate(model: Model, shape, dtype=jnp.float32,
         zones = flagbuf >> zshift
         zonalbuf = [fusion.zone_plane(ztab, c, zone_max, zones)
                     for c in range(len(zonal_names))]
-        synthbuf = [scrf[slot, j] for j in synth_idx] if is_cumulant \
-            else None
+        synthbuf = [scrf[slot, j].astype(cdtype) for j in synth_idx] \
+            if is_cumulant else None
         if is_cumulant:
-            acc_p = scrf[slot, avgp_idx, K:K + bzK]
-            acc_u = [scrf[slot, j, K:K + bzK] for j in avgu_idx]
+            # widen ONCE, accumulate all K steps in f32, narrow on the
+            # output write (the precision.unsafe_accum contract)
+            acc_p = scrf[slot, avgp_idx, K:K + bzK].astype(cdtype)
+            acc_u = [scrf[slot, j, K:K + bzK].astype(cdtype)
+                     for j in avgu_idx]
 
-        cur = [scrf[slot, k] for k in range(q)]   # rows [0, H)
+        # rows [0, H); widened to the compute dtype for the step chain
+        cur = [scrf[slot, k].astype(cdtype) for k in range(q)]
         for j in range(K):
             lo = j + 1                       # output window in buffer rows
             n_j = bzK + 2 * (K - 1 - j)
@@ -725,13 +804,13 @@ def make_pallas_iterate(model: Model, shape, dtype=jnp.float32,
                 acc_u = [au + u[c0:c0 + bzK] for au, u in zip(acc_u, us)]
 
         for k in range(q):
-            out_ref[k] = cur[k]
+            out_ref[k] = cur[k].astype(dtype)
         if is_cumulant:
             for j in synth_idx:
                 out_ref[j] = scrf[slot, j, K:K + bzK]
-            out_ref[avgp_idx] = acc_p
+            out_ref[avgp_idx] = acc_p.astype(dtype)
             for j, au in zip(avgu_idx, acc_u):
-                out_ref[j] = au
+                out_ref[j] = au.astype(dtype)
 
     if K >= 2:
         call_f = pl.pallas_call(
@@ -761,14 +840,16 @@ def make_pallas_iterate(model: Model, shape, dtype=jnp.float32,
                      niter: int) -> LatticeState:
         flags_i32 = state.flags.astype(jnp.int32)
         zones = flags_i32 >> zshift
-        zonal = jnp.stack([params.zone_table[j].astype(dtype)[zones]
+        # zonal planes, settings and the SMEM zone table ride in the
+        # COMPUTE dtype: only the field stack pays the storage narrowing
+        zonal = jnp.stack([params.zone_table[j].astype(cdtype)[zones]
                            for j in zonal_si])
-        sett = params.settings.astype(dtype)
-        fields = state.fields
+        sett = params.settings.astype(cdtype)
+        fields = state.fields.astype(dtype)
 
         if K >= 2:
             ztab = jnp.concatenate(
-                [params.zone_table[j].astype(dtype) for j in zonal_si])
+                [params.zone_table[j].astype(cdtype) for j in zonal_si])
 
             def body_f(fields, _):
                 return call_f(sett, ztab, fields, flags_i32), None

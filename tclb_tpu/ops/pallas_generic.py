@@ -60,6 +60,22 @@ _VMEM_SCRATCH_BUDGET = 4 * 1024 * 1024
 _HALO = 8   # DMA halo block height: one (8, 128) f32 tile per side
 HALO = _HALO  # public: max per-action reach a caller can plan against
 
+# storage dtypes the generic engines can keep in HBM.  Compute is ALWAYS
+# f32: field planes are widened right after the VMEM read and narrowed
+# on the output write, and the aux stack (flags + zonal planes) stays
+# f32 outright — bf16's 8 mantissa bits cannot represent uint16 flag
+# values exactly.  At f32 storage the casts are traced no-ops, so the
+# bit-parity contract with the XLA path is untouched; bf16 runs are
+# validated by the error-vs-f32 harness (tclb_tpu/precision.py), not by
+# bit-parity.  analysis/precision.py keys its unsafe-accumulation scan
+# on this marker.
+STORAGE_DTYPES = (jnp.float32, jnp.bfloat16)
+_COMPUTE_DTYPE = jnp.float32
+
+
+def _storage_ok(dtype) -> bool:
+    return jnp.dtype(dtype) in {jnp.dtype(d) for d in STORAGE_DTYPES}
+
 
 # --------------------------------------------------------------------------- #
 # Registry-derived stage plan
@@ -126,7 +142,8 @@ _DEFAULT_BY_CAP = 32
 
 
 def _band_rows(model: Model, ny: int, nx: int,
-               by_cap: Optional[int] = None) -> Optional[int]:
+               by_cap: Optional[int] = None,
+               itemsize: int = 4) -> Optional[int]:
     """Largest multiple-of-8 band height dividing ny whose scratch
     (state + aux stacks, band + two 8-row halo blocks) fits the budget.
 
@@ -143,7 +160,9 @@ def _band_rows(model: Model, ny: int, nx: int,
     # fallback ladder.  Costs at most one `by` notch on zonal-heavy
     # models vs budgeting the plain flavor only.
     n_aux = 1 + 2 * len(model.zonal_settings)
-    per_row = (model.n_storage + n_aux) * nx * 4
+    # field planes scale with the storage itemsize; the aux stack is
+    # always f32 (flags must survive the float round trip exactly)
+    per_row = (model.n_storage * itemsize + n_aux * 4) * nx
     cap = _DEFAULT_BY_CAP if by_cap is None else by_cap
     best = None
     for by in range(8, min(ny, cap) + 1, 8):
@@ -156,19 +175,21 @@ def _band_rows(model: Model, ny: int, nx: int,
 
 
 def _pad_rows(model: Model, ny: int, nx: int, mirror: int,
-              by_cap: Optional[int] = None) -> Optional[int]:
+              by_cap: Optional[int] = None,
+              itemsize: int = 4) -> Optional[int]:
     """Ghost-row padding lifting ny % 8, generalized to mirror width
     ``mirror`` (= the plan's total reach): the first/last ``mirror`` ghost
     rows replicate the physical edge rows so the kernel's periodic wrap
     over the padded height reproduces the exact periodic pull of the
     physical height (same scheme as ops/pallas_d2q9._pad_rows, reach
     parameterized).  Returns pad rows (0 if aligned), None if impossible."""
-    if ny % 8 == 0 and _band_rows(model, ny, nx, by_cap) is not None:
+    if ny % 8 == 0 and _band_rows(model, ny, nx, by_cap,
+                                  itemsize) is not None:
         return 0
     lo = ny + 2 * mirror
     best, best_score = None, None
     for ny_pad in range(((lo + 7) // 8) * 8, 2 * ny + 64, 8):
-        by = _band_rows(model, ny_pad, nx, by_cap)
+        by = _band_rows(model, ny_pad, nx, by_cap, itemsize)
         if by is None:
             continue
         score = ny_pad * (1.0 + 2.0 * _HALO / by)
@@ -477,7 +498,7 @@ def supports(model: Model, shape, dtype, probe: bool = True) -> bool:
     are caught later by the Lattice's compile probe."""
     if model.ndim == 3:
         return supports_3d(model, shape, dtype, probe=probe)
-    if model.ndim != 2 or len(shape) != 2 or dtype != jnp.float32:
+    if model.ndim != 2 or len(shape) != 2 or not _storage_ok(dtype):
         return False
     if "Iteration" not in model.actions:
         return False
@@ -489,15 +510,16 @@ def supports(model: Model, shape, dtype, probe: bool = True) -> bool:
     if reach > _HALO:
         return False
     ny, nx = (int(v) for v in shape)
+    itemsize = jnp.dtype(dtype).itemsize
     if ny < 8:
         return False
     if jax.default_backend() == "tpu" and nx % 128:
         return False
-    if _pad_rows(model, ny, nx, max(reach, 1)) is None:
+    if _pad_rows(model, ny, nx, max(reach, 1), itemsize=itemsize) is None:
         return False
     if not probe:
         return True
-    key = (model.name, nx)
+    key = (model.name, nx, itemsize)
     if key not in _probe_cache:
         try:
             iterate = make_pallas_iterate(model, (8 if ny % 8 else min(ny, 64),
@@ -550,6 +572,10 @@ def make_pallas_iterate(model: Model, shape, dtype=jnp.float32,
                                       fuse=fuse, by_cap=by_cap)
     if not supports(model, shape, dtype, probe=False):
         raise ValueError(f"pallas_generic unsupported: {model.name} {shape}")
+    cdtype = _COMPUTE_DTYPE
+    itemsize = jnp.dtype(dtype).itemsize
+    if ext_halo and jnp.dtype(dtype) != jnp.dtype(cdtype):
+        raise ValueError("ext_halo (sharded) blocks are f32-only")
     plan, reach = action_plan(model, "Iteration", fuse=fuse)
     if reach > _HALO:
         raise ValueError(f"fuse={fuse} needs reach {reach} > halo {_HALO}")
@@ -560,11 +586,11 @@ def make_pallas_iterate(model: Model, shape, dtype=jnp.float32,
             raise ValueError("ext_halo blocks need ny % 8 == 0")
         pad = 0
     else:
-        pad = _pad_rows(model, ny_phys, nx, mirror, by_cap)
+        pad = _pad_rows(model, ny_phys, nx, mirror, by_cap, itemsize)
         if pad is None:
             raise ValueError(f"no valid band height for {shape}")
     ny = ny_phys + pad
-    by = _band_rows(model, ny, nx, by_cap)
+    by = _band_rows(model, ny, nx, by_cap, itemsize)
     if interpret is None:
         interpret = jax.default_backend() != "tpu"
 
@@ -682,7 +708,10 @@ def make_pallas_iterate(model: Model, shape, dtype=jnp.float32,
         # working stack: one (by+16, nx) array per plane; band row 0 is
         # buffer row _HALO.  Stages update their stored planes in place
         # (functionally — row-concat), later stages read the updates.
-        work = [buff[slot, k] for k in range(n_storage)]
+        # Planes are widened to the compute dtype at the read (a traced
+        # no-op at f32 storage) and narrowed on the output write — the
+        # whole fused action accumulates in f32.
+        work = [buff[slot, k].astype(cdtype) for k in range(n_storage)]
         flags_full = bufa[slot, 0].astype(jnp.int32)
         if ztab is not None:
             zones_full = flags_full >> zshift
@@ -699,12 +728,12 @@ def make_pallas_iterate(model: Model, shape, dtype=jnp.float32,
 
         work, g_acc, g_last = run_action_plan(
             model, plan, work, flags_full, zonal_full, dt_full, sett,
-            it_ref[0], nt_present, _HALO, nx, dtype,
+            it_ref[0], nt_present, _HALO, nx, cdtype,
             n_per_rep=len(model.actions["Iteration"]),
             collect_globals=g_ref is not None, full_band=full_band)
 
         for k in range(n_storage):
-            out_ref[k] = work[k][_HALO:_HALO + by, :]
+            out_ref[k] = work[k][_HALO:_HALO + by, :].astype(dtype)
 
         if g_ref is not None:
             split = with_globals == "split"
@@ -712,13 +741,13 @@ def make_pallas_iterate(model: Model, shape, dtype=jnp.float32,
             @pl.when(i == 0)
             def _():
                 g_ref[...] = jnp.zeros((2, 8, 128) if split else (8, 128),
-                                       dtype)
+                                       cdtype)
             if pad:
                 # ghost rows must not contribute (mirror rows would
                 # double-count, wall rows are unphysical)
                 rows = jax.lax.broadcasted_iota(jnp.int32, (by, nx), 0) \
                     + i * jnp.int32(by)
-                gmask = (rows < jnp.int32(ny_phys)).astype(dtype)
+                gmask = (rows < jnp.int32(ny_phys)).astype(cdtype)
             for blk, acc in enumerate((g_acc, g_last) if split
                                       else (g_acc,)):
                 for gi, g in enumerate(model.globals_):
@@ -752,7 +781,7 @@ def make_pallas_iterate(model: Model, shape, dtype=jnp.float32,
                                       if with_globals == "split"
                                       else (lambda i: (0, 0)),
                                       memory_space=pltpu.VMEM)]
-            out_shape = [out_shape, jax.ShapeDtypeStruct(gshape, dtype)]
+            out_shape = [out_shape, jax.ShapeDtypeStruct(gshape, cdtype)]
         import os
         vmem_mb = int(os.environ.get("TCLB_VMEM_LIMIT_MB", "0"))
         return pl.pallas_call(
@@ -770,7 +799,7 @@ def make_pallas_iterate(model: Model, shape, dtype=jnp.float32,
             out_shape=out_shape,
             scratch_shapes=[
                 pltpu.VMEM((2, n_storage, by + 2 * _HALO, nx), dtype),
-                pltpu.VMEM((2, n_aux_k, by + 2 * _HALO, nx), dtype),
+                pltpu.VMEM((2, n_aux_k, by + 2 * _HALO, nx), cdtype),
                 pltpu.SemaphoreType.DMA((2, 6)),
             ],
             compiler_params=_CompilerParams(
@@ -808,7 +837,7 @@ def make_pallas_iterate(model: Model, shape, dtype=jnp.float32,
     def _iterate_jit(state: LatticeState, params: SimParams, niter: int
                      ) -> LatticeState:
         flags_i32 = state.flags.astype(jnp.int32)
-        fields = state.fields
+        fields = state.fields.astype(dtype)
         if pad:
             # ghost layout: [mirror rows 0..m-1, walls, mirror ny-m..ny-1]
             mid = pad - 2 * mirror
@@ -823,20 +852,20 @@ def make_pallas_iterate(model: Model, shape, dtype=jnp.float32,
             fields = jnp.concatenate([fields, fields[:, init_src, :]],
                                      axis=1)
         zones = flags_i32 >> zshift
-        sett = params.settings.astype(dtype)
+        sett = params.settings.astype(cdtype)
         has_series = params.time_series is not None
 
         # loop-invariant pieces (XLA hoists them out of the step scan):
         # the base zonal planes and the affected-zone masks.  Per step
         # only scalar masked selects remain — a zone-table re-gather
         # inside the scan is ~25 ms/step at 1024^2 (unhoistable gather)
-        flags_f = flags_i32.astype(dtype)
-        base_planes = [params.zone_table[k].astype(dtype)[zones]
+        flags_f = flags_i32.astype(cdtype)
+        base_planes = [params.zone_table[k].astype(cdtype)[zones]
                        for k in zonal_si]
 
         def aux_of(it):
             return assemble_aux(params, zones, flags_f, base_planes,
-                                zonal_si, it, dtype, with_dt=has_series)
+                                zonal_si, it, cdtype, with_dt=has_series)
 
         def refresh(fields):
             if not pad:
@@ -865,7 +894,7 @@ def make_pallas_iterate(model: Model, shape, dtype=jnp.float32,
                 # the zone table rides in SMEM and the kernel rebuilds
                 # the (iteration-invariant) zonal planes itself
                 ztab = jnp.concatenate(
-                    [params.zone_table[k].astype(dtype) for k in zonal_si])
+                    [params.zone_table[k].astype(cdtype) for k in zonal_si])
                 aux = flags_f[None]
 
                 def invoke(c, it, fields):
@@ -946,7 +975,7 @@ def supports_resident(model: Model, shape, dtype) -> bool:
     registry model — the deep temporal fusion the band kernels cannot do
     (their VMEM holds only a band; the reference has no analogue, its GPU
     has no software-managed on-chip tier)."""
-    if model.ndim != 2 or len(shape) != 2 or dtype != jnp.float32:
+    if model.ndim != 2 or len(shape) != 2 or not _storage_ok(dtype):
         return False
     if not supports(model, shape, dtype, probe=False):
         return False
@@ -955,7 +984,11 @@ def supports_resident(model: Model, shape, dtype) -> bool:
         return False   # residency keeps the exact periodic wrap: no
         #                ghost-row machinery, so the shape must be aligned
     n_aux = 1 + len(model.zonal_settings)
-    if (2 * model.n_storage + n_aux) * ny * nx * 4 > _RESIDENT_BUDGET:
+    itemsize = jnp.dtype(dtype).itemsize
+    # ping-pong field stacks narrow with the storage dtype; the aux
+    # planes stay f32 (flags + zonal settings)
+    if (2 * model.n_storage * itemsize + n_aux * 4) * ny * nx \
+            > _RESIDENT_BUDGET:
         return False
     plan, reach = action_plan(model, "Iteration", fuse=1)
     if reach > _HALO:
@@ -980,6 +1013,7 @@ def make_resident_iterate(model: Model, shape, dtype=jnp.float32,
     if not supports_resident(model, shape, dtype):
         raise ValueError(f"generic resident unsupported: {model.name} "
                          f"{shape}")
+    cdtype = _COMPUTE_DTYPE
     ny, nx = (int(s) for s in shape)
     if interpret is None:
         interpret = jax.default_backend() != "tpu"
@@ -1024,7 +1058,7 @@ def make_resident_iterate(model: Model, shape, dtype=jnp.float32,
         def one_step(src, dst):
             for c0 in range(0, ny, chunk):
                 c1 = c0 + chunk
-                work = [_circ(src, k, c0 - _HALO, c1 + _HALO)
+                work = [_circ(src, k, c0 - _HALO, c1 + _HALO).astype(cdtype)
                         for k in range(ns)]
                 fl = _circ(aux_ref, 0, c0 - _HALO, c1 + _HALO).astype(
                     jnp.int32)
@@ -1032,10 +1066,11 @@ def make_resident_iterate(model: Model, shape, dtype=jnp.float32,
                        for j, nm in enumerate(zonal_names)}
                 work, _, _ = run_action_plan(
                     model, plan1, work, fl, zon, {}, sett,
-                    it_ref[0] + t * adv, nt_present, _HALO, nx, dtype,
+                    it_ref[0] + t * adv, nt_present, _HALO, nx, cdtype,
                     n_per_rep=n_per_rep, full_band=True)
                 for k in range(ns):
-                    dst[k, c0:c1, :] = work[k][_HALO:_HALO + chunk, :]
+                    dst[k, c0:c1, :] = \
+                        work[k][_HALO:_HALO + chunk, :].astype(dtype)
 
         # ping-pong scratch <-> out (saves a third whole-lattice stack);
         # an EVEN grid length lands the final step in out_ref
@@ -1082,13 +1117,13 @@ def make_resident_iterate(model: Model, shape, dtype=jnp.float32,
                       ) -> LatticeState:
         flags_i32 = state.flags.astype(jnp.int32)
         zones = flags_i32 >> zshift
-        sett = params.settings.astype(dtype)
+        sett = params.settings.astype(cdtype)
         aux = jnp.stack(
-            [flags_i32.astype(dtype)]
-            + [params.zone_table[j].astype(dtype)[zones]
+            [flags_i32.astype(cdtype)]
+            + [params.zone_table[j].astype(cdtype)[zones]
                for j in zonal_si])
         fields = _call_for(niter)(sett, state.iteration[None],
-                                  state.fields, aux)
+                                  state.fields.astype(dtype), aux)
         return LatticeState(fields=fields, flags=state.flags,
                             globals_=jnp.zeros_like(state.globals_),
                             iteration=state.iteration + adv * niter)
@@ -1130,14 +1165,16 @@ _FUSED3D_BUDGET = 28 * 1024 * 1024
 def _slab_depth_gen(model: Model, nz: int, ny: int, nx: int,
                     reach: int, cap: Optional[int] = None,
                     n_aux: Optional[int] = None,
-                    budget: Optional[int] = None) -> Optional[int]:
+                    budget: Optional[int] = None,
+                    itemsize: int = 4) -> Optional[int]:
     """Largest slab depth BZ dividing nz whose double-slotted scratch
     (state + aux, band + ``reach`` halo slabs each side) fits the budget.
     Unlike the 2D rows, z is NOT a tiled axis, so halos are exactly
     ``reach`` slabs — no 8-alignment games."""
     if n_aux is None:
         n_aux = 1 + 2 * len(model.zonal_settings)   # series flavor's aux
-    per_slab = (model.n_storage + n_aux) * ny * nx * 4
+    # field slabs scale with the storage itemsize; aux stays f32
+    per_slab = (model.n_storage * itemsize + n_aux * 4) * ny * nx
     if budget is None:
         budget = 12 * 1024 * 1024
     best = None
@@ -1153,7 +1190,8 @@ def _slab_depth_gen(model: Model, nz: int, ny: int, nx: int,
 
 
 def choose_fuse_3d(model: Model, shape,
-                   fmax: int = fusion.FUSE_MAX) -> int:
+                   fmax: int = fusion.FUSE_MAX,
+                   itemsize: int = 4) -> int:
     """Fusion depth for the 3D generic z-slab engine: deepest K whose
     fused plan both fits the (raised-ceiling) VMEM budget at some slab
     depth AND beats the single-step engine's modeled traffic.  3D halos
@@ -1163,7 +1201,7 @@ def choose_fuse_3d(model: Model, shape,
     _, r1 = action_plan(model, "Iteration", fuse=1)
     R1 = max(r1, 1)
     ns = model.n_storage
-    bz1 = _slab_depth_gen(model, nz, ny, nx, R1)
+    bz1 = _slab_depth_gen(model, nz, ny, nx, R1, itemsize=itemsize)
     if bz1 is None:
         return 1
     # lean aux: the non-series kernels move ns + 1 planes per slab
@@ -1174,7 +1212,7 @@ def choose_fuse_3d(model: Model, shape,
         if nz < 2 * RK:
             break
         bzK = _slab_depth_gen(model, nz, ny, nx, RK, n_aux=1,
-                              budget=_FUSED3D_BUDGET)
+                              budget=_FUSED3D_BUDGET, itemsize=itemsize)
         if bzK is None:
             continue
         c = ((ns + 1) * (bzK + 2 * RK) + ns * bzK) / (K * bzK)
@@ -1185,7 +1223,7 @@ def choose_fuse_3d(model: Model, shape,
 
 def supports_3d(model: Model, shape, dtype, probe: bool = True) -> bool:
     """3D eligibility: same registry checks as 2D, z-banded."""
-    if model.ndim != 3 or len(shape) != 3 or dtype != jnp.float32:
+    if model.ndim != 3 or len(shape) != 3 or not _storage_ok(dtype):
         return False
     if "Iteration" not in model.actions:
         return False
@@ -1196,15 +1234,17 @@ def supports_3d(model: Model, shape, dtype, probe: bool = True) -> bool:
             return False
     plan, reach = action_plan(model, "Iteration", fuse=1)
     nz, ny, nx = (int(v) for v in shape)
+    itemsize = jnp.dtype(dtype).itemsize
     if nz < 2 * max(reach, 1):
         return False
     if jax.default_backend() == "tpu" and (nx % 128 or ny % 8):
         return False  # (ny, nx) is the (sublane, lane) tile
-    if _slab_depth_gen(model, nz, ny, nx, max(reach, 1)) is None:
+    if _slab_depth_gen(model, nz, ny, nx, max(reach, 1),
+                       itemsize=itemsize) is None:
         return False
     if not probe:
         return True
-    key = (model.name, "3d", ny, nx)
+    key = (model.name, "3d", ny, nx, itemsize)
     if key not in _probe_cache:
         try:
             it = make_pallas_iterate_3d(model, (4 * max(reach, 1), ny, nx),
@@ -1246,6 +1286,8 @@ def make_pallas_iterate_3d(model: Model, shape, dtype=jnp.float32,
     if not supports_3d(model, shape, dtype, probe=False):
         raise ValueError(f"pallas_generic 3d unsupported: {model.name} "
                          f"{shape}")
+    cdtype = _COMPUTE_DTYPE
+    itemsize = jnp.dtype(dtype).itemsize
     plan, reach = action_plan(model, "Iteration", fuse=fuse)
     R = max(reach, 1)
     plan1, r1 = (plan, reach) if fuse == 1 \
@@ -1266,8 +1308,9 @@ def make_pallas_iterate_3d(model: Model, shape, dtype=jnp.float32,
     vmem_ceiling = (by_cap is not None and by_cap < 0) or fuse >= 2
     cap = None if by_cap is None else max(1, abs(by_cap) // 8)
     bz = _slab_depth_gen(model, nz, ny, nx, R, cap, n_aux=1,
-                         budget=_FUSED3D_BUDGET) if fuse >= 2 \
-        else _slab_depth_gen(model, nz, ny, nx, R, cap)
+                         budget=_FUSED3D_BUDGET, itemsize=itemsize) \
+        if fuse >= 2 \
+        else _slab_depth_gen(model, nz, ny, nx, R, cap, itemsize=itemsize)
     if bz is None:
         raise ValueError(f"no slab depth fits fuse={fuse} for "
                          f"{model.name} {shape}")
@@ -1361,7 +1404,10 @@ def make_pallas_iterate_3d(model: Model, shape, dtype=jnp.float32,
                     sl = pltpu.roll(sl, dx % nx, axis=2)
                 return sl
 
-            work = [buff[slot, k] for k in range(ns)]
+            # widen to the compute dtype at the read (traced no-op at f32
+            # storage); the whole fused action accumulates in f32 and the
+            # output write narrows back to the storage dtype
+            work = [buff[slot, k].astype(cdtype) for k in range(ns)]
             flags_full = bufa[slot, 0].astype(jnp.int32)
             if ztab is not None:
                 zones_full = flags_full >> zshift
@@ -1400,7 +1446,7 @@ def make_pallas_iterate_3d(model: Model, shape, dtype=jnp.float32,
                     model, planes, loader,
                     flags_full[lo:lo + n_i],
                     {nm: p[lo:lo + n_i] for nm, p in zonal_full.items()},
-                    sett, dtype, it_ref[0] + rep, nt_present,
+                    sett, cdtype, it_ref[0] + rep, nt_present,
                     dt_planes={nm: p[lo:lo + n_i]
                                for nm, p in dt_full.items()},
                     compute_globals=g_ref is not None)
@@ -1431,12 +1477,12 @@ def make_pallas_iterate_3d(model: Model, shape, dtype=jnp.float32,
                         [w[:lo], new, w[lo + n_i:]], axis=0)
 
             for k in range(ns):
-                out_ref[k] = work[k][R:R + bz]
+                out_ref[k] = work[k][R:R + bz].astype(dtype)
 
             if g_ref is not None:
                 @pl.when(i == 0)
                 def _():
-                    g_ref[...] = jnp.zeros((8, 128), dtype)
+                    g_ref[...] = jnp.zeros((8, 128), cdtype)
                 for gi, g in enumerate(model.globals_):
                     if g.name not in g_acc:
                         continue
@@ -1458,7 +1504,7 @@ def make_pallas_iterate_3d(model: Model, shape, dtype=jnp.float32,
                          pl.BlockSpec((8, 128), lambda i: (0, 0),
                                       memory_space=pltpu.VMEM)]
             out_shape = [out_shape,
-                         jax.ShapeDtypeStruct((8, 128), dtype)]
+                         jax.ShapeDtypeStruct((8, 128), cdtype)]
         return pl.pallas_call(
             kern,
             grid=(nz // bz,),
@@ -1474,7 +1520,7 @@ def make_pallas_iterate_3d(model: Model, shape, dtype=jnp.float32,
             out_shape=out_shape,
             scratch_shapes=[
                 pltpu.VMEM((2, ns, bz + 2 * R_k, ny, nx), dtype),
-                pltpu.VMEM((2, n_aux_k, bz + 2 * R_k, ny, nx), dtype),
+                pltpu.VMEM((2, n_aux_k, bz + 2 * R_k, ny, nx), cdtype),
                 pltpu.SemaphoreType.DMA((2, 2 * (1 + 2 * R_k))),
             ],
             compiler_params=_CompilerParams(
@@ -1499,17 +1545,17 @@ def make_pallas_iterate_3d(model: Model, shape, dtype=jnp.float32,
     def _iterate_jit(state: LatticeState, params: SimParams, niter: int
                      ) -> LatticeState:
         flags_i32 = state.flags.astype(jnp.int32)
-        fields = state.fields
+        fields = state.fields.astype(dtype)
         zones = flags_i32 >> zshift
-        sett = params.settings.astype(dtype)
+        sett = params.settings.astype(cdtype)
         has_series = params.time_series is not None
-        flags_f = flags_i32.astype(dtype)
-        base_planes = [params.zone_table[k].astype(dtype)[zones]
+        flags_f = flags_i32.astype(cdtype)
+        base_planes = [params.zone_table[k].astype(cdtype)[zones]
                        for k in zonal_si]
 
         def aux_of(it):
             return assemble_aux(params, zones, flags_f, base_planes,
-                                zonal_si, it, dtype, with_dt=has_series)
+                                zonal_si, it, cdtype, with_dt=has_series)
 
         final_g = call_sg if has_series else call_g
         if niter <= 0:
@@ -1533,7 +1579,7 @@ def make_pallas_iterate_3d(model: Model, shape, dtype=jnp.float32,
             # how many zonal settings the model declares
             if lean_aux:
                 ztab = jnp.concatenate(
-                    [params.zone_table[k].astype(dtype)
+                    [params.zone_table[k].astype(cdtype)
                      for k in zonal_si])
                 aux = flags_f[None]
 

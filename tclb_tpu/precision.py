@@ -1,0 +1,217 @@
+"""Error-vs-reference harness for the mixed-precision storage ladder.
+
+The bf16 storage knob (``Lattice(storage_dtype=jnp.bfloat16)``) trades
+mantissa for HBM bytes, so its contract is NOT bit-parity — it is a
+bounded drift from the f32 reference.  This module is that contract
+made executable: run the same case twice (f32 storage vs narrowed
+storage, identical flags/settings/engine dispatch rules), measure
+relative L2/Linf error of the full distribution-field stack at fixed
+iteration checkpoints, and compare against :data:`ERROR_BOUNDS`.
+
+Reference TCLB treats precision as a compile-time build flavor
+(``CALC_DOUBLE_PRECISION``); a per-run knob needs a per-run safety
+net instead of a build matrix — this harness runs in CI on CPU
+(``python -m tclb_tpu.precision``) and tests/test_precision.py asserts
+the bounds, so a kernel change that silently degrades the bf16 path
+(e.g. an accumulation slipping to storage dtype past the static
+``precision.unsafe_accum`` check) fails the build.
+
+Bounds are measured on the CPU XLA path at 500 steps (bf16 round trips
+once per step there — the *worst* case: the fused Pallas engines
+narrow once per K steps, so device error is at or below these bounds)
+with ~2x headroom over observed error.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+from typing import Any, Optional, Sequence
+
+import numpy as np
+
+# checkpoints: error growth is roughly sqrt(t) (random-walk rounding),
+# so a mid-run sample catches a superlinear blowup the endpoint alone
+# would misattribute
+DEFAULT_CHECKPOINTS = (100, 250, 500)
+
+# measured (CPU, XLA path, 64x64, 500 steps) 2026-08: cavity peaks at
+# l2 5.2e-3 / linf 1.6e-2 (iter 250, then plateaus); kuper_drop at
+# l2 1.2e-2 / linf 5.0e-2 (the drop interface is a steep phi gradient —
+# pointwise error concentrates there).  Bounds carry ~2x headroom.
+ERROR_BOUNDS = {
+    ("cavity", "bfloat16"): {"l2": 1.2e-2, "linf": 3.5e-2},
+    ("kuper_drop", "bfloat16"): {"l2": 2.5e-2, "linf": 1.0e-1},
+}
+
+CASE_NAMES = ("cavity", "kuper_drop")
+
+
+def build_case(name: str, n: int = 64):
+    """A ready-to-init :class:`Lattice` builder for one harness case.
+
+    Returns ``(model, shape, settings, flags, zonal)`` — the caller
+    constructs the Lattice so it can thread ``storage_dtype``.
+
+    * ``cavity`` — the d2q9 driven cavity/channel family the bench's
+      karman case uses: walls top/bottom, WVelocity inflow, EPressure
+      outflow, a square obstacle (boundary dispatch + MRT bulk).
+    * ``kuper_drop`` — the d2q9_kuper drop.xml physics: a liquid drop
+      (zone-1 Density) equilibrating in vapor; exercises the
+      CalcPhi gradient stencil double-stage the fused kuper kernel
+      collapses.
+    """
+    from tclb_tpu.models import get_model
+    if name == "cavity":
+        m = get_model("d2q9")
+        settings = {"nu": 0.05, "Velocity": 0.02}
+        flags = np.full((n, n), m.flag_for("MRT"), dtype=np.uint16)
+        flags[:, 0] = m.flag_for("WVelocity", "MRT")
+        flags[:, -1] = m.flag_for("EPressure", "MRT")
+        flags[0, :] = flags[-1, :] = m.flag_for("Wall")
+        q = n // 4
+        flags[q:q + q // 2, q:q + q // 2] = m.flag_for("Wall")
+        return m, (n, n), settings, flags, {}
+    if name == "kuper_drop":
+        m = get_model("d2q9_kuper")
+        settings = {"omega": 1.0, "Temperature": 0.56, "FAcc": 1.0,
+                    "Magic": 0.01, "MagicA": -0.152,
+                    "MagicF": -2.0 / 3.0,
+                    "Density": 3.2600529440452366}
+        zonal = {("Density", 1): 0.014500641645077492}
+        flags = np.full((n, n), m.flag_for("MRT"), dtype=np.uint16)
+        yy, xx = np.mgrid[0:n, 0:n]
+        drop = (yy - n / 2) ** 2 + (xx - n / 2) ** 2 < (n / 5) ** 2
+        flags[drop] = m.flag_for("MRT", zone=1)
+        return m, (n, n), settings, flags, zonal
+    raise ValueError(f"unknown precision case {name!r}; "
+                     f"have {CASE_NAMES}")
+
+
+def _run(name: str, n: int, niter: int, storage_dtype,
+         checkpoints: Sequence[int]):
+    """(field stack, velocity) as f64 numpy at each checkpoint."""
+    import jax.numpy as jnp
+    from tclb_tpu.core.lattice import Lattice
+    model, shape, settings, flags, zonal = build_case(name, n)
+    lat = Lattice(model, shape, dtype=jnp.float32, settings=settings,
+                  storage_dtype=storage_dtype)
+    for (sname, zone), val in zonal.items():
+        lat.set_setting(sname, val, zone=zone)
+    lat.set_flags(flags)
+    lat.init()
+    out, prev = {}, 0
+    for it in sorted(set(int(c) for c in checkpoints) | {int(niter)}):
+        if it > niter:
+            break
+        if it > prev:
+            lat.iterate(it - prev)
+        prev = it
+        out[it] = (np.asarray(lat.state.fields, dtype=np.float64),
+                   np.asarray(lat.get_quantity("U"), dtype=np.float64))
+    return out
+
+
+def error_norms(case: str = "cavity", niter: int = 500, n: int = 64,
+                storage_dtype: Any = "bfloat16",
+                checkpoints: Sequence[int] = DEFAULT_CHECKPOINTS) -> dict:
+    """Relative L2/Linf error of narrowed-storage vs f32-storage runs.
+
+    Both runs use the normal engine dispatch (on CPU that is the XLA
+    step — the worst-case once-per-step narrowing).  Norms are over the
+    whole distribution-field stack:
+    ``l2 = ||a - r|| / ||r||``, ``linf = max|a - r| / max|r|``.
+
+    Each row also reports the same norms over the velocity quantity
+    (``u_l2``/``u_linf``) — these are informational, not bounded.
+    Raw distributions carry an O(1) rest-equilibrium background, so
+    bf16 quantization injects ~``2**-8 * max|f|`` of absolute noise per
+    round trip; relative to a low-Mach velocity signal that amplifies
+    by ``max|f|/max|u|`` (~20-50x at Ma~0.02).  The honest signal for
+    "is this case bf16-tolerant" is the u norm: O(1)-signal workloads
+    (multiphase density, thermal) tolerate the rung; low-Mach
+    velocimetry does not (see README "The storage ladder").
+    """
+    ref = _run(case, n, niter, None, checkpoints)
+    alt = _run(case, n, niter, storage_dtype, checkpoints)
+    rows = []
+    for it in sorted(ref):
+        (r, ru), (a, au) = ref[it], alt[it]
+        d = a - r
+        du = au - ru
+        rnorm = float(np.linalg.norm(r))
+        rmax = float(np.max(np.abs(r)))
+        rows.append({
+            "iteration": it,
+            "l2": float(np.linalg.norm(d)) / max(rnorm, 1e-30),
+            "linf": float(np.max(np.abs(d))) / max(rmax, 1e-30),
+            "u_l2": float(np.linalg.norm(du))
+            / max(float(np.linalg.norm(ru)), 1e-30),
+            "u_linf": float(np.max(np.abs(du)))
+            / max(float(np.max(np.abs(ru))), 1e-30),
+        })
+    return {"case": case, "storage_dtype": str(np.dtype(storage_dtype)),
+            "shape": [n, n], "niter": int(niter), "checkpoints": rows}
+
+
+def check_bounds(report: dict,
+                 bounds: Optional[dict] = None) -> list[str]:
+    """Violation strings (empty = within contract).  Every checkpoint
+    must satisfy the case's bound — error growing past the bound
+    mid-run then drifting back would still be a broken ladder."""
+    key = (report["case"], report["storage_dtype"])
+    bound = (bounds if bounds is not None else ERROR_BOUNDS).get(key)
+    if bound is None:
+        return [f"no documented error bound for {key}"]
+    out = []
+    for row in report["checkpoints"]:
+        for norm in ("l2", "linf"):
+            if row[norm] > bound[norm]:
+                out.append(
+                    f"{report['case']} @ iter {row['iteration']}: "
+                    f"{norm}={row[norm]:.3e} exceeds bound "
+                    f"{bound[norm]:.1e}")
+    return out
+
+
+def main(argv: Optional[list] = None) -> int:
+    p = argparse.ArgumentParser(
+        prog="python -m tclb_tpu.precision",
+        description="bf16 storage-ladder error harness vs f32 reference")
+    p.add_argument("--case", choices=CASE_NAMES + ("all",), default="all")
+    p.add_argument("--niter", type=int, default=500)
+    p.add_argument("--n", type=int, default=64,
+                   help="lattice edge length (default 64)")
+    p.add_argument("--storage-dtype", default="bfloat16")
+    p.add_argument("--format", choices=("text", "json"), default="text")
+    args = p.parse_args(argv)
+
+    cases = CASE_NAMES if args.case == "all" else (args.case,)
+    reports, violations = [], []
+    for case in cases:
+        rep = error_norms(case, niter=args.niter, n=args.n,
+                          storage_dtype=args.storage_dtype)
+        reports.append(rep)
+        violations += check_bounds(rep)
+    if args.format == "json":
+        print(json.dumps({"reports": reports, "violations": violations},
+                         indent=2))
+    else:
+        for rep in reports:
+            print(f"{rep['case']} ({rep['storage_dtype']} storage, "
+                  f"{rep['shape'][0]}x{rep['shape'][1]}):")
+            for row in rep["checkpoints"]:
+                print(f"  iter {row['iteration']:>5}  "
+                      f"l2 {row['l2']:.3e}  linf {row['linf']:.3e}  "
+                      f"(u: l2 {row['u_l2']:.3e}  "
+                      f"linf {row['u_linf']:.3e})")
+        for v in violations:
+            print("VIOLATION:", v)
+        if not violations:
+            print("all error bounds hold")
+    return 1 if violations else 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -97,28 +97,46 @@ class EnsemblePlan:
                  dtype: Any = jnp.float32,
                  base_settings: Optional[dict[str, float]] = None,
                  base: Optional[Lattice] = None,
-                 mode: str = "map"):
+                 mode: str = "map",
+                 storage_dtype: Any = None):
         from tclb_tpu.ops.lbm import present_types
         if base is None:
             base = Lattice(model, tuple(int(s) for s in shape), dtype=dtype,
-                           settings=base_settings)
+                           settings=base_settings,
+                           storage_dtype=storage_dtype)
             if flags is not None:
                 base.set_flags(np.asarray(flags, dtype=np.uint16))
         self.model = base.model
         self.shape = base.shape
         self.dtype = base.dtype
+        self.storage_dtype = base.storage_dtype
         self.mode = mode
         self.flags = base._flags_host()
         self.base_state = base.state
         self.base_params = base.params
         self.present = present_types(self.model, self.flags)
+        narrowed = jnp.dtype(self.storage_dtype) != jnp.dtype(self.dtype)
         self._init = make_ensemble_step(self.model, "Init", present=None)
-        self._iterate = make_ensemble_iterate(self.model,
-                                              present=self.present,
-                                              mode=mode)
+        if narrowed:
+            # Init evaluates in the compute dtype, the carry lives narrow
+            # (same round trip as Lattice._init's precision-ladder wrap).
+            raw_init, sdt = self._init, jnp.dtype(self.storage_dtype)
+
+            def _init_narrow(states, params):
+                cdt = params.settings.dtype
+                out = raw_init(
+                    states.replace(fields=states.fields.astype(cdt)), params)
+                return out.replace(fields=out.fields.astype(sdt))
+            self._init = _init_narrow
+        self._iterate = make_ensemble_iterate(
+            self.model, present=self.present, mode=mode,
+            storage_dtype=(self.storage_dtype if narrowed else None))
 
     def engine_tag(self, batch: int) -> str:
-        return f"ensemble_xla[{self.model.name},{self.mode},b={batch}]"
+        tag = f"ensemble_xla[{self.model.name},{self.mode},b={batch}"
+        if jnp.dtype(self.storage_dtype) != jnp.dtype(self.dtype):
+            tag += f",{np.dtype(self.storage_dtype).name}"
+        return tag + "]"
 
     # -- pieces the cache compiles ----------------------------------------- #
 
@@ -179,7 +197,8 @@ class EnsemblePlan:
         engine) — the scheduler's degradation target when a batched
         compile fails, and the parity reference in tests."""
         case = case if isinstance(case, Case) else Case(settings=dict(case))
-        lat = Lattice(self.model, self.shape, dtype=self.dtype)
+        lat = Lattice(self.model, self.shape, dtype=self.dtype,
+                      storage_dtype=self.storage_dtype)
         lat.set_flags(self.flags.copy())
         lat.params = case_params(self.model, self.base_params, case,
                                  self.dtype)
@@ -194,6 +213,7 @@ def run_ensemble(model: Model, cases: Sequence[Case | dict], niter: int,
                  *, shape: Optional[Sequence[int]] = None,
                  flags: Optional[np.ndarray] = None,
                  dtype: Any = jnp.float32,
+                 storage_dtype: Any = None,
                  base_settings: Optional[dict[str, float]] = None,
                  base: Optional[Lattice] = None,
                  cache=None, init: bool = True) -> list[EnsembleResult]:
@@ -207,5 +227,6 @@ def run_ensemble(model: Model, cases: Sequence[Case | dict], niter: int,
     if base is None and shape is None:
         raise ValueError("run_ensemble needs `shape` (or `base`)")
     plan = EnsemblePlan(model, shape or (), flags=flags, dtype=dtype,
-                        base_settings=base_settings, base=base)
+                        base_settings=base_settings, base=base,
+                        storage_dtype=storage_dtype)
     return plan.run(cases, niter, cache=cache, init=init)

@@ -56,6 +56,9 @@ class JobSpec:
     niter: int
     flags: Optional[np.ndarray] = None
     dtype: Any = jnp.float32
+    # opt-in narrowed storage (e.g. bf16): halves the per-case working
+    # set, so the memory-predicated batch cap roughly doubles
+    storage_dtype: Any = None
     base_settings: Optional[dict[str, float]] = None
     # a prebuilt plan (e.g. the sweep CLI's XML-derived base, whose zonal
     # base params a plain settings dict cannot express); must describe
@@ -128,7 +131,10 @@ def _bin_key(spec: JobSpec) -> tuple:
     else:
         base = tuple(sorted((spec.base_settings or {}).items()))
     return (spec.model.fingerprint, tuple(spec.shape),
-            str(jnp.dtype(spec.dtype)), flags_digest, int(spec.niter), base)
+            str(jnp.dtype(spec.dtype)),
+            str(jnp.dtype(spec.storage_dtype if spec.storage_dtype
+                          is not None else spec.dtype)),
+            flags_digest, int(spec.niter), base)
 
 
 class Scheduler:
@@ -217,14 +223,19 @@ class Scheduler:
         if plan is None:
             plan = spec.plan if spec.plan is not None else EnsemblePlan(
                 spec.model, spec.shape, flags=spec.flags, dtype=spec.dtype,
-                base_settings=spec.base_settings)
+                base_settings=spec.base_settings,
+                storage_dtype=spec.storage_dtype)
             self._plans[key] = plan
         return plan
 
     def batch_cap(self, spec: JobSpec) -> int:
+        # the carry lives in the STORAGE dtype, so bf16 storage halves
+        # the per-case working set and roughly doubles the cap
+        sdt = spec.storage_dtype if spec.storage_dtype is not None \
+            else spec.dtype
         cap = fusion.ensemble_batch_cap(
             spec.model.n_storage, tuple(spec.shape),
-            jnp.dtype(spec.dtype).itemsize)
+            jnp.dtype(sdt).itemsize)
         if self.max_batch is not None:
             cap = min(cap, int(self.max_batch))
         return max(1, cap)

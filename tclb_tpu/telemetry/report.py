@@ -116,7 +116,10 @@ def summarize(evts: list[dict]) -> dict:
                 g = engines.setdefault(eng, {
                     "chunks": 0, "iters": 0, "node_updates": 0.0,
                     "total_s": 0.0, "vs_roofline": None,
-                    "roofline_known": e.get("roofline_known")})
+                    "roofline_known": e.get("roofline_known"),
+                    "storage_dtype": e.get("storage_dtype")})
+                if e.get("storage_dtype") is not None:
+                    g["storage_dtype"] = e["storage_dtype"]
                 g["chunks"] += 1
                 g["iters"] += int(e.get("iters", 0))
                 g["node_updates"] += (float(e.get("nodes", 0.0))
@@ -269,12 +272,14 @@ def format_text(summary: dict) -> str:
     lines = []
     if summary["engines"]:
         lines.append("per-engine iterate summary")
-        lines.append(f"  {'engine':<44} {'chunks':>6} {'iters':>9} "
-                     f"{'time_s':>10} {'MLUPS':>10} {'vs_roofline':>12}")
+        lines.append(f"  {'engine':<44} {'dtype':>9} {'chunks':>6} "
+                     f"{'iters':>9} {'time_s':>10} {'MLUPS':>10} "
+                     f"{'vs_roofline':>12}")
         for eng, g in sorted(summary["engines"].items()):
             star = "" if g.get("roofline_known", True) else "~"
             lines.append(
-                f"  {eng:<44} {g['chunks']:>6} {g['iters']:>9} "
+                f"  {eng:<44} {_fmt(g.get('storage_dtype')):>9} "
+                f"{g['chunks']:>6} {g['iters']:>9} "
                 f"{_fmt(g['total_s'], 3):>10} {_fmt(g['mlups'], 1):>10} "
                 f"{star + _fmt(g['vs_roofline'], 4):>12}")
         if any(not g.get("roofline_known", True)

@@ -344,3 +344,52 @@ def test_fingerprint_stable_across_rebuilds():
     assert a is not b
     assert a.fingerprint == b.fingerprint
     assert a.fingerprint != get_model("d2q9").fingerprint
+
+
+_BF16_KERNEL_HEADER = (
+    "import jax.numpy as jnp\n"
+    "STORAGE_DTYPES = (jnp.float32, jnp.bfloat16)\n")
+
+
+def test_precision_fires_on_unsafe_bf16_accumulation(tmp_path):
+    """A kernel in a bf16-storage engine that reduces or accumulates
+    raw field loads (no .astype widening) is a silent-precision-loss
+    bug — the ladder's contract is narrow storage, f32 arithmetic."""
+    from tclb_tpu.analysis.precision import scan_unsafe_accum
+    p = tmp_path / "pallas_bad.py"
+    p.write_text(_BF16_KERNEL_HEADER +
+                 "def kernel(scrf, out_ref):\n"
+                 "    work = [scrf[0, k] for k in range(9)]\n"
+                 "    rho = jnp.sum(jnp.stack(work), 0)\n"
+                 "    acc = work[0]\n"
+                 "    acc = acc + work[1]\n"
+                 "    out_ref[0] = acc + rho\n")
+    fs = scan_unsafe_accum(paths=[str(p)])
+    assert [f.check for f in fs] == ["precision.unsafe_accum"] * 2
+    assert all(f.severity == "error" for f in fs)
+
+
+def test_precision_accepts_widened_accumulation(tmp_path):
+    from tclb_tpu.analysis.precision import scan_unsafe_accum
+    p = tmp_path / "pallas_good.py"
+    p.write_text(_BF16_KERNEL_HEADER +
+                 "def kernel(scrf, out_ref):\n"
+                 "    work = [scrf[0, k].astype(jnp.float32)"
+                 " for k in range(9)]\n"
+                 "    rho = jnp.sum(jnp.stack(work), 0)\n"
+                 "    acc = work[0]\n"
+                 "    acc = acc + work[1]\n"
+                 "    out_ref[0] = (acc + rho).astype(out_ref.dtype)\n")
+    assert scan_unsafe_accum(paths=[str(p)]) == []
+
+
+def test_precision_skips_f32_only_engines(tmp_path):
+    """Engines that never take narrow storage (no bf16 in
+    STORAGE_DTYPES) may accumulate in their native dtype freely."""
+    from tclb_tpu.analysis.precision import scan_unsafe_accum
+    p = tmp_path / "pallas_f32.py"
+    p.write_text("import jax.numpy as jnp\n"
+                 "def kernel(scrf, out_ref):\n"
+                 "    rho = jnp.sum(scrf[0], 0)\n"
+                 "    out_ref[0] = rho\n")
+    assert scan_unsafe_accum(paths=[str(p)]) == []

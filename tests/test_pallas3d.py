@@ -250,3 +250,89 @@ def test_choose_fuse_planner():
     # fused traffic must beat the single-step engine's model
     assert pallas_d3q._fused_cost(m, bz, K) \
         < pallas_d3q._base_cost(m, 48, 48, 256)
+
+
+@pytest.mark.parametrize("name", ["d3q19", "d3q27_cumulant"])
+def test_fused_bit_exact_K8(name):
+    """fuse=8 (the raised FUSE_MAX) stays bit-identical to the XLA step.
+    Needs nz >= 2*K halo slabs, so this runs on a taller domain than
+    FUSED_SHAPE."""
+    shape = (16, 8, 64)
+    m = get_model(name)
+    sett = {"nu": 0.05, "GravitationX": 1e-5}
+    if name == "d3q27_cumulant":
+        sett = {"nu": 0.05, "ForceX": 1e-5}
+    lat = Lattice(m, shape, dtype=jnp.float32, settings=sett)
+    flags = np.full(shape, m.flag_for("MRT"), dtype=np.uint16)
+    flags[0] = flags[-1] = m.flag_for("Wall")
+    flags[:, 0, :] = m.flag_for("Wall")
+    lat.set_flags(flags)
+    lat.init()
+    it = pallas_d3q.make_pallas_iterate(
+        m, shape, present=pallas_d3q.present_types(m, flags), fuse=8)
+    niter = 9   # one fused chunk + one remainder step
+    s_p = it(jax.tree.map(jnp.copy, lat.state), lat.params, niter)
+    s_x = lat._iterate(lat.state, lat.params, niter)
+    np.testing.assert_array_equal(np.asarray(s_p.fields),
+                                  np.asarray(s_x.fields))
+    assert int(s_p.iteration) == int(s_x.iteration)
+
+
+def test_fused_cfg_engages_at_bench_shape():
+    """The planner selects K>=2 for BOTH tuned 3D families at the bench
+    shape 48x48x256 — the d3q27(_cumulant) non-engagement this PR fixes
+    (the VMEM predicate priced the cumulant's collision temporaries as
+    if every plane were resident per-q at full K depth)."""
+    shape = (48, 48, 256)
+    for name in ("d3q19", "d3q27_cumulant"):
+        cfg, why = pallas_d3q.fused_cfg_explain(get_model(name), shape)
+        assert cfg is not None and why is None, (name, why)
+        assert cfg[1] >= 2, (name, cfg)
+    # bf16 storage halves the field-plane VMEM term, so the planner may
+    # only go deeper, never shallower
+    for name in ("d3q19", "d3q27_cumulant"):
+        cfg32, _ = pallas_d3q.fused_cfg_explain(get_model(name), shape)
+        cfg16, _ = pallas_d3q.fused_cfg_explain(get_model(name), shape,
+                                                itemsize=2)
+        assert cfg16 is not None
+        assert cfg16[0] * cfg16[1] >= cfg32[0] * cfg32[1]
+
+
+def test_fused_cfg_explain_reasons():
+    """Rejections carry the failing predicate term, so single-step
+    demotion can never recur silently (the d3q27 bench-tag regression
+    this PR closes)."""
+    cfg, why = pallas_d3q.fused_cfg_explain(get_model("d3q19"),
+                                            (2, 8, 128))
+    assert cfg is None and why.startswith("vmem")
+    # plain d3q27 (BGK) is outside the tuned family
+    cfg, why = pallas_d3q.fused_cfg_explain(get_model("d3q27"),
+                                            (48, 48, 256))
+    assert cfg is None and why.startswith("unsupported")
+
+
+def test_fused_rejected_event(monkeypatch, tmp_path):
+    """When dispatch demotes the tuned 3D engine to fuse=1, the trace
+    carries a fused_rejected event naming the failing predicate term."""
+    import json
+    from tclb_tpu import telemetry
+    monkeypatch.setenv("TCLB_FASTPATH", "force")
+    m = get_model("d3q19")
+    lat = Lattice(m, (2, 8, 64), dtype=jnp.float32,
+                  settings={"nu": 0.05, "GravitationX": 1e-5})
+    flags = np.full((2, 8, 64), m.flag_for("MRT"), dtype=np.uint16)
+    lat.set_flags(flags)
+    lat.init()
+    trace = tmp_path / "t.jsonl"
+    telemetry.enable(str(trace))
+    try:
+        lat.iterate(1)
+    finally:
+        telemetry.disable()
+    evts = [json.loads(x) for x in trace.read_text().splitlines()
+            if x.strip()]
+    rej = [e for e in evts if e.get("kind") == "fused_rejected"]
+    assert rej, "demoted fused engine must emit fused_rejected"
+    assert rej[0]["engine"] == "pallas_d3q"
+    assert rej[0]["model"] == "d3q19"
+    assert rej[0]["reason"].startswith("vmem")

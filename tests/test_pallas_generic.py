@@ -410,3 +410,169 @@ def test_sharded_generic_matches_single(monkeypatch):
     np.testing.assert_allclose(np.asarray(lat.state.fields),
                                np.asarray(ref.state.fields),
                                rtol=1e-5, atol=1e-6)
+
+
+# --------------------------------------------------------------------- #
+# deep temporal fusion (tier-1): K in {4, 8} bit-exact vs XLA
+# --------------------------------------------------------------------- #
+
+
+@pytest.mark.parametrize("name,K", [
+    ("d2q9_heat", 4), ("d2q9_heat", 8),
+    # kuper: reach 2/step (the CalcPhi gradient stencil), so fuse=4
+    # saturates the 8-row band halo — this IS the fused Run+CalcPhi
+    # deep-fusion case (phi rebuilt in-VMEM, no second HBM pass)
+    ("d2q9_kuper", 4),
+])
+def test_fused_deep_bit_exact(name, K):
+    """fuse=K band output is BIT-IDENTICAL (assert_array_equal, not
+    allclose) to the same engine unfused: the progressive-extension
+    windows replay each step's arithmetic exactly, so any reassociation
+    or halo slip at the deeper depths fails at == level.  (The engine's
+    parity vs the XLA step is the existing allclose contract `_parity`
+    pins — the zonal where-chain reassociates by ~1 ulp.)"""
+    ny, nx = 16, 64
+    m = get_model(name)
+    lat = Lattice(m, (ny, nx), dtype=jnp.float32,
+                  settings=_SETTINGS[name])
+    flags = _paint(m, ny, nx)
+    lat.set_flags(flags)
+    lat.init()
+    present = present_types(m, flags)
+
+    # K + 2 forces one fused chunk plus remainder single steps
+    niter = K + 2
+    it_p = pallas_generic.make_pallas_iterate(
+        m, (ny, nx), jnp.float32, interpret=True, present=present,
+        fuse=K)
+    s_p = it_p(jax.tree.map(jnp.copy, lat.state), lat.params, niter)
+    it_1 = pallas_generic.make_pallas_iterate(
+        m, (ny, nx), jnp.float32, interpret=True, present=present,
+        fuse=1)
+    s_1 = it_1(jax.tree.map(jnp.copy, lat.state), lat.params, niter)
+    np.testing.assert_array_equal(np.asarray(s_p.fields),
+                                  np.asarray(s_1.fields))
+    assert int(s_p.iteration) == int(s_1.iteration)
+    # and the fused output still matches the XLA step at the engine's
+    # established allclose tolerance
+    it_x = jax.jit(make_iterate(m, present=present),
+                   static_argnames=("niter",))
+    s_x = it_x(lat.state, lat.params, niter)
+    np.testing.assert_allclose(np.asarray(s_p.fields),
+                               np.asarray(s_x.fields),
+                               rtol=1e-5, atol=1e-5)
+
+
+def test_choose_fuse_deep_depths():
+    """The planner now extends past 2: reach-1 models saturate FUSE_MAX
+    (8) and kuper's reach-2 plan caps at 4 (reach 8 == the band halo)."""
+    assert pallas_generic.choose_fuse(get_model("d2q9_heat")) == 8
+    assert pallas_generic.choose_fuse(get_model("d2q9_kuper")) == 4
+
+
+# --------------------------------------------------------------------- #
+# precision ladder: bf16 storage through the generic engines
+# --------------------------------------------------------------------- #
+
+
+def test_storage_dtype_is_opt_in():
+    """Never silently narrowed: the default Lattice stores in the
+    compute dtype, and non-float / widening storage dtypes are
+    rejected up front."""
+    m = get_model("d2q9_heat")
+    lat = Lattice(m, (16, 64), dtype=jnp.float32,
+                  settings=_SETTINGS["d2q9_heat"])
+    assert lat.storage_dtype == jnp.dtype(jnp.float32)
+    assert lat.state.fields.dtype == jnp.dtype(jnp.float32)
+    with pytest.raises(ValueError, match="storage_dtype"):
+        Lattice(m, (16, 64), dtype=jnp.float32, storage_dtype=jnp.int8,
+                settings=_SETTINGS["d2q9_heat"])
+    with pytest.raises(ValueError, match="storage_dtype"):
+        Lattice(m, (16, 64), dtype=jnp.float32,
+                storage_dtype=jnp.float64,
+                settings=_SETTINGS["d2q9_heat"])
+
+
+def test_storage_dtype_bf16_xla_close_to_f32():
+    """bf16 storage on the XLA path: fields stay bf16 across iterate,
+    compute happens in f32 (error stays at bf16-rounding scale instead
+    of compounding catastrophically)."""
+    m = get_model("d2q9_heat")
+
+    def run(storage_dtype):
+        lat = Lattice(m, (16, 64), dtype=jnp.float32,
+                      settings=_SETTINGS["d2q9_heat"],
+                      storage_dtype=storage_dtype)
+        lat.set_flags(_paint(m, 16, 64))
+        lat.init()
+        lat.iterate(20)
+        return lat
+
+    ref = run(None)
+    alt = run(jnp.bfloat16)
+    assert alt.state.fields.dtype == jnp.dtype(jnp.bfloat16)
+    a = np.asarray(alt.state.fields, dtype=np.float32)
+    b = np.asarray(ref.state.fields)
+    assert np.isfinite(a).all()
+    denom = max(float(np.max(np.abs(b))), 1e-30)
+    assert float(np.max(np.abs(a - b))) / denom < 2e-2
+
+
+def test_storage_dtype_bf16_band_matches_xla_cast_path():
+    """The generic band kernel under bf16 storage (widen-on-read,
+    f32 accumulate, narrow-on-write) matches the XLA narrowed-carry
+    reference bit-for-bit: both paths run f32 arithmetic between
+    identical bf16 round trips."""
+    m = get_model("d2q9_heat")
+    lat = Lattice(m, (16, 64), dtype=jnp.float32,
+                  settings=_SETTINGS["d2q9_heat"],
+                  storage_dtype=jnp.bfloat16)
+    flags = _paint(m, 16, 64)
+    lat.set_flags(flags)
+    lat.init()
+    present = present_types(m, flags)
+    niter = 6
+
+    it_p = pallas_generic.make_pallas_iterate(
+        m, (16, 64), jnp.bfloat16, interpret=True, present=present)
+    s_p = it_p(jax.tree.map(jnp.copy, lat.state), lat.params, niter)
+    assert s_p.fields.dtype == jnp.dtype(jnp.bfloat16)
+
+    it_x = jax.jit(make_iterate(m, present=present,
+                                storage_dtype=jnp.bfloat16),
+                   static_argnames=("niter",))
+    s_x = it_x(lat.state, lat.params, niter)
+    np.testing.assert_array_equal(
+        np.asarray(s_p.fields, dtype=np.float32),
+        np.asarray(s_x.fields, dtype=np.float32))
+
+
+def test_bf16_dispatch_skips_f32_only_kernels(monkeypatch, tmp_path):
+    """Engine dispatch under bf16 storage routes past the f32-only tuned
+    d2q9 kernels to a narrowed-capable engine, and stamps the storage
+    dtype on iterate spans (telemetry attribution must not overstate
+    bf16 runs' bytes)."""
+    import json as _json
+    from tclb_tpu import telemetry
+    monkeypatch.setenv("TCLB_FASTPATH", "force")
+    m = get_model("d2q9")
+    lat = Lattice(m, (16, 64), dtype=jnp.float32,
+                  settings=_SETTINGS["d2q9"],
+                  storage_dtype=jnp.bfloat16)
+    lat.set_flags(_paint(m, 16, 64))
+    lat.init()
+    trace = tmp_path / "t.jsonl"
+    telemetry.enable(str(trace))
+    try:
+        lat.iterate(2)
+    finally:
+        telemetry.disable()
+    assert lat._fast_name is not None
+    assert "generic" in lat._fast_name   # tuned d2q9 kernels are f32-only
+    evts = [_json.loads(x) for x in trace.read_text().splitlines()
+            if x.strip()]
+    spans = [e for e in evts
+             if e.get("kind") == "span" and e.get("name") == "iterate"]
+    assert spans and spans[0]["storage_dtype"] == "bfloat16"
+    # actual bytes per node: 2 x n_storage x 2 (bf16) + flag read
+    assert spans[0]["bytes_per_node"] == 2 * m.n_storage * 2 + 2

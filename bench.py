@@ -66,6 +66,19 @@ BENCH_FLOORS = {
     # the narrow path is spilling casts to HBM instead of folding them
     # into the DMA pipeline.
     "bf16_effective_bw": 1.6,
+    # fleet: the 16-small-cavity-job workload through the per-device
+    # FleetDispatcher (one serving lane per local device, double-buffered
+    # host staging) vs the single-worker Scheduler, same max_batch, both
+    # warmed (serve/fleet_bench.py — the exact workload CI smokes).  N
+    # real devices must buy close to N lanes' worth of throughput; 4.0
+    # on 8 devices leaves headroom for binning/staging overheads.
+    # TPU-gated like every floor: forced-host CPU "devices" timeshare
+    # the same cores, so the CPU run prints the ratio informationally.
+    "fleet_speedup_d8": 4.0,
+    # staging overlap (percent of host-staging time hidden under device
+    # execution, first-fill batches excluded): under 90% means batch k+1
+    # device_put is no longer overlapping batch k's execute
+    "fleet_staging_overlap_pct": 90.0,
 }
 
 
@@ -510,6 +523,56 @@ def bench_ensemble(results):
     return []
 
 
+def bench_fleet(results):
+    """Pod-scale serving: the fleet workload from serve/fleet_bench.py —
+    single-worker Scheduler vs per-device FleetDispatcher throughput,
+    staging overlap / occupancy from a dedicated telemetry trace, one
+    large job routed to the sharded engine, and bit-parity of every
+    sampled lane result against the sequential path.  With fewer than 2
+    local devices the workload re-launches itself in a subprocess with 8
+    forced host devices so the dispatcher logic is exercised everywhere;
+    the speedup/overlap floors stay TPU-gated (virtual CPU devices
+    timeshare the same cores)."""
+    import subprocess
+
+    import jax
+
+    jobs = int(os.environ.get("TCLB_BENCH_FLEET_JOBS", 16))
+    iters = int(os.environ.get("TCLB_BENCH_ITERS_FLEET", 60))
+    multi = len(jax.devices()) >= 2
+    if multi:
+        from tclb_tpu.serve.fleet_bench import run_fleet
+        doc = run_fleet(jobs=jobs, niter=iters)
+    else:
+        env = dict(os.environ)
+        env["JAX_PLATFORMS"] = "cpu"
+        env["XLA_FLAGS"] = (env.get("XLA_FLAGS", "")
+                            + " --xla_force_host_platform_device_count=8"
+                            ).strip()
+        env.pop("TCLB_TELEMETRY", None)  # keeps its own internal trace
+        out = subprocess.run(
+            [sys.executable, "-m", "tclb_tpu.serve.fleet_bench",
+             "--jobs", str(jobs), "--niter", str(iters)],
+            capture_output=True, text=True, env=env, check=True)
+        doc = json.loads(out.stdout)
+    assert doc.get("parity_ok"), \
+        "fleet lanes lost bit-parity vs the sequential path"
+    assert doc.get("devices_evicted", 0) == 0, \
+        f"fleet bench evicted {doc['devices_evicted']} healthy device(s)"
+    results["fleet_devices"] = doc["devices"]
+    results["fleet_lanes_active"] = doc.get("lanes_active")
+    results["fleet_occupancy_pct"] = doc.get("mean_occupancy_pct")
+    results["fleet_route_sharded"] = doc.get("route_sharded_events")
+    # floor keys only from a real multi-device run — the forced-host
+    # fallback's numbers describe core timesharing, not the dispatcher
+    spd = "fleet_speedup_d8" if multi else "fleet_speedup_forced_host"
+    ovl = ("fleet_staging_overlap_pct" if multi
+           else "fleet_staging_overlap_forced_host")
+    results[spd] = doc.get("fleet_speedup_d8")
+    results[ovl] = doc.get("staging_overlap_pct")
+    return []
+
+
 def bench_precision_ladder(results):
     """The bf16 storage ladder on its flagship case: the d2q9 channel at
     the headline bench shape, same auto-selected engine, f32 vs bf16
@@ -568,6 +631,8 @@ def main():
         checks3d += bench_precision_ladder(results)
     with telemetry.span("bench.ensemble"):
         checks3d += bench_ensemble(results)
+    with telemetry.span("bench.fleet"):
+        checks3d += bench_fleet(results)
 
     dev = jax.devices()[0]
     hbm = HBM_GBS.get(dev.device_kind)

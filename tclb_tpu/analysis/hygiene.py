@@ -520,6 +520,50 @@ def scan_ensemble_unsafe(paths=None) -> list:
     return findings
 
 
+def scan_unpinned_device_put(paths=None) -> list:
+    """Device-placement hygiene for the serving fleet: every
+    ``device_put`` in ``tclb_tpu/serve`` must name an explicit target —
+    a second positional argument or a ``device=``/``sharding=`` keyword.
+
+    A bare ``jax.device_put(x)`` commits to ``jax.devices()[0]``, which
+    on a fleet lane silently funnels every lane's staging traffic onto
+    device 0 — the exact cross-lane contention the dispatcher exists to
+    avoid, and invisible in tests that run on one device."""
+    if paths is None:
+        paths = _py_files(os.path.join(_PKG_ROOT, "serve"))
+    findings = []
+    for path in paths:
+        try:
+            with open(path) as fh:
+                tree = ast.parse(fh.read())
+        except (OSError, SyntaxError) as e:
+            findings.append(Finding(
+                "hygiene.unparseable", "error", "",
+                f"cannot parse {path}: {e}", path))
+            continue
+        rel = os.path.relpath(path, _REPO_ROOT)
+        for node in ast.walk(tree):
+            if not isinstance(node, ast.Call):
+                continue
+            f = node.func
+            name = f.id if isinstance(f, ast.Name) else \
+                (f.attr if isinstance(f, ast.Attribute) else None)
+            if name != "device_put":
+                continue
+            pinned = len(node.args) >= 2 or any(
+                kw.arg in ("device", "sharding") for kw in node.keywords)
+            if not pinned:
+                findings.append(Finding(
+                    "hygiene.unpinned_device_put", "error", "",
+                    f"{rel}:{node.lineno} device_put without an explicit "
+                    "device/sharding — in serve/ this commits to "
+                    "jax.devices()[0] and funnels every fleet lane's "
+                    "staging onto device 0; pass the lane's device "
+                    "(or a NamedSharding) explicitly",
+                    f"{rel}:{node.lineno}"))
+    return findings
+
+
 def check_repo(engine_dir=None, sources=None) -> list:
     from tclb_tpu.analysis.precision import scan_unsafe_accum
     return (scan_dead_entry_points(engine_dir, sources)
@@ -527,6 +571,7 @@ def check_repo(engine_dir=None, sources=None) -> list:
             + scan_dispatch_telemetry()
             + scan_unrestorable_handlers()
             + scan_ensemble_unsafe()
+            + scan_unpinned_device_put()
             + scan_unsafe_accum())
 
 

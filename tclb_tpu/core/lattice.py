@@ -709,7 +709,8 @@ class Lattice:
                  dtype: Any = jnp.float32,
                  settings: Optional[dict[str, float]] = None,
                  mesh: Any = None,
-                 storage_dtype: Any = None):
+                 storage_dtype: Any = None,
+                 device: Any = None):
         if len(shape) != model.ndim:
             raise ValueError(f"model {model.name} is {model.ndim}D; "
                              f"got shape {shape}")
@@ -751,9 +752,20 @@ class Lattice:
             globals_=jnp.zeros((model.n_globals,), dtype=dtype),
             iteration=jnp.zeros((), dtype=jnp.int32),
         )
+        if mesh is not None and device is not None:
+            raise ValueError("pass either mesh= (sharded) or device= "
+                             "(single-device pin), not both")
+        self.device = device
         if mesh is not None:
             from tclb_tpu.parallel.mesh import shard_state
             self._place = lambda: shard_state(self.state, self.params, mesh)
+            self.state, self.params = self._place()
+        elif device is not None:
+            # single-device pin (the fleet dispatcher's lane seam): commit
+            # state+params to the named device so every downstream dispatch
+            # runs there instead of on JAX's default device
+            self._place = lambda: (jax.device_put(self.state, device),
+                                   jax.device_put(self.params, device))
             self.state, self.params = self._place()
         else:
             self._place = None

@@ -11,6 +11,7 @@ LBM halo exchange is nearest-neighbor, which maps exactly onto the ICI torus.
 
 from __future__ import annotations
 
+import functools
 from typing import Optional, Sequence
 
 import numpy as np
@@ -31,7 +32,22 @@ def choose_decomposition(shape: Sequence[int], n_devices: int,
     assignments of ``n_devices`` to dims, score = total halo area
     = sum over split dims of (points per cut plane) x (cuts), prefer leaving
     X whole (TPU lane dim / reference coalescing dim).
+
+    The search is memoized on ``(shape, n_devices, keep_x)`` — the fleet
+    dispatcher's routing cost model calls it per submitted job, and the
+    exhaustive factorization walk must not be back on that hot path.
+    Note the chosen score ranks identically to
+    :func:`decomposition_overhead` (cost = total/2 x overhead for even
+    splits), so the pick also minimizes the halo-to-volume ratio within
+    its keep-x tier (tests/test_fleet.py proves this by enumeration).
     """
+    return dict(_choose_decomposition_cached(
+        tuple(int(s) for s in shape), int(n_devices), bool(keep_x)))
+
+
+@functools.lru_cache(maxsize=4096)
+def _choose_decomposition_cached(shape: tuple[int, ...], n_devices: int,
+                                 keep_x: bool) -> tuple:
     names = AXIS_NAMES_2D if len(shape) == 2 else AXIS_NAMES_3D
     dims = dict(zip(names, shape))
 
@@ -63,7 +79,8 @@ def choose_decomposition(shape: Sequence[int], n_devices: int,
     if best is None:
         raise ValueError(
             f"cannot decompose shape {tuple(shape)} over {n_devices} devices")
-    return best
+    # cache a frozen snapshot; choose_decomposition hands out fresh dicts
+    return tuple((a, best[a]) for a in names)
 
 
 def make_mesh(shape: Sequence[int], devices: Optional[list] = None,

@@ -12,13 +12,18 @@ many-case engine:
   compilation cache via ``TCLB_COMPILE_CACHE``);
 * :mod:`tclb_tpu.serve.scheduler` — in-process queue that bins
   compatible jobs into batches, retries failed batched runs and
-  degrades to the sequential path rather than failing a whole batch.
+  degrades to the sequential path rather than failing a whole batch;
+* :mod:`tclb_tpu.serve.dispatcher` — the fleet layer: one concurrent
+  serving lane per local device (device-pinned compiled caches,
+  double-buffered host staging) plus size-aware routing of large jobs
+  onto the multi-device sharded engine.
 
 CLI: ``python -m tclb_tpu sweep case.xml --param "nu=0.01:0.05:8"``.
 """
 
 from tclb_tpu.serve.cache import (CompiledCache, default_cache,
                                   wire_persistent_cache)
+from tclb_tpu.serve.dispatcher import FleetDispatcher, route_job
 from tclb_tpu.serve.ensemble import (Case, EnsemblePlan, EnsembleResult,
                                      run_ensemble)
 from tclb_tpu.serve.scheduler import Job, JobSpec, JobTimeout, Scheduler
@@ -28,11 +33,13 @@ __all__ = [
     "CompiledCache",
     "EnsemblePlan",
     "EnsembleResult",
+    "FleetDispatcher",
     "Job",
     "JobSpec",
     "JobTimeout",
     "Scheduler",
     "default_cache",
+    "route_job",
     "run_ensemble",
     "wire_persistent_cache",
 ]

@@ -73,7 +73,8 @@ class CompiledCache:
         self.evictions = 0
         wire_persistent_cache()
 
-    def key_for(self, plan, batch: int, niter: int, init: bool) -> tuple:
+    def key_for(self, plan, batch: int, niter: int, init: bool,
+                device: Any = None) -> tuple:
         return (plan.model.fingerprint,
                 plan.shape,
                 plan.engine_tag(batch),
@@ -81,19 +82,25 @@ class CompiledCache:
                 str(jax.numpy.dtype(plan.dtype)),
                 int(niter),
                 bool(init),
-                frozenset(plan.present or ()))
+                frozenset(plan.present or ()),
+                str(device))
 
     def get(self, plan, batch: int, niter: int, fn: Callable,
-            init: bool = True) -> Callable:
+            init: bool = True, device: Any = None) -> Callable:
         """Compiled ``(states, params) -> states`` executable for this
-        plan/batch/niter class, compiling on miss."""
-        key = self.key_for(plan, batch, niter, init)
+        plan/batch/niter class, compiling on miss.  ``device`` pins the
+        executable to one device via input shardings (a fleet lane's
+        cache compiles against its own device so executables never
+        migrate)."""
+        key = self.key_for(plan, batch, niter, init, device=device)
         hit = key in self._entries
-        with telemetry.span("serve.compile",
-                            cache="hit" if hit else "miss",
-                            engine=plan.engine_tag(batch),
-                            model=plan.model.name, batch=int(batch),
-                            niter=int(niter)):
+        fields = dict(cache="hit" if hit else "miss",
+                      engine=plan.engine_tag(batch),
+                      model=plan.model.name, batch=int(batch),
+                      niter=int(niter))
+        if device is not None:
+            fields["device"] = str(device)
+        with telemetry.span("serve.compile", **fields):
             if hit:
                 self._entries.move_to_end(key)
                 self.hits += 1
@@ -101,7 +108,7 @@ class CompiledCache:
                 return self._entries[key]
             self.misses += 1
             telemetry.counter("serve.cache.miss")
-            states, params = plan.abstract_inputs(batch)
+            states, params = plan.abstract_inputs(batch, device=device)
             lowered = jax.jit(fn, static_argnames=("niter",)).lower(
                 states, params, niter=niter)
             compiled = lowered.compile()

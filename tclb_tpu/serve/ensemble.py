@@ -114,6 +114,7 @@ class EnsemblePlan:
         self.flags = base._flags_host()
         self.base_state = base.state
         self.base_params = base.params
+        self._host_state = None  # numpy mirror, built lazily for staging
         self.present = present_types(self.model, self.flags)
         narrowed = jnp.dtype(self.storage_dtype) != jnp.dtype(self.dtype)
         self._init = make_ensemble_step(self.model, "Init", present=None)
@@ -150,11 +151,20 @@ class EnsemblePlan:
             return self._iterate(states, params, niter)
         return fn
 
-    def abstract_inputs(self, batch: int) -> tuple:
+    def abstract_inputs(self, batch: int, device: Any = None) -> tuple:
         """``jax.ShapeDtypeStruct`` pytrees matching a batch-of-``batch``
-        call — what AOT lowering sees instead of real arrays."""
+        call — what AOT lowering sees instead of real arrays.  With
+        ``device`` the structs carry a ``SingleDeviceSharding`` so the
+        compiled executable is pinned to that device (a fleet lane's
+        executables never migrate)."""
+        sharding = None
+        if device is not None:
+            from jax.sharding import SingleDeviceSharding
+            sharding = SingleDeviceSharding(device)
+
         def sds(x):
-            return jax.ShapeDtypeStruct((batch,) + tuple(x.shape), x.dtype)
+            return jax.ShapeDtypeStruct((batch,) + tuple(x.shape), x.dtype,
+                                        sharding=sharding)
         states = jax.tree.map(sds, self.base_state)
         params = jax.tree.map(sds, self.base_params)
         return states, params
@@ -164,6 +174,37 @@ class EnsemblePlan:
         params = stack_trees([case_params(self.model, self.base_params, c,
                                           self.dtype) for c in cases])
         return states, params
+
+    def host_stacked_cases(self, cases: Sequence[Case]) -> tuple:
+        """Host-side (numpy) stacked inputs for a batch — what a staging
+        thread builds while the device executes the *previous* batch, so
+        the only device work left is one explicit ``device_put``.  Values
+        are identical to :meth:`stack_cases` (same float64 host derivation
+        in :func:`case_params`), preserving the bit-parity contract."""
+        if self._host_state is None:
+            self._host_state = jax.tree.map(np.asarray, self.base_state)
+        states = jax.tree.map(
+            lambda x: np.broadcast_to(x[None], (len(cases),) + x.shape),
+            self._host_state)
+        per_case = [case_params(self.model, self.base_params, c, self.dtype)
+                    for c in cases]
+        params = jax.tree.map(
+            lambda *xs: np.stack([np.asarray(x) for x in xs]), *per_case)
+        return states, params
+
+    def results_from(self, cases: Sequence[Case], out: LatticeState
+                     ) -> list[EnsembleResult]:
+        """Per-case results (input order) from a batched output state."""
+        finals = unstack_tree(out, len(cases))
+        m = self.model
+        results = []
+        for case, st in zip(cases, finals):
+            vals = np.asarray(st.globals_)
+            results.append(EnsembleResult(
+                case=case, state=st,
+                globals={g.name: float(vals[i])
+                         for i, g in enumerate(m.globals_)}))
+        return results
 
     def run(self, cases: Sequence[Case], niter: int,
             cache=None, init: bool = True) -> list[EnsembleResult]:
@@ -179,26 +220,20 @@ class EnsemblePlan:
         else:
             out = jax.jit(fn, static_argnames=("niter",))(
                 states, params, niter)
-        finals = unstack_tree(out, len(cases))
-        m = self.model
-        results = []
-        for case, st in zip(cases, finals):
-            vals = np.asarray(st.globals_)
-            results.append(EnsembleResult(
-                case=case, state=st,
-                globals={g.name: float(vals[i])
-                         for i, g in enumerate(m.globals_)}))
-        return results
+        return self.results_from(cases, out)
 
     # -- sequential reference path ----------------------------------------- #
 
-    def run_sequential(self, case: Case, niter: int) -> EnsembleResult:
+    def run_sequential(self, case: Case, niter: int,
+                       device: Any = None) -> EnsembleResult:
         """One case through the plain ``Lattice`` path (auto-selected
         engine) — the scheduler's degradation target when a batched
-        compile fails, and the parity reference in tests."""
+        compile fails, and the parity reference in tests.  ``device``
+        pins the run to one device (a fleet lane degrading a poisoned
+        batch stays on its own lane)."""
         case = case if isinstance(case, Case) else Case(settings=dict(case))
         lat = Lattice(self.model, self.shape, dtype=self.dtype,
-                      storage_dtype=self.storage_dtype)
+                      storage_dtype=self.storage_dtype, device=device)
         lat.set_flags(self.flags.copy())
         lat.params = case_params(self.model, self.base_params, case,
                                  self.dtype)

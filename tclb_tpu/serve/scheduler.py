@@ -167,6 +167,9 @@ class Scheduler:
         self._lock = threading.Lock()
         self._closing = False
         self._worker: Optional[threading.Thread] = None
+        # every live handle, so close() can sweep jobs whose timeout
+        # fires while the worker is stuck or the queue never drains
+        self._inflight: dict[int, Job] = {}
 
     # -- admission ---------------------------------------------------------- #
 
@@ -187,6 +190,7 @@ class Scheduler:
         with self._lock:
             self._jobs += 1
             job = Job(spec, self._jobs)
+            self._inflight[job.id] = job
         self._queue.put(job)
         telemetry.counter("serve.jobs.submitted")
         if self.autostart:
@@ -205,10 +209,26 @@ class Scheduler:
                 pass
         return jobs
 
-    def close(self, wait: bool = True) -> None:
+    def close(self, wait: bool = True, join_timeout: float = 60.0) -> None:
         self._closing = True
         if wait and self._worker is not None:
-            self._worker.join(timeout=60.0)
+            self._worker.join(timeout=join_timeout)
+        # close/timeout race: a job whose deadline passes while close is
+        # draining (worker stuck mid-batch, or a queue that never ran)
+        # must surface as failed-not-hung — the caller may never wait on
+        # result() with its own timeout again after close returns.
+        now = time.monotonic()
+        with self._lock:
+            pending = [j for j in self._inflight.values()
+                       if not j._done.is_set()]
+            self._inflight = {j.id: j for j in pending}
+        for job in pending:
+            t = job.spec.timeout_s
+            if t is not None and now >= job.submitted + t:
+                job._finish(None, JobTimeout(
+                    f"job {job.id} timed out during close "
+                    f"(waited {now - job.submitted:.2f}s)"))
+                telemetry.counter("serve.jobs.timeout")
 
     def __enter__(self) -> "Scheduler":
         return self
@@ -344,6 +364,7 @@ class Scheduler:
             self._stream(j)
 
     def _stream(self, job: Job) -> None:
+        self._inflight.pop(job.id, None)
         telemetry.counter("serve.jobs.done" if job.status == DONE
                           else "serve.jobs.failed")
         if self._on_result is not None:

@@ -90,6 +90,64 @@ def _serving_summary(evts: list[dict]) -> dict:
     return out
 
 
+def _fleet_summary(evts: list[dict]) -> dict:
+    """The fleet dispatcher's health numbers: per-device occupancy (lane
+    busy time over the ``serve.fleet`` lifetime span), queue waits, the
+    staging-overlap fraction, and the routing/eviction event counts.
+
+    Staging overlap is the fraction of host-staging time hidden under
+    device execution, ``1 - sum(stall_s)/sum(stage_s)`` over
+    ``serve.lane_batch`` spans — a lane's first fill has nothing to
+    overlap with and is excluded (``first=True`` rows).  The bench gate
+    wants >90% on the fleet workload."""
+    lanes = [e for e in evts if e.get("kind") == "span"
+             and e.get("name") == "serve.lane_batch"]
+    fleet = [e for e in evts if e.get("kind") == "span"
+             and e.get("name") == "serve.fleet"]
+    routed = sum(1 for e in evts if e.get("kind") == "serve.route_sharded")
+    evicted = sum(1 for e in evts
+                  if e.get("kind") == "serve.device_evicted")
+    if not lanes and not fleet and not routed:
+        return {}
+    wall = sum(float(f.get("dur_s", 0.0)) for f in fleet) or None
+    per: dict[str, dict] = {}
+    stage_tot = stall_tot = 0.0
+    waits: list[float] = []
+    for b in lanes:
+        dev = str(b.get("device", "?"))
+        row = per.setdefault(dev, {"batches": 0, "jobs": 0, "busy_s": 0.0})
+        row["batches"] += 1
+        row["jobs"] += int(b.get("batch", 0))
+        row["busy_s"] += float(b.get("dur_s", 0.0))
+        waits.extend(float(w) for w in (b.get("wait_s") or []))
+        if not b.get("first"):
+            stage_tot += float(b.get("stage_s", 0.0))
+            stall_tot += float(b.get("stall_s", 0.0))
+    for row in per.values():
+        row["busy_s"] = round(row["busy_s"], 6)
+        row["occupancy_pct"] = (round(100.0 * row["busy_s"] / wall, 2)
+                                if wall else None)
+    occ = [r["occupancy_pct"] for r in per.values()
+           if r["occupancy_pct"] is not None]
+    p50, p95 = _percentile(waits, 0.50), _percentile(waits, 0.95)
+    return {
+        "lanes": dict(sorted(per.items())),
+        "lanes_active": sum(1 for r in per.values() if r["jobs"] > 0),
+        "batches": len(lanes),
+        "jobs": sum(r["jobs"] for r in per.values()),
+        "wall_s": None if wall is None else round(wall, 6),
+        "mean_occupancy_pct": (round(sum(occ) / len(occ), 2)
+                               if occ else None),
+        "staging_overlap_pct": (
+            round(100.0 * (1.0 - stall_tot / stage_tot), 2)
+            if stage_tot > 0 else None),
+        "queue_wait_p50_s": None if p50 is None else round(p50, 6),
+        "queue_wait_p95_s": None if p95 is None else round(p95, 6),
+        "routed_sharded": routed,
+        "devices_evicted": evicted,
+    }
+
+
 def summarize(evts: list[dict]) -> dict:
     """Aggregate one trace into the report structure (all plain dicts,
     JSON-serializable as-is)."""
@@ -168,6 +226,7 @@ def summarize(evts: list[dict]) -> dict:
                 sum(nu * r for nu, r in rows) / tot, 4)
     return {"engines": engines, "spans": spans,
             "serving": _serving_summary(evts),
+            "fleet": _fleet_summary(evts),
             "engine_selected": [
                 {k: v for k, v in e.items() if k not in ("kind",)}
                 for e in selected],
@@ -244,6 +303,34 @@ def compare(base: dict, other: dict, threshold: float = 0.05) -> dict:
                         "what": what, "base": av, "other": bv,
                         "delta_pct": row[f"{key}_delta_pct"]})
         out["serving"] = row
+    # fleet health: a shrinking per-device occupancy or a staging
+    # overlap that stops hiding under execution is the multi-device
+    # analogue of the batch-occupancy regression above
+    fa = base.get("fleet") or {}
+    fb = other.get("fleet") or {}
+    if fa or fb:
+        row = {"base_mean_occupancy_pct": fa.get("mean_occupancy_pct"),
+               "other_mean_occupancy_pct": fb.get("mean_occupancy_pct"),
+               "base_staging_overlap_pct": fa.get("staging_overlap_pct"),
+               "other_staging_overlap_pct": fb.get("staging_overlap_pct"),
+               "base_lanes_active": fa.get("lanes_active"),
+               "other_lanes_active": fb.get("lanes_active")}
+        for what, key in (("fleet_occupancy", "mean_occupancy_pct"),
+                          ("fleet_staging_overlap",
+                           "staging_overlap_pct")):
+            av, bv = fa.get(key), fb.get(key)
+            if av and bv is not None:
+                delta = (bv - av) / av
+                row[f"{key}_delta_pct"] = round(100 * delta, 2)
+                if delta < -threshold:
+                    out["regressions"].append({
+                        "what": what, "base": av, "other": bv,
+                        "delta_pct": row[f"{key}_delta_pct"]})
+        la, lb = fa.get("lanes_active"), fb.get("lanes_active")
+        if la and lb is not None and lb < la:
+            out["regressions"].append({
+                "what": "fleet_lanes_active", "base": la, "other": lb})
+        out["fleet"] = row
     # fallback-chain drift is a regression signal of its own (an engine
     # newly failing to compile shows up here before any timing does)
     fb_a = [(f.get("from"), f.get("to")) for f in base.get("fallbacks", [])]
@@ -314,6 +401,27 @@ def format_text(summary: dict) -> str:
                 f"hit rate {_fmt(sv['cache_hit_rate_pct'], 1)}%, "
                 f"{_fmt(sv['compile_miss_s'], 3)}s compiling")
         lines.append("")
+    if summary.get("fleet"):
+        fl = summary["fleet"]
+        lines.append("fleet")
+        if fl.get("lanes"):
+            lines.append(f"  {'device':<28} {'batches':>8} {'jobs':>6} "
+                         f"{'busy_s':>10} {'occupancy':>10}")
+            for dev, r in fl["lanes"].items():
+                occ = (_fmt(r["occupancy_pct"], 1) + "%"
+                       if r.get("occupancy_pct") is not None else "-")
+                lines.append(f"  {dev:<28} {r['batches']:>8} "
+                             f"{r['jobs']:>6} {_fmt(r['busy_s'], 4):>10} "
+                             f"{occ:>10}")
+        lines.append(
+            f"  lanes active {fl['lanes_active']}  "
+            f"staging overlap {_fmt(fl['staging_overlap_pct'], 1)}%  "
+            f"routed sharded {fl['routed_sharded']}  "
+            f"evicted {fl['devices_evicted']}")
+        lines.append(
+            f"  queue wait p50 {_fmt(fl['queue_wait_p50_s'], 4)}s  "
+            f"p95 {_fmt(fl['queue_wait_p95_s'], 4)}s")
+        lines.append("")
     if summary["engine_selected"]:
         lines.append("engine selections")
         for e in summary["engine_selected"]:
@@ -373,6 +481,17 @@ def format_compare_text(diff: dict) -> str:
             f"{_fmt(sv['other_occupancy_pct'], 1)}%, cache hit rate "
             f"{_fmt(sv['base_cache_hit_rate_pct'], 1)}% -> "
             f"{_fmt(sv['other_cache_hit_rate_pct'], 1)}%")
+    if diff.get("fleet"):
+        fl = diff["fleet"]
+        lines.append(
+            "  fleet: occupancy "
+            f"{_fmt(fl['base_mean_occupancy_pct'], 1)}% -> "
+            f"{_fmt(fl['other_mean_occupancy_pct'], 1)}%, "
+            "staging overlap "
+            f"{_fmt(fl['base_staging_overlap_pct'], 1)}% -> "
+            f"{_fmt(fl['other_staging_overlap_pct'], 1)}%, lanes "
+            f"{_fmt(fl['base_lanes_active'])} -> "
+            f"{_fmt(fl['other_lanes_active'])}")
     if diff.get("fallback_drift"):
         lines.append("  fallback drift: "
                      f"base={diff['fallback_drift']['base']} "

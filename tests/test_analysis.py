@@ -316,6 +316,37 @@ def test_hygiene_fires_on_unrestorable_handler(tmp_path):
     assert hygiene.scan_unrestorable_handlers(paths=[str(p)]) == []
 
 
+def test_hygiene_fires_on_unpinned_device_put(tmp_path):
+    """serve/ staging must name its target device: a bare device_put
+    commits to jax.devices()[0] and funnels every fleet lane onto one
+    device — invisible on single-device test runs, fatal on a pod."""
+    p = tmp_path / "staging.py"
+    p.write_text(
+        "import jax\n"
+        "from jax import device_put\n"
+        "def stage_bad(x):\n"
+        "    return jax.device_put(x)\n"            # flagged: no target
+        "def stage_bare_bad(x):\n"
+        "    return device_put(x)\n"                # flagged: bare alias
+        "def stage_dev(x, dev):\n"
+        "    return jax.device_put(x, dev)\n"       # positional target ok
+        "def stage_kw(x, dev):\n"
+        "    return jax.device_put(x, device=dev)\n"
+        "def stage_sharded(x, s):\n"
+        "    return jax.device_put(x, sharding=s)\n")
+    fs = hygiene.scan_unpinned_device_put(paths=[str(p)])
+    assert [f.check for f in fs] == ["hygiene.unpinned_device_put"] * 2
+    assert all(f.severity == "error" for f in fs)
+    locs = sorted(f.message.split(" ")[0] for f in fs)
+    assert locs[0].endswith("staging.py:4"), locs
+    assert locs[1].endswith("staging.py:6"), locs
+
+    # the shipped serve/ package itself must be clean (also covered by
+    # test_repo_hygiene_clean via check_repo, but assert it directly so
+    # a future wiring regression cannot hide the check)
+    assert hygiene.scan_unpinned_device_put() == []
+
+
 # --------------------------------------------------------------------------- #
 # Finding mechanics / fingerprints
 # --------------------------------------------------------------------------- #

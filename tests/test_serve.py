@@ -26,7 +26,7 @@ from tclb_tpu.core.lattice import Lattice
 from tclb_tpu.models import get_model
 from tclb_tpu.serve import (Case, CompiledCache, EnsemblePlan, JobSpec,
                             JobTimeout, Scheduler, run_ensemble)
-from tclb_tpu.serve.scheduler import DONE, FAILED
+from tclb_tpu.serve.scheduler import DONE, FAILED, PENDING
 from tclb_tpu.telemetry import report
 
 REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
@@ -287,6 +287,40 @@ def test_scheduler_timeout_is_failed_not_hung():
             job.result()
         assert time.monotonic() - t0 < 2.0
         assert job.status == FAILED
+
+
+def test_scheduler_close_sweeps_inflight_past_deadline():
+    """The close(wait=True) vs in-flight-timeout race: the worker is
+    stuck inside a batch whose job deadline passes while close() is
+    draining.  close must not return leaving the job PENDING forever —
+    it sweeps in-flight jobs past their deadline into JobTimeout, so a
+    caller that trusted close() never hangs on result() afterwards."""
+    release = time.monotonic() + 3.0
+
+    def stuck(plan, cases, niter):
+        while time.monotonic() < release:   # worker wedged mid-batch
+            time.sleep(0.05)
+        return ["late"] * len(cases)
+
+    sched = Scheduler(max_batch=2, batch_runner=stuck)
+    job = sched.submit(_specs(_d2q9_plan(), (0.02,), timeout_s=0.2)[0])
+    time.sleep(0.4)                          # rot past the deadline
+    t0 = time.monotonic()
+    sched.close(wait=True, join_timeout=0.5)
+    assert time.monotonic() - t0 < 5.0       # close returned, not hung
+    assert job.status == FAILED
+    with pytest.raises(JobTimeout, match="during close"):
+        job.result(timeout=0.1)
+
+
+def test_scheduler_close_leaves_undeadlined_jobs_pending():
+    """Queued jobs with no timeout_s are NOT swept by close — a late
+    background finish may still legitimately flip them (the documented
+    Job.result() semantics); close only resolves the timeout race."""
+    with Scheduler(max_batch=2, autostart=False) as sched:
+        job = sched.submit(_specs(_d2q9_plan(), (0.02,))[0])
+    # never started, no deadline: still pending, error-free
+    assert job.status == PENDING and job.error is None
 
 
 def test_scheduler_expires_jobs_that_rotted_in_queue():

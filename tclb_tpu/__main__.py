@@ -59,6 +59,12 @@ def _cmd_run(args) -> int:
     if dtype is jnp.float64:
         jax.config.update("jax_enable_x64", True)
 
+    monitor = None
+    if args.monitor:
+        from tclb_tpu.telemetry.http import MonitorServer
+        monitor = MonitorServer.from_spec(args.monitor).start()
+        print(f"monitor: {monitor.url}/status")
+
     if args.profile:
         # XLA/TPU trace for TensorBoard (the reference's per-event CUDA
         # timing scaffolding + kernel stats, SURVEY §5 tracing)
@@ -70,6 +76,8 @@ def _cmd_run(args) -> int:
         if args.profile:
             jax.profiler.stop_trace()
             print(f"profile trace written to {args.profile}")
+        if monitor is not None:
+            monitor.stop()
     print(f"done: {solver.iter} iterations")
     return 0
 
@@ -129,6 +137,10 @@ def main(argv=None) -> int:
                    "checkpoint directory")
     r.add_argument("--profile", default=None, metavar="DIR",
                    help="write a TensorBoard trace of the run to DIR")
+    r.add_argument("--monitor", default=None, metavar="[HOST]:PORT",
+                   help="serve live /metrics, /status and /trace over "
+                   "HTTP for the duration of the run (host defaults to "
+                   "127.0.0.1; port 0 picks a free one)")
     r.add_argument("--distributed", default=None, metavar="SPEC",
                    help="multi-host init: 'auto' (TPU pod metadata) or "
                    "coordinator:port,num_processes,process_id")

@@ -564,6 +564,75 @@ def scan_unpinned_device_put(paths=None) -> list:
     return findings
 
 
+_MONITOR_BANNED_ROOTS = ("jax", "jaxlib")
+_MONITOR_BANNED_CALLS = ("device_put", "block_until_ready", "device_get")
+_MONITOR_BANNED_NAMES = ("Lattice",)
+
+
+def scan_device_work_in_monitor(paths=None) -> list:
+    """The HTTP monitor handler thread must never touch device state: a
+    scrape that calls into jax (or walks a Lattice) can deadlock against
+    the solve loop's dispatch or, worse, enqueue host-to-device work from
+    an arbitrary thread mid-iterate.  The contract is structural —
+    ``telemetry/http.py`` reads registry/status snapshots only — so this
+    check enforces it by AST: no jax/jaxlib import, no
+    ``device_put``/``block_until_ready``/``device_get`` call, and no
+    ``Lattice`` reference anywhere in the monitor module."""
+    if paths is None:
+        paths = [os.path.join(_PKG_ROOT, "telemetry", "http.py")]
+    findings = []
+    for path in paths:
+        try:
+            with open(path) as fh:
+                tree = ast.parse(fh.read())
+        except (OSError, SyntaxError) as e:
+            findings.append(Finding(
+                "hygiene.unparseable", "error", "",
+                f"cannot parse {path}: {e}", path))
+            continue
+        rel = os.path.relpath(path, _REPO_ROOT)
+
+        def flag(lineno: int, what: str) -> None:
+            findings.append(Finding(
+                "hygiene.device_work_in_monitor", "error", "",
+                f"{rel}:{lineno} {what} — the monitor handler thread "
+                "must only read registry/status snapshots, never touch "
+                "jax or device state (scrapes racing the solve loop can "
+                "deadlock dispatch); move the work behind a status "
+                "provider registered from the owning thread",
+                f"{rel}:{lineno}"))
+
+        for node in ast.walk(tree):
+            if isinstance(node, ast.Import):
+                for a in node.names:
+                    root = a.name.split(".")[0]
+                    if root in _MONITOR_BANNED_ROOTS:
+                        flag(node.lineno, f"imports {a.name}")
+            elif isinstance(node, ast.ImportFrom):
+                root = (node.module or "").split(".")[0]
+                if root in _MONITOR_BANNED_ROOTS:
+                    flag(node.lineno, f"imports from {node.module}")
+                for a in node.names:
+                    if a.name in _MONITOR_BANNED_CALLS \
+                            or a.name in _MONITOR_BANNED_NAMES:
+                        flag(node.lineno, f"imports {a.name}")
+            elif isinstance(node, ast.Call):
+                f = node.func
+                name = f.id if isinstance(f, ast.Name) else \
+                    (f.attr if isinstance(f, ast.Attribute) else None)
+                if name in _MONITOR_BANNED_CALLS:
+                    flag(node.lineno, f"calls {name}(...)")
+            elif isinstance(node, ast.Name) \
+                    and node.id in _MONITOR_BANNED_NAMES:
+                flag(node.lineno, f"references {node.id}")
+            elif isinstance(node, ast.Attribute) \
+                    and isinstance(node.value, ast.Name) \
+                    and node.value.id in _MONITOR_BANNED_ROOTS:
+                flag(node.lineno,
+                     f"uses {node.value.id}.{node.attr}")
+    return findings
+
+
 def check_repo(engine_dir=None, sources=None) -> list:
     from tclb_tpu.analysis.precision import scan_unsafe_accum
     return (scan_dead_entry_points(engine_dir, sources)
@@ -572,6 +641,7 @@ def check_repo(engine_dir=None, sources=None) -> list:
             + scan_unrestorable_handlers()
             + scan_ensemble_unsafe()
             + scan_unpinned_device_put()
+            + scan_device_work_in_monitor()
             + scan_unsafe_accum())
 
 

@@ -565,8 +565,9 @@ class cbFailcheck(Handler):
                 n_bad = int(arr.size - finite.sum())
                 log.warning(f"Failcheck: {q.name} has {n_bad} non-finite "
                             f"values at iteration {s.iter}")
-                telemetry.failcheck(iteration=s.iter, quantity=q.name,
-                                    n_bad=n_bad)
+                telemetry.failcheck(
+                    iteration=s.iter, quantity=q.name, n_bad=n_bad,
+                    engine=getattr(s.lattice, "_fast_name", None) or "xla")
                 bad = True
                 break
         if bad:

@@ -43,6 +43,7 @@ import jax.numpy as jnp
 import numpy as np
 
 from tclb_tpu import telemetry
+from tclb_tpu.telemetry import live as tlive
 from tclb_tpu.core.lattice import Lattice
 from tclb_tpu.ops import fusion
 from tclb_tpu.parallel.mesh import (choose_decomposition,
@@ -121,10 +122,15 @@ class Lane:
         self.disp = dispatcher
         self.index = index
         self.device = device
+        # precomputed so the monitor thread never repr()s a live device
+        self.device_str = str(device)
         self.cache = CompiledCache()
         self.evicted = False
         self.batches = 0
+        self.jobs_served = 0
+        self.busy_s = 0.0
         self.failstreak = 0
+        self._current_job_ids: list[int] = []
         # one slot: batch k+1 stages while batch k executes
         self._staged: queue.Queue[Optional[_Staged]] = queue.Queue(maxsize=1)
         self._idle = threading.Event()
@@ -147,43 +153,60 @@ class Lane:
     def _stage_loop(self) -> None:
         d = self.disp
         try:
-            while not self.evicted:
-                batch = d._take_batch(self)
-                if batch is None:
-                    if d._closing:
-                        return
-                    continue
-                if not batch:
-                    continue
-                spec = batch[0].spec
-                key = _bin_key(spec)
-                cap = d.batch_cap(spec)
-                now = time.monotonic()
-                waits = [round(now - j.submitted, 6) for j in batch]
-                t0 = time.perf_counter()
-                try:
-                    plan = d._plan_for(spec, key)
-                    with telemetry.span("serve.stage",
-                                        device=str(self.device),
-                                        lane=self.index, batch=len(batch)):
-                        states, params = plan.host_stacked_cases(
-                            [j.spec.case for j in batch])
-                        inputs = jax.device_put((states, params), self.device)
-                        jax.block_until_ready(inputs)
-                except Exception as e:  # noqa: BLE001 - per-batch verdict
-                    for j in batch:
-                        j._finish(None, e)
-                        d._stream(j)
-                    continue
-                stage_s = time.perf_counter() - t0
-                self._staged.put(_Staged(batch, plan, inputs, stage_s,
-                                         cap, waits))
+            self._stage_loop_inner()
+        except BaseException as e:  # noqa: BLE001 - post-mortem first
+            tlive.flight_recorder().dump("stage_loop_exception",
+                                         lane=self.index, error=repr(e))
+            raise
         finally:
             self._staged.put(None)  # release the execute thread
+
+    def _stage_loop_inner(self) -> None:
+        d = self.disp
+        while not self.evicted:
+            batch = d._take_batch(self)
+            if batch is None:
+                if d._closing:
+                    return
+                continue
+            if not batch:
+                continue
+            spec = batch[0].spec
+            key = _bin_key(spec)
+            cap = d.batch_cap(spec)
+            now = time.monotonic()
+            waits = [round(now - j.submitted, 6) for j in batch]
+            t0 = time.perf_counter()
+            try:
+                plan = d._plan_for(spec, key)
+                with telemetry.span("serve.stage",
+                                    device=str(self.device),
+                                    lane=self.index, batch=len(batch),
+                                    job_ids=[j.id for j in batch]):
+                    states, params = plan.host_stacked_cases(
+                        [j.spec.case for j in batch])
+                    inputs = jax.device_put((states, params), self.device)
+                    jax.block_until_ready(inputs)
+            except Exception as e:  # noqa: BLE001 - per-batch verdict
+                for j in batch:
+                    j._finish(None, e)
+                    d._stream(j)
+                continue
+            stage_s = time.perf_counter() - t0
+            self._staged.put(_Staged(batch, plan, inputs, stage_s,
+                                     cap, waits))
 
     # -- execute thread ----------------------------------------------------- #
 
     def _exec_loop(self) -> None:
+        try:
+            self._exec_loop_inner()
+        except BaseException as e:  # noqa: BLE001 - post-mortem first
+            tlive.flight_recorder().dump("exec_loop_exception",
+                                         lane=self.index, error=repr(e))
+            raise
+
+    def _exec_loop_inner(self) -> None:
         d = self.disp
         while True:
             t0 = time.perf_counter()
@@ -210,10 +233,13 @@ class Lane:
         # under, so the report excludes first=True rows from the overlap
         stall_s = min(wait_s, item.stage_s)
         first = self.batches == 0
+        job_ids = [j.id for j in batch]
         for j in batch:
             j.status = RUNNING
         results: Optional[list[EnsembleResult]] = None
         err: Optional[BaseException] = None
+        busy_t0 = time.perf_counter()
+        telemetry.set_job(job_ids[0] if len(job_ids) == 1 else None)
         with telemetry.span("serve.lane_batch", device=str(self.device),
                             lane=self.index, batch=len(batch),
                             capacity=item.cap, model=spec.model.name,
@@ -221,7 +247,8 @@ class Lane:
                             engine=plan.engine_tag(len(batch)),
                             stage_s=round(item.stage_s, 6),
                             stall_s=round(stall_s, 6), first=first,
-                            wait_s=item.waits) as sp:
+                            wait_s=item.waits, job_ids=job_ids) as sp:
+            self._current_job_ids = job_ids
             for attempt in range(1 + d.retries):
                 for j in batch:
                     j.attempts += 1
@@ -239,7 +266,10 @@ class Lane:
                                     " retrying")
             self.batches += 1
             if results is not None:
-                sp.add(outcome="ok")
+                sp.add(outcome="ok", retries=attempt)
+                telemetry.set_job(None)
+                self.busy_s += time.perf_counter() - busy_t0
+                self.jobs_served += len(batch)
                 self.failstreak = 0
                 for j, r in zip(batch, results):
                     j._finish(r, None)
@@ -250,16 +280,22 @@ class Lane:
             log.warning(f"fleet lane {self.index}: batched run failed after "
                         f"{1 + d.retries} attempts ({err!r}); degrading "
                         f"{len(batch)} job(s) to sequential")
+        telemetry.set_job(None)
         any_ok = False
         for j in batch:
             j.degraded = True
-            try:
-                r = d._seq_runner(self, plan, j.spec.case, spec.niter)
-                j._finish(r, None)
-                any_ok = True
-            except Exception as e:  # noqa: BLE001 - per-job verdict
-                j._finish(None, e)
+            telemetry.event("serve.job_degraded", job_id=j.id,
+                            lane=self.index, error=repr(err))
+            with telemetry.job_context(j.id):
+                try:
+                    r = d._seq_runner(self, plan, j.spec.case, spec.niter)
+                    j._finish(r, None)
+                    any_ok = True
+                except Exception as e:  # noqa: BLE001 - per-job verdict
+                    j._finish(None, e)
             d._stream(j)
+        self.busy_s += time.perf_counter() - busy_t0
+        self.jobs_served += len(batch)
         if any_ok:
             self.failstreak = 0
         else:
@@ -298,7 +334,8 @@ class FleetDispatcher:
                  batch_runner: Optional[Callable] = None,
                  sequential_runner: Optional[Callable] = None,
                  on_result: Optional[Callable[[Job], None]] = None,
-                 autostart: bool = True):
+                 autostart: bool = True,
+                 monitor: Optional[str] = None):
         self.devices = list(devices) if devices is not None \
             else list(jax.devices())
         self.max_batch = max_batch
@@ -326,6 +363,13 @@ class FleetDispatcher:
         self._started = False
         self._shard_worker: Optional[threading.Thread] = None
         self._t0 = time.monotonic()
+        self._monitor_spec = monitor
+        self._monitor = None
+        # flight recorder on by default inside serve/: a crashed fleet
+        # yields a post-mortem ring dump even without a trace
+        self._flight_attached = True
+        tlive.flight_recorder().attach()
+        tlive.register_status("fleet", self._status)
 
     # -- admission ---------------------------------------------------------- #
 
@@ -334,11 +378,50 @@ class FleetDispatcher:
             if self._started:
                 return
             self._started = True
+        if self._monitor_spec is not None and self._monitor is None:
+            from tclb_tpu.telemetry.http import MonitorServer
+            self._monitor = MonitorServer.from_spec(
+                self._monitor_spec).start()
+            log.notice(f"fleet: monitor at {self._monitor.url}/status")
         for lane in self.lanes:
             lane.start()
         self._shard_worker = threading.Thread(
             target=self._sharded_loop, name="tclb-fleet-sharded", daemon=True)
         self._shard_worker.start()
+
+    @property
+    def monitor_url(self) -> Optional[str]:
+        """Base URL of the live monitor, or None when not enabled."""
+        return self._monitor.url if self._monitor is not None else None
+
+    def _status(self) -> dict:
+        """Plain-python /status fragment: per-lane occupancy, queue
+        depths, inflight job ages, evicted devices.  Reads only
+        thread-safe python state — monitor-thread safe by construction
+        (and enforced by hygiene.device_work_in_monitor)."""
+        now = time.monotonic()
+        wall = max(now - self._t0, 1e-9)
+        with self._lock:
+            inflight = [{"job_id": j.id, "name": j.spec.name,
+                         "status": j.status,
+                         "age_s": round(now - j.submitted, 3)}
+                        for j in list(self._inflight.values())[:64]]
+        return {
+            "queue_depth": self._queue.qsize(),
+            "sharded_queue_depth": self._sharded.qsize(),
+            "jobs_submitted": self._jobs,
+            "inflight": inflight,
+            "lanes": [{"lane": l.index, "device": l.device_str,
+                       "batches": l.batches, "jobs": l.jobs_served,
+                       "busy_s": round(l.busy_s, 6),
+                       "occupancy_pct": round(100.0 * l.busy_s / wall, 2),
+                       "failstreak": l.failstreak,
+                       "evicted": l.evicted} for l in self.lanes],
+            "evicted_devices": [l.device_str for l in self.lanes
+                                if l.evicted],
+            "uptime_s": round(wall, 3),
+            "closing": self._closing,
+        }
 
     def submit(self, spec: JobSpec, lane: Optional[int] = None) -> Job:
         """Route + enqueue one job; ``lane`` pins it to a specific lane
@@ -356,9 +439,13 @@ class FleetDispatcher:
         else:
             route, info = route_job(spec, len(self.devices),
                                     self.shard_min_work)
+        telemetry.event("serve.job_queued", job_id=job.id,
+                        name=spec.name, model=spec.model.name,
+                        shape=list(spec.shape), niter=int(spec.niter),
+                        route=route, reason=info.get("reason"))
         if route == "sharded":
             telemetry.event("serve.route_sharded", job=job.id,
-                            model=spec.model.name,
+                            job_id=job.id, model=spec.model.name,
                             shape=list(spec.shape), niter=int(spec.niter),
                             **info)
             telemetry.counter("serve.route_sharded")
@@ -424,6 +511,13 @@ class FleetDispatcher:
                         dur_s=round(now - self._t0, 6),
                         lanes=len(self.lanes), jobs=self._jobs,
                         evicted=sum(1 for l in self.lanes if l.evicted))
+        tlive.unregister_status("fleet", self._status)
+        if self._monitor is not None:
+            self._monitor.stop()
+            self._monitor = None
+        if self._flight_attached:
+            self._flight_attached = False
+            tlive.flight_recorder().detach()
 
     def __enter__(self) -> "FleetDispatcher":
         return self
@@ -509,11 +603,22 @@ class FleetDispatcher:
             jax.tree.map(lambda x: x.copy_to_host_async(), out)
         except Exception:  # noqa: BLE001 - an optimization, never a verdict
             pass
-        return plan.results_from(cases, out)
+        with telemetry.span("serve.d2h", lane=lane.index,
+                            batch=len(cases),
+                            job_ids=list(lane._current_job_ids)):
+            return plan.results_from(cases, out)
 
     # -- sharded rail ------------------------------------------------------- #
 
     def _sharded_loop(self) -> None:
+        try:
+            self._sharded_loop_inner()
+        except BaseException as e:  # noqa: BLE001 - post-mortem first
+            tlive.flight_recorder().dump("sharded_loop_exception",
+                                         error=repr(e))
+            raise
+
+    def _sharded_loop_inner(self) -> None:
         while True:
             try:
                 job = self._sharded.get(timeout=0.1)
@@ -539,11 +644,13 @@ class FleetDispatcher:
                 job.status = RUNNING
                 job.attempts += 1
                 spec = job.spec
-                with telemetry.span("serve.sharded_job",
-                                    model=spec.model.name,
-                                    shape=list(spec.shape),
-                                    niter=int(spec.niter),
-                                    devices=len(self.devices)) as sp:
+                with telemetry.job_context(job.id), \
+                        telemetry.span("serve.sharded_job",
+                                       model=spec.model.name,
+                                       shape=list(spec.shape),
+                                       niter=int(spec.niter),
+                                       devices=len(self.devices),
+                                       job_id=job.id) as sp:
                     result = self._run_sharded(spec)
                     sp.add(outcome="ok")
                 job._finish(result, None)
@@ -553,6 +660,8 @@ class FleetDispatcher:
                     # next rung of the ladder: one lane instead of the
                     # whole fleet
                     job.degraded = True
+                    telemetry.event("serve.job_degraded", job_id=job.id,
+                                    rail="sharded", error=repr(e))
                     telemetry.counter("serve.sharded.degraded")
                     log.warning(f"fleet: sharded job {job.id} failed "
                                 f"({e!r}); degrading to a single lane")
@@ -583,7 +692,17 @@ class FleetDispatcher:
 
     def _redistribute(self, batch: Sequence[Job]) -> None:
         """Hand an evicted lane's staged-but-unexecuted jobs back to the
-        shared queue for the surviving lanes."""
+        shared queue for the surviving lanes.  With no survivor left the
+        jobs fail here — re-queueing after the all-evicted drain would
+        strand them (nobody polls a dead fleet's queue)."""
+        if all(l.evicted for l in self.lanes):
+            for j in batch:
+                if not j._done.is_set():
+                    j._finish(None, RuntimeError(
+                        "fleet: all lanes evicted; no device can serve "
+                        "the job"))
+                    self._stream(j)
+            return
         for j in batch:
             j.status = PENDING
             if getattr(j, "pin", None) is not None:
@@ -609,6 +728,11 @@ class FleetDispatcher:
         self._inflight.pop(job.id, None)
         telemetry.counter("serve.jobs.done" if job.status == DONE
                           else "serve.jobs.failed")
+        telemetry.event(
+            "serve.job_done", job_id=job.id, status=job.status,
+            attempts=job.attempts, degraded=job.degraded,
+            wall_s=(None if job.finished_at is None else
+                    round(job.finished_at - job.submitted, 6)))
         if self._on_result is not None:
             try:
                 self._on_result(job)

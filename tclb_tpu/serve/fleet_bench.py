@@ -62,11 +62,25 @@ def make_specs(model, n: int, shape, niter: int) -> list[JobSpec]:
             for i in range(n)]
 
 
+def _scrape(url: str) -> tuple[int, str, str]:
+    """(status, content-type, body) for one monitor endpoint."""
+    import urllib.request
+    with urllib.request.urlopen(url, timeout=10) as r:
+        return (r.status, r.headers.get("Content-Type", ""),
+                r.read().decode("utf-8"))
+
+
 def run_fleet(jobs: int = 16, shape=(24, 32), niter: int = 60,
               max_batch: int = 2, repeats: int = 2,
               overlap_batches: int = 4, smoke: bool = False,
-              trace_out: Optional[str] = None) -> dict:
-    """Run the fleet workload; returns the JSON-ready result doc."""
+              trace_out: Optional[str] = None,
+              monitor: Optional[str] = None) -> dict:
+    """Run the fleet workload; returns the JSON-ready result doc.
+
+    With ``monitor`` set (a ``[host]:port`` spec; port 0 picks a free
+    one) the telemetry-phase dispatcher serves the live HTTP plane and
+    the workload scrapes ``/metrics`` + ``/status`` mid-run, embedding
+    the scrape verdicts in the result doc — the CI smoke asserts them."""
     import jax
     devices = jax.devices()
     n_dev = len(devices)
@@ -128,8 +142,31 @@ def run_fleet(jobs: int = 16, shape=(24, 32), niter: int = 60,
         big = JobSpec(model=model, shape=big_shape,
                       case=Case(settings={"nu": 0.05}, name="big"),
                       niter=big_niter, base_settings={"nu": 0.05})
-        fleet2 = FleetDispatcher(max_batch=max_batch, shard_min_work=floor)
-        fjobs = fleet2.run(tel_specs)
+        fleet2 = FleetDispatcher(max_batch=max_batch, shard_min_work=floor,
+                                 monitor=monitor)
+        if monitor is not None:
+            # async submit so the scrape sees jobs genuinely in flight
+            fjobs = [fleet2.submit(s) for s in tel_specs]
+            fleet2.start()
+            from tclb_tpu.telemetry import live as tlive
+            st, ctype, body = _scrape(fleet2.monitor_url + "/metrics")
+            doc["monitor_metrics_ok"] = bool(
+                st == 200 and ctype == tlive.CONTENT_TYPE
+                and "tclb_" in body)
+            st, _ctype, body = _scrape(fleet2.monitor_url + "/status")
+            status = json.loads(body) if st == 200 else {}
+            fstat = status.get("fleet") or {}
+            doc["monitor_status_ok"] = bool(
+                st == 200 and len(fstat.get("lanes", [])) == n_dev)
+            doc["monitor_status_jobs_submitted"] = \
+                fstat.get("jobs_submitted")
+            for j in fjobs:
+                try:
+                    j.result()
+                except Exception:  # noqa: BLE001 - surfaced on handle
+                    pass
+        else:
+            fjobs = fleet2.run(tel_specs)
         bjob = fleet2.submit(big)
         try:
             bjob.result(timeout=600)
@@ -187,10 +224,15 @@ def main(argv=None) -> int:
     p.add_argument("--repeats", type=int, default=2)
     p.add_argument("--trace-out", default=None,
                    help="keep the telemetry trace at this path")
+    p.add_argument("--monitor", default=None, metavar="[HOST]:PORT",
+                   help="serve the live HTTP monitor during the "
+                   "telemetry phase and scrape it mid-run (port 0 "
+                   "picks a free one)")
     args = p.parse_args(argv)
     doc = run_fleet(jobs=args.jobs, niter=args.niter,
                     max_batch=args.max_batch, repeats=args.repeats,
-                    smoke=args.smoke, trace_out=args.trace_out)
+                    smoke=args.smoke, trace_out=args.trace_out,
+                    monitor=args.monitor)
     print(json.dumps(doc, indent=2, sort_keys=True))
     return 0
 

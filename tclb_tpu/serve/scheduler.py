@@ -32,6 +32,7 @@ import jax.numpy as jnp
 import numpy as np
 
 from tclb_tpu import telemetry
+from tclb_tpu.telemetry import live as tlive
 from tclb_tpu.core.registry import Model
 from tclb_tpu.ops import fusion
 from tclb_tpu.serve.cache import CompiledCache
@@ -170,6 +171,11 @@ class Scheduler:
         # every live handle, so close() can sweep jobs whose timeout
         # fires while the worker is stuck or the queue never drains
         self._inflight: dict[int, Job] = {}
+        # flight recorder on by default inside serve/: a crashed serving
+        # process yields a post-mortem ring dump even without a trace
+        self._flight_attached = True
+        tlive.flight_recorder().attach()
+        tlive.register_status("scheduler", self._status)
 
     # -- admission ---------------------------------------------------------- #
 
@@ -193,6 +199,9 @@ class Scheduler:
             self._inflight[job.id] = job
         self._queue.put(job)
         telemetry.counter("serve.jobs.submitted")
+        telemetry.event("serve.job_queued", job_id=job.id,
+                        name=spec.name, model=spec.model.name,
+                        shape=list(spec.shape), niter=int(spec.niter))
         if self.autostart:
             self.start()
         return job
@@ -209,8 +218,25 @@ class Scheduler:
                 pass
         return jobs
 
+    def _status(self) -> dict:
+        """Plain-python /status fragment (monitor-thread safe)."""
+        now = time.monotonic()
+        with self._lock:
+            inflight = [{"job_id": j.id, "name": j.spec.name,
+                         "status": j.status,
+                         "age_s": round(now - j.submitted, 3)}
+                        for j in list(self._inflight.values())[:64]]
+        return {"queue_depth": self._queue.qsize(),
+                "jobs_submitted": self._jobs,
+                "inflight": inflight,
+                "closing": self._closing}
+
     def close(self, wait: bool = True, join_timeout: float = 60.0) -> None:
         self._closing = True
+        tlive.unregister_status("scheduler", self._status)
+        if self._flight_attached:
+            self._flight_attached = False
+            tlive.flight_recorder().detach()
         if wait and self._worker is not None:
             self._worker.join(timeout=join_timeout)
         # close/timeout race: a job whose deadline passes while close is
@@ -290,6 +316,9 @@ class Scheduler:
             try:
                 self._serve_batch(batch)
             except BaseException as e:  # noqa: BLE001 - never kill the loop
+                tlive.flight_recorder().dump(
+                    "scheduler_exception", error=repr(e),
+                    job_ids=[j.id for j in batch])
                 for j in batch:
                     if not j._done.is_set():
                         j._finish(None, e)
@@ -320,10 +349,12 @@ class Scheduler:
         waits = [round(now - j.submitted, 6) for j in live]
         for j in live:
             j.status = RUNNING
+        job_ids = [j.id for j in live]
+        telemetry.set_job(job_ids[0] if len(job_ids) == 1 else None)
         with telemetry.span("serve.batch", batch=len(live), capacity=cap,
                             model=spec.model.name, niter=int(spec.niter),
                             engine=plan.engine_tag(len(live)),
-                            wait_s=waits) as sp:
+                            wait_s=waits, job_ids=job_ids) as sp:
             results: Optional[list[EnsembleResult]] = None
             err: Optional[BaseException] = None
             for attempt in range(1 + self.retries):
@@ -341,7 +372,8 @@ class Scheduler:
                                     f"(attempt {attempt + 1}): {e!r}; "
                                     "retrying")
             if results is not None:
-                sp.add(outcome="ok")
+                sp.add(outcome="ok", retries=attempt)
+                telemetry.set_job(None)
                 for j, r in zip(live, results):
                     j._finish(r, None)
                     self._stream(j)
@@ -354,19 +386,28 @@ class Scheduler:
             log.warning(f"serve: batched run failed after "
                         f"{1 + self.retries} attempts ({err!r}); "
                         f"degrading {len(live)} job(s) to sequential")
+        telemetry.set_job(None)
         for j in live:
             j.degraded = True
-            try:
-                r = self._seq_runner(plan, j.spec.case, spec.niter)
-                j._finish(r, None)
-            except Exception as e:  # noqa: BLE001 - per-job verdict
-                j._finish(None, e)
+            telemetry.event("serve.job_degraded", job_id=j.id,
+                            error=repr(err))
+            with telemetry.job_context(j.id):
+                try:
+                    r = self._seq_runner(plan, j.spec.case, spec.niter)
+                    j._finish(r, None)
+                except Exception as e:  # noqa: BLE001 - per-job verdict
+                    j._finish(None, e)
             self._stream(j)
 
     def _stream(self, job: Job) -> None:
         self._inflight.pop(job.id, None)
         telemetry.counter("serve.jobs.done" if job.status == DONE
                           else "serve.jobs.failed")
+        telemetry.event(
+            "serve.job_done", job_id=job.id, status=job.status,
+            attempts=job.attempts, degraded=job.degraded,
+            wall_s=(None if job.finished_at is None else
+                    round(job.finished_at - job.submitted, 6)))
         if self._on_result is not None:
             try:
                 self._on_result(job)

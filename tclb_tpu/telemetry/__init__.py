@@ -10,15 +10,21 @@ Usage (a trace costs nothing unless asked for):
   sp.sync(out)`` — honest wall-time (``block_until_ready`` fencing),
   MLUPS / vs-roofline derived metrics, ``jax.profiler.TraceAnnotation``
   passthrough;
-* ``telemetry.counter(name)`` — monotonic counters, flushed on close;
+* ``telemetry.counter(name)`` — monotonic counters, snapshotted
+  periodically and flushed on close;
+* ``telemetry.subscribe(fn)`` — fan the event stream out to extra sinks
+  (the live metrics registry and the flight recorder in telemetry/live.py
+  are subscribers; the monitor endpoint in telemetry/http.py serves
+  their snapshots over ``/metrics`` + ``/status``);
 * ``python -m tclb_tpu.telemetry report trace.jsonl [--format text|json]
-  [--compare other.jsonl]`` — per-engine/per-span aggregation and trace
-  diffing (see telemetry/report.py).
+  [--compare other.jsonl] [--job ID]`` — per-engine/per-span aggregation,
+  trace diffing, and per-job timelines (see telemetry/report.py).
 """
 
 from tclb_tpu.telemetry.events import (  # noqa: F401
-    counter, counters, disable, enable, enabled, engine_fallback,
-    engine_selected, event, failcheck, path)
+    counter, counters, current_job, disable, enable, enabled,
+    engine_fallback, engine_selected, event, failcheck, job_context,
+    path, set_job, subscribe, unsubscribe)
 from tclb_tpu.telemetry.spans import (  # noqa: F401
     HBM_GBS, NOOP_SPAN, Span, device_kind, fuse_of, roofline_mlups,
     span)

@@ -1,26 +1,31 @@
-"""Structured telemetry: the process-wide JSONL event sink.
+"""Structured telemetry: a process-wide fan-out of typed events.
 
 The reference ships real observability — the per-iteration globals CSV
 (``cbLog``), NaN failchecks (``cbFailcheck``) and in-situ Catalyst
 monitoring — but all of it is human-facing output.  This module is the
-machine-facing counterpart: one append-only JSONL stream of typed events
-(``{"kind": ..., "ts": ...}`` per line) that the report CLI
-(``python -m tclb_tpu.telemetry report``) aggregates into per-engine /
-per-span attributions.
+machine-facing counterpart: one stream of typed events
+(``{"kind": ..., "ts": ...}`` per record) fanned out to pluggable sinks.
+The original append-only JSONL file sink (``TCLB_TELEMETRY`` /
+:func:`enable`) is one subscriber; the live metrics registry and the
+flight recorder (:mod:`tclb_tpu.telemetry.live`) are others.
 
 Design constraints:
 
 * **no-op when disabled** — every entry point starts with an ``enabled()``
-  check (a single attribute test); nothing is imported, opened, synced or
-  allocated on the disabled path, so instrumented hot seams cost nothing
-  in production runs that don't ask for a trace;
-* **process-wide** — one sink shared by every Lattice/Solver in the
-  process, selected via the ``TCLB_TELEMETRY`` environment variable at
-  import or :func:`enable` at runtime (the reference's equivalent switch
-  is its compile-time logging level);
+  check (a single boolean test); nothing is imported, opened, synced or
+  allocated while no sink is subscribed, so instrumented hot seams cost
+  nothing in production runs that don't ask for a trace or a monitor;
+* **process-wide** — one fan-out shared by every Lattice/Solver in the
+  process; the JSONL sink is selected via the ``TCLB_TELEMETRY``
+  environment variable at import or :func:`enable` at runtime (the
+  reference's equivalent switch is its compile-time logging level);
 * **append-only JSONL** — one self-describing JSON object per line, so a
   crashed run still yields a readable (truncated) trace and two traces
-  diff line-wise.
+  diff line-wise;
+* **counters survive abnormal exits** — cumulative ``counters`` snapshots
+  are emitted every ``COUNTER_SNAPSHOT_S`` seconds (piggybacked on event
+  traffic), so a SIGKILLed run's trace still carries counter totals; the
+  final flush on :func:`disable` remains authoritative.
 """
 
 from __future__ import annotations
@@ -30,30 +35,49 @@ import json
 import os
 import threading
 import time
-from typing import Any, Optional, TextIO
+from typing import Any, Callable, Iterator, Optional, TextIO
+from contextlib import contextmanager
 
 SCHEMA_VERSION = 1
 
-_lock = threading.Lock()
-_sink: Optional[TextIO] = None
+#: cadence of cumulative ``counters`` snapshots (seconds); snapshots ride
+#: on event traffic, so an idle process emits none
+COUNTER_SNAPSHOT_S = 5.0
+
+#: arrays larger than this are summarized (shape/dtype) instead of being
+#: serialized element-wise into the trace
+MAX_INLINE_ELEMS = 64
+
+_lock = threading.RLock()
+_subscribers: list[Callable[[dict], None]] = []
+_enabled = False                    # single-boolean gate: bool(_subscribers)
+_sink: Optional[TextIO] = None      # the JSONL file sink (one subscriber)
 _path: Optional[str] = None
 _counters: dict[str, float] = {}
+_counters_last_emit = 0.0           # monotonic ts of the last snapshot
 _atexit_registered = False
+_job_local = threading.local()      # per-thread active job id (correlation)
 
 
 def enabled() -> bool:
-    """Fast check instrumentation sites gate on (a plain attribute test)."""
-    return _sink is not None
+    """Fast check instrumentation sites gate on (a plain boolean test)."""
+    return _enabled
 
 
 def path() -> Optional[str]:
-    """The active trace path, or None when disabled."""
+    """The active JSONL trace path, or None when the file sink is off."""
     return _path
 
 
 def _json_default(obj: Any):
     # numpy / jax scalars and arrays reach here from instrumentation
-    # sites; keep the trace readable rather than crash the run
+    # sites; keep the trace readable rather than crash the run — and
+    # never serialize a whole lattice field into one trace line
+    shape = getattr(obj, "shape", None)
+    size = getattr(obj, "size", None)
+    if shape is not None and isinstance(size, int) and size > MAX_INLINE_ELEMS:
+        return ("<array shape=%s dtype=%s>"
+                % (tuple(shape), getattr(obj, "dtype", "?")))
     for attr in ("item", "tolist"):
         fn = getattr(obj, attr, None)
         if callable(fn):
@@ -61,7 +85,48 @@ def _json_default(obj: Any):
                 return fn()
             except Exception:  # noqa: BLE001 — e.g. .item() on an array
                 continue
-    return str(obj)
+    s = str(obj)
+    if len(s) > 512:
+        s = s[:512] + "...(+%d chars)" % (len(s) - 512)
+    return s
+
+
+# -- sink fan-out ------------------------------------------------------------- #
+
+
+def subscribe(fn: Callable[[dict], None]) -> None:
+    """Register ``fn(doc)`` to receive every event document.  Subscribers
+    run under the module lock and must be fast and never call back into
+    this module's emitters; exceptions are swallowed per-sink."""
+    global _enabled
+    with _lock:
+        if fn not in _subscribers:
+            _subscribers.append(fn)
+        _enabled = True
+
+
+def unsubscribe(fn: Callable[[dict], None]) -> None:
+    """Remove a subscriber (idempotent); recomputes the enabled gate."""
+    global _enabled
+    with _lock:
+        try:
+            _subscribers.remove(fn)
+        except ValueError:
+            pass
+        _enabled = bool(_subscribers)
+
+
+def _fanout_locked(doc: dict) -> None:
+    for fn in list(_subscribers):
+        try:
+            fn(doc)
+        except Exception:  # noqa: BLE001 — one bad sink must not kill others
+            pass
+
+
+def _jsonl_write(doc: dict) -> None:
+    if _sink is not None:
+        _sink.write(json.dumps(doc, default=_json_default) + "\n")
 
 
 def enable(trace_path: str) -> None:
@@ -77,6 +142,10 @@ def enable(trace_path: str) -> None:
         os.makedirs(d, exist_ok=True)
         _sink = open(trace_path, "a", buffering=1)  # line-buffered
         _path = trace_path
+        # counters are session-scoped: a fresh JSONL session must not
+        # inherit bumps recorded while only live sinks were attached
+        _counters.clear()
+        subscribe(_jsonl_write)
         if not _atexit_registered:
             atexit.register(disable)
             _atexit_registered = True
@@ -90,8 +159,8 @@ def _close_locked() -> None:
     if _sink is None:
         return
     if _counters:
-        _write_locked({"kind": "counters", "ts": round(time.time(), 6),
-                       "counters": dict(_counters)})
+        _fanout_locked({"kind": "counters", "ts": round(time.time(), 6),
+                        "counters": dict(_counters), "final": True})
         _counters.clear()
     try:
         _sink.close()
@@ -99,36 +168,39 @@ def _close_locked() -> None:
         pass
     _sink = None
     _path = None
+    unsubscribe(_jsonl_write)
 
 
 def disable() -> None:
-    """Flush counters, close the sink, and stop recording (idempotent)."""
+    """Flush counters, close the JSONL sink, and stop file recording
+    (idempotent).  Other subscribers (registry, flight recorder) stay,
+    but the counter session ends here either way."""
     with _lock:
         _close_locked()
-
-
-def _write_locked(doc: dict) -> None:
-    assert _sink is not None
-    _sink.write(json.dumps(doc, default=_json_default) + "\n")
+        _counters.clear()
 
 
 def event(kind: str, **fields: Any) -> None:
     """Emit one structured event; silently a no-op when disabled."""
-    if _sink is None:
+    if not _enabled:
         return
     doc = {"kind": kind, "ts": round(time.time(), 6)}
     doc.update(fields)
     with _lock:
-        if _sink is not None:
-            _write_locked(doc)
+        _maybe_snapshot_counters_locked()
+        _fanout_locked(doc)
 
 
 def counter(name: str, inc: float = 1) -> None:
-    """Bump a monotonic process counter (flushed as one ``counters``
-    event when the sink closes); no-op when disabled."""
-    if _sink is None:
+    """Bump a monotonic process counter (snapshotted periodically and
+    flushed as a final ``counters`` event when the JSONL sink closes);
+    no-op when disabled."""
+    global _counters_last_emit
+    if not _enabled:
         return
     with _lock:
+        if not _counters:
+            _counters_last_emit = time.monotonic()
         _counters[name] = _counters.get(name, 0) + inc
 
 
@@ -136,6 +208,48 @@ def counters() -> dict[str, float]:
     """Snapshot of the live counters (empty when disabled)."""
     with _lock:
         return dict(_counters)
+
+
+def _maybe_snapshot_counters_locked() -> None:
+    # Counter loss on abnormal exit: the final flush in _close_locked
+    # never happens on SIGKILL, so piggyback a cumulative snapshot on
+    # event traffic every COUNTER_SNAPSHOT_S seconds.  Snapshots are
+    # cumulative, so the report aggregates them with per-session max.
+    global _counters_last_emit
+    if not _counters:
+        return
+    now = time.monotonic()
+    if now - _counters_last_emit < COUNTER_SNAPSHOT_S:
+        return
+    _counters_last_emit = now
+    _fanout_locked({"kind": "counters", "ts": round(time.time(), 6),
+                    "counters": dict(_counters)})
+
+
+# -- job correlation ---------------------------------------------------------- #
+# serve/ threads stamp the job id they are working for; emitters below
+# (failcheck) pick it up so post-mortems localize without cross-referencing.
+
+
+def set_job(job_id: Optional[Any]) -> None:
+    """Set (or clear, with None) the active job id for this thread."""
+    _job_local.job_id = job_id
+
+
+def current_job() -> Optional[Any]:
+    """The active job id for this thread, or None."""
+    return getattr(_job_local, "job_id", None)
+
+
+@contextmanager
+def job_context(job_id: Any) -> Iterator[None]:
+    """Scope the active job id for the calling thread."""
+    prev = current_job()
+    set_job(job_id)
+    try:
+        yield
+    finally:
+        set_job(prev)
 
 
 # -- named emitters ---------------------------------------------------------- #
@@ -160,7 +274,12 @@ def engine_fallback(from_engine: str, to_engine: str, cause: str,
 
 
 def failcheck(**fields: Any) -> None:
-    """A NaN/Inf failcheck fired.  Fields: iteration, quantity, n_bad."""
+    """A NaN/Inf failcheck fired.  Fields: iteration, quantity, n_bad,
+    engine.  The active job id (when a serve thread set one) is stamped
+    automatically."""
+    jid = current_job()
+    if jid is not None and "job_id" not in fields:
+        fields["job_id"] = jid
     event("failcheck", **fields)
 
 

@@ -1,0 +1,144 @@
+"""HTTP monitor endpoint: ``/metrics``, ``/status``, ``/trace?secs=N``.
+
+A stdlib-threaded (``http.server.ThreadingHTTPServer``) monitor attached
+to a running solve or serving fleet via ``--monitor [host]:port``
+(``python -m tclb_tpu run``) or ``FleetDispatcher(monitor=...)``:
+
+* ``GET /metrics`` — Prometheus text exposition (format 0.0.4) of the
+  in-process registry plus ``telemetry.counter`` totals;
+* ``GET /status``  — JSON: per-lane occupancy, queue depth, inflight jobs
+  with ages, last-iterate MLUPS/engine tag, checkpoint age, evicted
+  devices, flight-recorder state;
+* ``GET /trace?secs=N`` — kick an on-demand profiler capture to a named
+  artifact dir (runs on a background thread, not the handler).
+
+Hygiene contract (enforced by ``analysis.hygiene.device_work_in_monitor``):
+nothing in this module may touch jax, ``device_put``, or ``Lattice``
+state — the handler thread reads only plain-python registry snapshots,
+so a scrape can never fence, allocate on, or deadlock a device.
+"""
+
+from __future__ import annotations
+
+import json
+import threading
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from typing import Optional
+from urllib.parse import parse_qs, urlparse
+
+from tclb_tpu.telemetry import live
+
+_INDEX = (b"tclb_tpu monitor\n"
+          b"  /metrics        Prometheus text exposition\n"
+          b"  /status         JSON process status\n"
+          b"  /trace?secs=N   on-demand profiler capture\n")
+
+
+class _Handler(BaseHTTPRequestHandler):
+    server_version = "tclb-monitor"
+
+    def log_message(self, fmt, *args):  # noqa: D102 — silence stderr
+        pass
+
+    def _send(self, code: int, body: bytes, ctype: str) -> None:
+        self.send_response(code)
+        self.send_header("Content-Type", ctype)
+        self.send_header("Content-Length", str(len(body)))
+        self.end_headers()
+        self.wfile.write(body)
+
+    def _send_json(self, code: int, doc: dict) -> None:
+        body = json.dumps(doc, indent=2, default=str).encode()
+        self._send(code, body + b"\n", "application/json")
+
+    def do_GET(self):  # noqa: N802 — http.server API
+        url = urlparse(self.path)
+        route = url.path.rstrip("/") or "/"
+        try:
+            if route == "/metrics":
+                self._send(200, live.prometheus_text().encode(),
+                           live.CONTENT_TYPE)
+            elif route == "/status":
+                self._send_json(200, live.status_snapshot())
+            elif route == "/trace":
+                qs = parse_qs(url.query)
+                secs = float(qs.get("secs", ["3"])[0])
+                try:
+                    outdir = live.capture_profile(secs)
+                except RuntimeError as e:
+                    self._send_json(409, {"error": str(e)})
+                    return
+                self._send_json(200, {"artifact_dir": outdir,
+                                      "secs": secs, "started": True})
+            elif route == "/":
+                self._send(200, _INDEX, "text/plain; charset=utf-8")
+            else:
+                self._send_json(404, {"error": "no such route",
+                                      "routes": ["/metrics", "/status",
+                                                 "/trace"]})
+        except BrokenPipeError:  # pragma: no cover — client went away
+            pass
+        except Exception as e:  # noqa: BLE001 — a scrape must never kill
+            try:                # the process it is observing
+                self._send_json(500, {"error": repr(e)})
+            except Exception:  # noqa: BLE001
+                pass
+
+
+class MonitorServer:
+    """The live monitor: a daemon-threaded HTTP server over the metrics
+    registry.  ``start()`` subscribes the registry to the event fan-out
+    (refcounted); ``stop()`` releases it."""
+
+    def __init__(self, host: str = "127.0.0.1", port: int = 0) -> None:
+        self.host = host
+        self.port = port
+        self._server: Optional[ThreadingHTTPServer] = None
+        self._thread: Optional[threading.Thread] = None
+
+    @classmethod
+    def from_spec(cls, spec: str) -> "MonitorServer":
+        """Build from a ``[host]:port`` string (see
+        :func:`live.parse_monitor_spec`)."""
+        host, port = live.parse_monitor_spec(spec)
+        return cls(host=host, port=port)
+
+    @property
+    def url(self) -> str:
+        return "http://%s:%d" % (self.host, self.port)
+
+    def start(self) -> "MonitorServer":
+        if self._server is not None:
+            return self
+        live.enable_live()
+        try:
+            srv = ThreadingHTTPServer((self.host, self.port), _Handler)
+        except Exception:
+            live.disable_live()
+            raise
+        srv.daemon_threads = True
+        self._server = srv
+        self.port = srv.server_address[1]
+        self._thread = threading.Thread(target=srv.serve_forever,
+                                        name="tclb-monitor", daemon=True)
+        self._thread.start()
+        return self
+
+    def stop(self) -> None:
+        srv, self._server = self._server, None
+        if srv is None:
+            return
+        try:
+            srv.shutdown()
+            srv.server_close()
+        finally:
+            live.disable_live()
+        if self._thread is not None:
+            self._thread.join(timeout=5)
+            self._thread = None
+
+    def __enter__(self) -> "MonitorServer":
+        return self.start()
+
+    def __exit__(self, *exc) -> None:
+        self.stop()

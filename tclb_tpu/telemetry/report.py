@@ -157,7 +157,17 @@ def summarize(evts: list[dict]) -> dict:
     fallbacks: list[dict] = []
     failchecks: list[dict] = []
     cnt: dict[str, float] = {}
+    sess_cnt: dict[str, float] = {}
     kinds: dict[str, int] = {}
+
+    def _fold_session() -> None:
+        # counters snapshots are cumulative within one enable()..disable()
+        # session (periodic + final flush), so a session contributes its
+        # max per key; sessions (delimited by trace_start) add up
+        for k, v in sess_cnt.items():
+            cnt[k] = cnt.get(k, 0) + v
+        sess_cnt.clear()
+
     for e in evts:
         kind = e.get("kind", "")
         kinds[kind] = kinds.get(kind, 0) + 1
@@ -189,9 +199,12 @@ def summarize(evts: list[dict]) -> dict:
             fallbacks.append(e)
         elif kind == "failcheck":
             failchecks.append(e)
+        elif kind == "trace_start":
+            _fold_session()
         elif kind == "counters":
             for k, v in (e.get("counters") or {}).items():
-                cnt[k] = cnt.get(k, 0) + v
+                sess_cnt[k] = max(sess_cnt.get(k, 0), v)
+    _fold_session()
     for s in spans.values():
         s["total_s"] = round(s["total_s"], 6)
         s["mean_s"] = round(s["total_s"] / max(s["count"], 1), 6)
@@ -342,6 +355,69 @@ def compare(base: dict, other: dict, threshold: float = 0.05) -> dict:
             out["regressions"].append({
                 "what": "new_fallbacks", "fallbacks": new})
     return out
+
+
+# -- per-job timeline --------------------------------------------------------- #
+
+
+def job_events(evts: list[dict], job_id) -> list[dict]:
+    """Every event attributed to ``job_id`` — via its own ``job_id`` /
+    ``job`` field or membership in a batch's ``job_ids`` list."""
+    jid = str(job_id)
+    out = []
+    for e in evts:
+        ids = {str(e[k]) for k in ("job_id", "job") if e.get(k) is not None}
+        ids.update(str(x) for x in (e.get("job_ids") or ()))
+        if jid in ids:
+            out.append(e)
+    return out
+
+
+_TIMELINE_VERBS = {
+    "serve.job_queued": "queued",
+    "serve.stage": "staged",
+    "serve.batch": "dispatched",
+    "serve.lane_batch": "dispatched",
+    "serve.d2h": "d2h",
+    "serve.sharded_job": "sharded",
+    "serve.route_sharded": "routed",
+    "serve.job_degraded": "degraded",
+    "serve.job_done": "done",
+    "failcheck": "failcheck",
+}
+
+
+def format_job_timeline(evts: list[dict], job_id) -> str:
+    """One job's end-to-end timeline (queued -> staged -> dispatched ->
+    d2h -> done, with retries/degrades/failchecks), offsets relative to
+    its first event.  Span rows are placed at their *start* time
+    (``ts - dur_s``; the trace stamps spans on exit)."""
+    rows = job_events(evts, job_id)
+    if not rows:
+        return f"job {job_id}: no matching events in trace"
+
+    def start_ts(e: dict) -> float:
+        ts = float(e.get("ts", 0.0))
+        if e.get("kind") == "span" and e.get("dur_s") is not None:
+            return ts - float(e["dur_s"])
+        return ts
+
+    rows = sorted(rows, key=start_ts)
+    t0 = start_ts(rows[0])
+    lines = [f"job {job_id} timeline ({len(rows)} events)"]
+    skip = {"kind", "ts", "name", "job_id", "job", "job_ids", "dur_s"}
+    for e in rows:
+        kind = e.get("kind")
+        label = e.get("name") if kind == "span" else kind
+        verb = _TIMELINE_VERBS.get(label, label)
+        fields = " ".join(f"{k}={e[k]}" for k in e if k not in skip)
+        if len(fields) > 120:
+            fields = fields[:120] + "..."
+        dur = (f"  ({float(e['dur_s']):.4f}s)"
+               if e.get("dur_s") is not None else "")
+        lines.append(f"  +{start_ts(e) - t0:8.4f}s  {verb:<11} "
+                     f"{fields}{dur}")
+    return "\n".join(lines)
 
 
 # -- rendering --------------------------------------------------------------- #
@@ -523,13 +599,22 @@ def main(argv: Optional[list] = None) -> int:
                          "(default 0.05)")
     rp.add_argument("--fail-on-regression", action="store_true",
                     help="exit 4 if the comparison finds regressions")
+    rp.add_argument("--job", metavar="ID", default=None,
+                    help="render one job's end-to-end timeline instead "
+                         "of the aggregate report (exit 3 if the trace "
+                         "has no events for that job)")
     args = p.parse_args(argv)
 
     try:
-        base = summarize(load(args.trace))
+        evts = load(args.trace)
     except OSError as e:
         print(f"error: cannot read {args.trace}: {e}", file=sys.stderr)
         return 2
+    if args.job is not None:
+        txt = format_job_timeline(evts, args.job)
+        print(txt)
+        return 3 if not job_events(evts, args.job) else 0
+    base = summarize(evts)
     if args.compare is None:
         if args.format == "json":
             print(json.dumps(base, indent=2, sort_keys=True))

@@ -9,8 +9,13 @@ as long as no backend has been initialized yet.
 """
 
 import os
+import tempfile
 
 os.environ["JAX_PLATFORMS"] = "cpu"
+# flight-recorder dumps (telemetry/live.py) go to a scratch dir, not the
+# repo checkout, when eviction/failcheck tests trigger them
+os.environ.setdefault("TCLB_FLIGHT_DIR",
+                      tempfile.mkdtemp(prefix="tclb-flight-"))
 _flags = os.environ.get("XLA_FLAGS", "")
 if "xla_force_host_platform_device_count" not in _flags:
     os.environ["XLA_FLAGS"] = (

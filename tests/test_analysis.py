@@ -347,6 +347,42 @@ def test_hygiene_fires_on_unpinned_device_put(tmp_path):
     assert hygiene.scan_unpinned_device_put() == []
 
 
+def test_hygiene_fires_on_device_work_in_monitor(tmp_path):
+    """The HTTP monitor must be structurally jax-free: a handler thread
+    that calls into jax (or touches a Lattice) can deadlock against the
+    solve loop's dispatch mid-scrape."""
+    p = tmp_path / "http.py"
+    p.write_text(
+        "import jax\n"                              # flagged: import
+        "from jax import device_put\n"              # flagged: import fn
+        "from tclb_tpu.core.lattice import Lattice\n"  # flagged: Lattice
+        "def scrape(x):\n"
+        "    jax.block_until_ready(x)\n"       # flagged: jax.attr + call
+        "    return device_put(x)\n"                # flagged: call
+        "def fine():\n"
+        "    return {'ok': True}\n")
+    fs = hygiene.scan_device_work_in_monitor(paths=[str(p)])
+    assert fs, "expected findings on the poisoned monitor module"
+    assert all(f.check == "hygiene.device_work_in_monitor" for f in fs)
+    assert all(f.severity == "error" for f in fs)
+    joined = " ".join(f.message for f in fs)
+    assert "imports jax" in joined
+    assert "device_put" in joined
+    assert "Lattice" in joined
+    assert "block_until_ready" in joined
+
+    # a clean snapshot-reading module passes
+    q = tmp_path / "clean.py"
+    q.write_text(
+        "from tclb_tpu.telemetry import live\n"
+        "def scrape():\n"
+        "    return live.status_snapshot()\n")
+    assert hygiene.scan_device_work_in_monitor(paths=[str(q)]) == []
+
+    # the shipped monitor module itself must be clean
+    assert hygiene.scan_device_work_in_monitor() == []
+
+
 # --------------------------------------------------------------------------- #
 # Finding mechanics / fingerprints
 # --------------------------------------------------------------------------- #

@@ -59,6 +59,13 @@ BENCH_FLOORS = {
     # or the compiled-executable cache regressed.  TPU-gated like every
     # floor; the CPU smoke run prints the number informationally.
     "ensemble_speedup_b32": 2.0,
+    # gradient serving: a width-8 line-search fan batched into ONE
+    # dispatch of the lax.map'd VJP executable vs the same 8 evals as
+    # cached batch-1 dispatches.  The grad bin exists to amortize the
+    # per-dispatch round trip across the fan — under 2x the serial rate
+    # means GradSpec binning or the AOT VJP cache regressed.  TPU-gated
+    # like every floor; the CPU smoke prints the ratio informationally.
+    "grad_batch_speedup": 2.0,
     # precision ladder: MLUPS(bf16 storage) / MLUPS(f32 storage) on the
     # same engine+geometry.  Halving the field bytes cuts the per-node
     # traffic from 2*Q*4+2 to 2*Q*2+2, so a bandwidth-bound engine must
@@ -381,6 +388,124 @@ def bench_adjoint(results):
     return []
 
 
+def bench_unsteady_adjoint(results):
+    """Production unsteady adjoint: the revolve-checkpointed gradient
+    (adjoint/revolve — binomial schedule, host-mem snapshot tier) at a
+    fixed snapshot budget S, reported as gradient MLUPS-primal-
+    equivalents plus the sweep's measured recompute factor (which must
+    track the planner's binomial bound — a drift means the executor is
+    re-advancing segments it already paid for).  CPU runs a small smoke
+    geometry informationally; TPU runs the production shape."""
+    import jax
+    import jax.numpy as jnp
+    from tclb_tpu.adjoint import InternalTopology, make_revolve_gradient
+    from tclb_tpu.core.lattice import Lattice
+    from tclb_tpu.models import get_model
+
+    on_tpu = jax.default_backend() == "tpu"
+    ny, nx = (512, 1024) if on_tpu else (64, 128)
+    niter = int(os.environ.get("TCLB_BENCH_ITERS_REVOLVE",
+                               1000 if on_tpu else 48))
+    snaps = int(os.environ.get("TCLB_BENCH_REVOLVE_SNAPSHOTS", 8))
+    m = get_model("d2q9_adj")
+    lat = Lattice(m, (ny, nx), dtype=jnp.float32,
+                  settings={"nu": 0.1, "Velocity": 0.05, "Porocity": 0.5,
+                            "DragInObj": 1.0})
+    flags = np.full((ny, nx), m.flag_for("MRT"), dtype=np.uint16)
+    flags[:, 0] = m.flag_for("WVelocity", "MRT")
+    flags[:, -1] = m.flag_for("EPressure", "MRT")
+    flags[0, :] = flags[-1, :] = m.flag_for("Wall")
+    flags[ny // 4:3 * ny // 4, nx // 3:2 * nx // 3] |= \
+        m.flag_for("DesignSpace")
+    lat.set_flags(flags)
+    lat.init()
+    design = InternalTopology(m)
+    theta0 = design.get(lat.state, lat.params)
+    try:
+        rev = make_revolve_gradient(m, design, niter, snapshots=snaps,
+                                    engine="auto", shape=(ny, nx),
+                                    dtype=jnp.float32)
+        obj, g, _ = rev(theta0, lat.state, lat.params)
+        float(obj)                                    # warmup / compile
+        t0 = time.perf_counter()
+        obj, g, _ = rev(theta0, lat.state, lat.params)
+        s = float(obj) + float(jnp.sum(g))
+        dt = time.perf_counter() - t0
+        assert np.isfinite(s)
+        results["unsteady_adjoint_mlups"] = round(
+            ny * nx * niter / dt / 1e6, 3)
+        results["unsteady_adjoint_snapshots"] = snaps
+        results["unsteady_adjoint_recompute"] = round(
+            rev.last["recompute_factor"], 3)
+        results["unsteady_adjoint_peak_snapshots"] = \
+            rev.last["peak_snapshots"]
+        results["unsteady_adjoint_engine"] = rev.engine_name
+    except Exception as e:   # never let the revolve probe kill bench
+        results["unsteady_adjoint_error"] = str(e)[:200]
+    return []
+
+
+def bench_grad_batch(results):
+    """Batched gradient serving: W same-class gradient evals (one
+    line-search fan) through serve's grad mode — ONE dispatch of the
+    lax.map'd VJP executable — vs the same W evals as cached batch-1
+    dispatches.  Tiny grids are the serving regime: per-dispatch host
+    round trips dominate, and batching pays one for the whole fan.
+    ``grad_batch_speedup`` is floor-gated on TPU."""
+    import jax.numpy as jnp
+    from tclb_tpu.adjoint import InternalTopology
+    from tclb_tpu.core.lattice import Lattice
+    from tclb_tpu.models import get_model
+    from tclb_tpu.serve import (Case, GradSpec, JobSpec, Scheduler,
+                                make_grad_evaluator)
+
+    ny, nx = 32, 64
+    iters = int(os.environ.get("TCLB_BENCH_ITERS_GRADBATCH", 16))
+    width = int(os.environ.get("TCLB_BENCH_GRADBATCH_W", 8))
+    m = get_model("d2q9_adj")
+    settings = {"nu": 0.1, "Velocity": 0.05, "Porocity": 0.5,
+                "DragInObj": 1.0}
+    flags = np.full((ny, nx), m.flag_for("MRT"), dtype=np.uint16)
+    flags[:, 0] = m.flag_for("WVelocity", "MRT")
+    flags[:, -1] = m.flag_for("EPressure", "MRT")
+    flags[0, :] = flags[-1, :] = m.flag_for("Wall")
+    flags[8:24, 20:44] |= m.flag_for("DesignSpace")
+    lat = Lattice(m, (ny, nx), dtype=jnp.float32, settings=settings)
+    lat.set_flags(flags)
+    lat.init()
+    design = InternalTopology(m)
+    theta0 = design.get(lat.state, lat.params)
+    thetas = [jnp.clip(theta0 + 0.01 * i, 0.0, 1.0) for i in range(width)]
+    sched = Scheduler(autostart=False)
+    try:
+        spec = JobSpec(model=m, shape=(ny, nx), case=Case(), niter=iters,
+                       flags=flags, dtype=jnp.float32,
+                       base_settings=settings,
+                       grad=GradSpec(design=design), name="bench")
+        ev = make_grad_evaluator(sched, spec)
+        ev([thetas[0]])                     # compile the batch-1 VJP
+        t0 = time.perf_counter()
+        for th in thetas:
+            out = ev([th])
+            assert np.isfinite(out[0][0])
+        dt_seq = time.perf_counter() - t0
+        ev(thetas)                          # compile the batch-W VJP
+        t0 = time.perf_counter()
+        out = ev(thetas)
+        assert all(np.isfinite(o) for o, _ in out)
+        dt_batch = time.perf_counter() - t0
+        results["grad_batch_width"] = width
+        results["grad_batch_seq_evals_per_s"] = round(width / dt_seq, 2)
+        results["grad_batch_evals_per_s"] = round(width / dt_batch, 2)
+        results["grad_batch_speedup"] = round(dt_seq / dt_batch, 2)
+        results["grad_batch_cache"] = sched.cache.stats()
+    except Exception as e:   # never let the serving probe kill bench
+        results["grad_batch_error"] = str(e)[:200]
+    finally:
+        sched.close()
+    return []
+
+
 def bench_d3q27(results):
     """d3q27_cumulant forced channel (the BASELINE north-star case,
     reference example/3d_channel_test_periodic_force_driven.xml geometry
@@ -627,6 +752,10 @@ def main():
         checks3d += bench_baseline_cases(results)
     with telemetry.span("bench.adjoint"):
         checks3d += bench_adjoint(results)
+    with telemetry.span("bench.unsteady_adjoint"):
+        checks3d += bench_unsteady_adjoint(results)
+    with telemetry.span("bench.grad_batch"):
+        checks3d += bench_grad_batch(results)
     with telemetry.span("bench.precision_ladder"):
         checks3d += bench_precision_ladder(results)
     with telemetry.span("bench.ensemble"):

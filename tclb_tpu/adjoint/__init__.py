@@ -19,7 +19,11 @@ from tclb_tpu.adjoint.run import (nested_checkpoint_scan, objective_weights,
 from tclb_tpu.adjoint.design import (ControlSecond, Design, InternalTopology, OptimalControl,
                                      Fourier, BSpline, RepeatControl,
                                      CompositeDesign, threshold_topology)
-from tclb_tpu.adjoint.optimize import optimize
+from tclb_tpu.adjoint.optimize import batched_descent, optimize
+from tclb_tpu.adjoint.revolve import (RevolvePlan, SnapshotStore,
+                                      auto_plan, binomial_bound,
+                                      make_revolve_gradient,
+                                      revolve_schedule)
 
 __all__ = [
     "nested_checkpoint_scan", "objective_weights", "make_objective_run",
@@ -27,4 +31,7 @@ __all__ = [
     "make_steady_gradient", "fd_test",
     "Design", "InternalTopology", "OptimalControl", "Fourier", "BSpline",
     "RepeatControl", "CompositeDesign", "threshold_topology", "optimize",
+    "batched_descent",
+    "RevolvePlan", "SnapshotStore", "auto_plan", "binomial_bound",
+    "make_revolve_gradient", "revolve_schedule",
 ]

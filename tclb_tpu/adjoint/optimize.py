@@ -212,6 +212,57 @@ def _mma(grad_fn, theta0, max_eval, lo, hi, material, callback):
     return unravel(jnp.asarray(best_x, dtype=flat0.dtype)), best_obj
 
 
+def batched_descent(evaluate: Callable, theta0: Any, max_iter: int = 10,
+                    steps: tuple = (0.25, 0.5, 1.0, 2.0),
+                    bounds: tuple = (None, None),
+                    callback: Optional[Callable] = None
+                    ) -> tuple[Any, float]:
+    """Projected steepest descent whose line search is ONE batched
+    gradient dispatch per iteration — the serving client of the
+    gradient-mode scheduler (:func:`tclb_tpu.serve.make_grad_evaluator`).
+
+    ``evaluate(thetas) -> [(objective, grad), ...]`` values a whole list
+    of candidates at once; here every iteration submits the full
+    candidate fan ``theta - s * g`` for each trial step ``s`` as a
+    single batch, picks the best candidate, and reuses ITS gradient for
+    the next fan — so each optimizer iteration costs exactly one batched
+    adjoint dispatch of ``len(steps)`` whole (forward + reverse) sweeps.
+    The warm-up evaluation replicates ``theta0`` to the candidate width:
+    every dispatch then shares one batch size, so the whole optimization
+    runs through ONE AOT-compiled VJP executable (the CI serving smoke
+    asserts exactly that).
+
+    When no candidate improves, the trial steps halve (classic
+    backtracking) and the fan re-issues from the same point.  Returns
+    ``(theta_best, objective_best)``."""
+    lo, hi = bounds if isinstance(bounds, tuple) and len(bounds) == 2 \
+        else (None, None)
+    width = max(1, len(steps))
+    out = evaluate([theta0] * width)
+    obj, g = float(out[0][0]), out[0][1]
+    theta, scale = theta0, 1.0
+    best_obj, best_theta = obj, theta0
+    if callback:
+        callback(0, obj, theta0)
+    for k in range(max_iter):
+        cands = [_clamp(jax.tree_util.tree_map(
+            lambda t, d, s=s: t - scale * s * d, theta, g), lo, hi)
+            for s in steps]
+        out = evaluate(cands)
+        objs = [float(o) for o, _ in out]
+        i = int(np.argmin(objs))
+        if objs[i] < obj:
+            theta, obj, g = cands[i], objs[i], out[i][1]
+            scale = 1.0
+        else:
+            scale *= 0.5   # backtrack: same point, shorter fan
+        if obj < best_obj:
+            best_obj, best_theta = obj, theta
+        if callback:
+            callback(k + 1, obj, theta)
+    return best_theta, best_obj
+
+
 def optimize(grad_fn: Callable, theta0: Any, method: str = "MMA",
              max_eval: int = 20, step: float = 1.0,
              bounds: tuple = (None, None),

@@ -312,8 +312,19 @@ def make_spilled_gradient(model: Model, design, niter: int, segment: int,
 
     Returns ``grad_fn(theta, state, params) -> (objective, grads,
     final_state)``.
+
+    Snapshot parking is ASYNCHRONOUS: each segment's entry fields are
+    handed to the one-in-flight checkpoint writer
+    (:class:`tclb_tpu.checkpoint.writer.AsyncWriter` via
+    :class:`tclb_tpu.adjoint.revolve.SnapshotStore`), whose thread does
+    the device→host copy and the (atomic, CRC-sidecarred) file write
+    while the main thread keeps dispatching the next segment — the
+    forward wall only fences at reverse-sweep fetch.  The CI spill gate
+    asserts the <5% forward-overhead budget via
+    ``telemetry report --compare`` (same gate as async checkpoint
+    saves), and the kill-resume step asserts a SIGKILL mid-run leaves
+    only CRC-valid spill files.
     """
-    import os
     if segment <= 0:
         raise ValueError("segment must be positive")
     lengths = [segment] * (niter // segment)
@@ -341,56 +352,58 @@ def make_spilled_gradient(model: Model, design, niter: int, segment: int,
         g_th, g_fs = vjp((jnp.ones_like(obj), cot_fields))
         return obj, g_th, g_fs
 
-    def _park(k, fields):
-        if spill_dir is None:
-            return np.asarray(fields)
-        os.makedirs(spill_dir, exist_ok=True)
-        path = os.path.join(spill_dir, f"snap_{k:05d}.npy")
-        np.save(path, np.asarray(fields))
-        return path
-
-    def _fetch(parked):
-        if isinstance(parked, str):
-            return jnp.asarray(np.load(parked))
-        return jnp.asarray(parked)
-
     def grad_fn(theta, state: LatticeState, params: SimParams):
-        # forward: park each segment's entry fields off-device
-        parked = []
+        from tclb_tpu import telemetry
+        from tclb_tpu.adjoint.revolve import SnapshotStore
+        # memory tier when spill_dir is None, pure disk tier otherwise;
+        # parking never blocks the solve thread either way (the writer
+        # thread materializes the device arrays)
+        store = SnapshotStore(
+            mem_slots=len(lengths) if spill_dir is None else 0,
+            spill_dir=spill_dir)
         fields = state.fields
         it = state.iteration
         iters = []
         final = None
-        for k, length in enumerate(lengths):
-            parked.append(_park(k, fields))
-            iters.append(it)
-            _, final = seg_fwd(theta, fields, state.replace(iteration=it),
-                               params, length)
-            fields, it = final.fields, final.iteration
-        # final carries the LAST step's globals_ — same contract as
-        # make_unsteady_gradient's final_state
-        final_state = final if final is not None else state
+        with telemetry.span("adjoint.sweep", model=model.name,
+                            mode="spill", segments=len(lengths),
+                            niter=int(niter), snapshots=len(lengths),
+                            spill_dir=spill_dir or "host") as sp:
+            try:
+                # forward: park each segment's entry fields off-device;
+                # the async writer overlaps the park with this segment's
+                # forward dispatch
+                for k, length in enumerate(lengths):
+                    store.put(k, fields)
+                    iters.append(it)
+                    _, final = seg_fwd(theta, fields,
+                                       state.replace(iteration=it),
+                                       params, length)
+                    fields, it = final.fields, final.iteration
+                # final carries the LAST step's globals_ — same contract
+                # as make_unsteady_gradient's final_state
+                final_state = final if final is not None else state
 
-        # reverse: chain the fields cotangent across segment boundaries
-        try:
-            cot = jnp.zeros_like(fields)
-            g_total = None
-            obj_total = 0.0
-            for k in reversed(range(len(lengths))):
-                fk = _fetch(parked[k])
-                obj_k, g_th, cot = seg_bwd(
-                    theta, fk, state.replace(iteration=iters[k]), params,
-                    lengths[k], cot)
-                obj_total += float(obj_k)
-                g_total = g_th if g_total is None else \
-                    jax.tree_util.tree_map(jnp.add, g_total, g_th)
-        finally:
-            # spilled snapshots can be GBs each — never leak them, even
-            # when the reverse sweep dies (OOM/interrupt)
-            if spill_dir is not None:
-                for p in parked:
-                    if isinstance(p, str) and os.path.exists(p):
-                        os.remove(p)
+                # reverse: chain the fields cotangent across segment
+                # boundaries (store.get fences the writer on first use)
+                cot = jnp.zeros_like(fields)
+                g_total = None
+                obj_total = 0.0
+                for k in reversed(range(len(lengths))):
+                    fk = jnp.asarray(store.get(k))
+                    obj_k, g_th, cot = seg_bwd(
+                        theta, fk, state.replace(iteration=iters[k]),
+                        params, lengths[k], cot)
+                    obj_total += float(obj_k)
+                    g_total = g_th if g_total is None else \
+                        jax.tree_util.tree_map(jnp.add, g_total, g_th)
+                sp.add(recompute_factor=1.0,
+                       peak_snapshots=store.peak_live,
+                       spill_bytes=store.spill_bytes)
+            finally:
+                # spilled snapshots can be GBs each — never leak them,
+                # even when the reverse sweep dies (OOM/interrupt)
+                store.close()
         return obj_total, g_total, final_state
 
     return grad_fn

@@ -148,6 +148,120 @@ def _file_refs(tree, modname: str):
     return refs, own
 
 
+_HORIZON_CALLS = {"scan", "nested_checkpoint_scan", "make_objective_run",
+                  "fori_loop", "while_loop"}
+_REVERSE_CALLS = {"grad", "value_and_grad", "vjp"}
+_POLICY_NAMES = {"levels", "segment", "segments", "revolve_schedule",
+                 "schedule", "checkpoint", "remat", "snapshots"}
+
+
+def _call_name(call: ast.Call):
+    fn = call.func
+    return (fn.attr if isinstance(fn, ast.Attribute)
+            else fn.id if isinstance(fn, ast.Name) else None)
+
+
+def _horizon_inside(fnode, defs, _seen=None) -> bool:
+    """True if ``fnode`` (a def or lambda) contains a horizon loop,
+    following calls to sibling nested defs (one level of resolution is
+    enough for the closure-factory idiom used throughout adjoint/)."""
+    if _seen is None:
+        _seen = set()
+    if fnode in _seen:
+        return False
+    _seen.add(fnode)
+    for sub in ast.walk(fnode):
+        if isinstance(sub, ast.Call):
+            name = _call_name(sub)
+            if name in _HORIZON_CALLS:
+                return True
+            if name in defs and _horizon_inside(defs[name], defs, _seen):
+                return True
+    return False
+
+
+def scan_unbounded_adjoint(paths=None) -> list:
+    """Flag reverse-mode entry points in ``adjoint/`` that differentiate
+    a full-horizon loop with NO checkpoint policy in scope.
+
+    A function that takes ``jax.grad``/``value_and_grad``/``vjp`` of a
+    program containing a horizon loop (``lax.scan``/``fori_loop``/
+    ``make_objective_run``/...) stores O(T) residuals — at production
+    horizons that is an OOM wired into the API, invisible until someone
+    raises ``niter``.  Every such entry must show its policy in the same
+    function: a ``levels`` remat depth (nested checkpoint scan), a
+    ``segment``/spill tier, ``jax.checkpoint``/``remat``, or a revolve
+    ``schedule``/``snapshots`` budget.
+
+    A horizon loop that merely COEXISTS with a reverse call is fine —
+    the fixed-point adjoint iterates a Neumann series around the VJP of
+    one step without ever differentiating through the loop.  The loop
+    must sit inside the function handed to the reverse-mode call (the
+    differentiated region) to count."""
+    if paths is None:
+        paths = _py_files(os.path.join(_PKG_ROOT, "adjoint"))
+    findings = []
+    for path in paths:
+        try:
+            with open(path) as fh:
+                tree = ast.parse(fh.read())
+        except SyntaxError as e:
+            findings.append(Finding(
+                "hygiene.unparseable", "error", "",
+                f"cannot parse {path}: {e}", path))
+            continue
+        for node in ast.walk(tree):
+            if not isinstance(node, (ast.FunctionDef,
+                                     ast.AsyncFunctionDef)):
+                continue
+            has_horizon = has_policy = False
+            diffs_horizon = False
+            defs = {d.name: d for d in ast.walk(node)
+                    if isinstance(d, (ast.FunctionDef, ast.AsyncFunctionDef))
+                    and d is not node}
+            for sub in ast.walk(node):
+                if isinstance(sub, ast.Call):
+                    name = _call_name(sub)
+                    if name in _HORIZON_CALLS:
+                        has_horizon = True
+                    if name in ("checkpoint", "remat"):
+                        has_policy = True
+                    for kw in sub.keywords:
+                        if kw.arg in _POLICY_NAMES:
+                            has_policy = True
+                if isinstance(sub, ast.Name) and sub.id in _POLICY_NAMES:
+                    has_policy = True
+                if isinstance(sub, ast.arg) and sub.arg in _POLICY_NAMES:
+                    has_policy = True
+            for sub in ast.walk(node):
+                if not (isinstance(sub, ast.Call)
+                        and _call_name(sub) in _REVERSE_CALLS
+                        and sub.args):
+                    continue
+                target = sub.args[0]
+                if isinstance(target, ast.Lambda):
+                    diffs_horizon |= _horizon_inside(target, defs)
+                elif isinstance(target, ast.Name) and target.id in defs:
+                    diffs_horizon |= _horizon_inside(defs[target.id], defs)
+                elif isinstance(target, (ast.Name, ast.Attribute, ast.Call)):
+                    # unresolvable callable (imported fn, partial, method):
+                    # stay conservative — any loop in scope counts
+                    diffs_horizon |= has_horizon
+                # tuple/constant first arg: that is a returned vjp function
+                # being APPLIED to a cotangent, not a differentiation
+            if diffs_horizon and not has_policy:
+                rel = os.path.relpath(path, _REPO_ROOT)
+                findings.append(Finding(
+                    "hygiene.unbounded_adjoint", "error", "",
+                    f"{rel}:{node.lineno} `{node.name}` differentiates "
+                    "a full-horizon loop with no checkpoint policy "
+                    "(no levels=/segment=/snapshots= budget, no "
+                    "jax.checkpoint/remat, no revolve schedule) — "
+                    "reverse-mode residuals grow O(T) and OOM at "
+                    "production horizons", f"{rel}:{node.lineno}"))
+    return findings
+
+
 def scan_dead_entry_points(engine_dir=None, sources=None) -> list:
     """Unreachable engine entry points: public ``make_*``/``supports*``
     functions in ``tclb_tpu/ops`` no live code refers to."""
@@ -637,6 +751,7 @@ def check_repo(engine_dir=None, sources=None) -> list:
     from tclb_tpu.analysis.precision import scan_unsafe_accum
     return (scan_dead_entry_points(engine_dir, sources)
             + scan_id_keyed_caches()
+            + scan_unbounded_adjoint()
             + scan_dispatch_telemetry()
             + scan_unrestorable_handlers()
             + scan_ensemble_unsafe()

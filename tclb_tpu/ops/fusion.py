@@ -117,6 +117,32 @@ def ensemble_batch_cap(n_storage: int, shape: Tuple[int, ...],
     return max(1, min(int(bmax), budget_bytes // max(per_case, 1)))
 
 
+def snapshot_mem_slots(n_storage: int, shape: Tuple[int, ...],
+                       itemsize: int,
+                       budget_bytes: Optional[int] = None) -> int:
+    """How many adjoint checkpoints (full field stacks) fit the HOST
+    snapshot budget — the memory tier of the revolve two-tier store
+    (adjoint/revolve.py); snapshots past this count spill to disk.
+
+    ``budget_bytes`` defaults to ``TCLB_ADJOINT_BUDGET_MB`` (MB) or
+    4 GiB of host RAM: snapshots are host-side numpy (the forward sweep
+    parks them off-device precisely so device memory stays O(one chunk's
+    remat tree)), so the budget is a host-RAM predicate, not an HBM one.
+    Always at least 1 — revolve degenerates to the quadratic
+    single-snapshot sweep rather than refusing to run.
+    """
+    if budget_bytes is None:
+        import os
+        mb = os.environ.get("TCLB_ADJOINT_BUDGET_MB")
+        budget_bytes = (int(mb) * 1024 * 1024 if mb
+                        else 4 * 1024 * 1024 * 1024)
+    nodes = 1
+    for s in shape:
+        nodes *= int(s)
+    per_snap = max(1, nodes * n_storage * itemsize)
+    return max(1, int(budget_bytes) // per_snap)
+
+
 def zone_plane(ztab, col: int, zone_max: int, zones,
                zones_present: Optional[Iterable[int]] = None):
     """Reconstruct one zonal-setting plane inside a kernel.

@@ -66,7 +66,13 @@ def supports_diff(model: Model, shape, dtype, series: bool = False) -> bool:
     """Whether the differentiable Pallas chunk covers this configuration:
     everything the forward generic kernel needs, plus aligned unpadded
     shapes (the backward band kernel has no ghost-row machinery), chain
-    reach within the halo budget, and SUM Globals (the objective)."""
+    reach within the halo budget, and SUM Globals (the objective).
+
+    3D models (d3q19_adj and friends) route to the z-slab flavor: the
+    forward sweep runs the fused 3D Pallas engine, the backward the XLA
+    whole-array chain (see :func:`_make_diff_step_3d`)."""
+    if model.ndim == 3 and len(shape) == 3:
+        return _supports_diff_3d(model, shape, dtype, series)
     if model.ndim != 2 or len(shape) != 2:
         return False
     if not pallas_generic.supports(model, shape, dtype, probe=False):
@@ -127,6 +133,183 @@ def supports_diff(model: Model, shape, dtype, series: bool = False) -> bool:
     return _probe_cache[key]
 
 
+def _supports_diff_3d(model: Model, shape, dtype,
+                      series: bool = False) -> bool:
+    """3D eligibility: the generic z-slab engine must cover the
+    configuration (its in-kernel-globals flavor is the forward sweep),
+    the objective must be SUM Globals, and the traced grad probe at the
+    production chunk size must go through.  The Control-series flavor is
+    2D-only for now."""
+    if series:
+        return False
+    if jnp.dtype(dtype) != jnp.dtype(jnp.float32):
+        return False
+    if not pallas_generic.supports(model, shape, dtype, probe=False):
+        return False
+    nz, ny, nx = (int(s) for s in shape)
+    if ny % 8 or nx % 128:
+        return False
+    if not (1 <= model.n_globals <= 8) \
+            or any(g.op != "SUM" for g in model.globals_):
+        return False
+    from tclb_tpu import analysis
+    if not analysis.kernel_safety_ok(model):
+        return False
+    key = (model.fingerprint, tuple(shape), "3d")
+    if key not in _probe_cache:
+        try:
+            step = make_diff_step(model, shape, dtype, interpret=True,
+                                  k=max_chunk(model))
+            fields = jax.ShapeDtypeStruct((model.n_storage,) + tuple(shape),
+                                          dtype)
+            flags = jax.ShapeDtypeStruct(tuple(shape), jnp.uint16)
+
+            def loss(f):
+                from tclb_tpu.core.lattice import LatticeState
+                st = LatticeState(
+                    fields=f,
+                    flags=jnp.zeros(tuple(shape), jnp.uint16),
+                    globals_=jnp.zeros((model.n_globals,), dtype),
+                    iteration=jnp.zeros((), jnp.int32))
+                st2, ginc = step.prepare(st, _probe_params(model, dtype))(
+                    st, _probe_params(model, dtype))
+                return jnp.sum(st2.fields) + jnp.sum(ginc)
+
+            jax.eval_shape(jax.grad(loss), fields)
+            del flags
+            _probe_cache[key] = True
+        except Exception as e:  # noqa: BLE001 — untraceable = ineligible
+            from tclb_tpu.utils import log
+            log.debug(f"pallas_adjoint: {model.name} 3d diff probe "
+                      f"failed: {type(e).__name__}: {str(e)[:200]}")
+            _probe_cache[key] = False
+    return _probe_cache[key]
+
+
+def _probe_params(model: Model, dtype):
+    from tclb_tpu.core.lattice import SimParams
+    n_sett = len(model.settings)
+    return SimParams(settings=jnp.zeros((n_sett,), dtype),
+                     zone_table=jnp.zeros((n_sett, model.zone_max), dtype))
+
+
+def _make_diff_step_3d(model: Model, shape, dtype=jnp.float32,
+                       interpret: Optional[bool] = None,
+                       present: Optional[set] = None,
+                       k: Optional[int] = None):
+    """The 3D differentiable chunk: ``custom_vjp`` pairing the z-slab
+    Pallas engine's in-kernel-globals flavor (forward) with the VJP of
+    the XLA whole-array action chain (backward).
+
+    In a checkpointed/revolve adjoint the FORWARD steps dominate —
+    every reverse of one unit costs up to ``r`` recomputed advances
+    (Griewank's repetition number) plus exactly one backward — so the
+    fused Pallas forward is where the wall time goes; the backward
+    chain stays on XLA, whose 3D step is bit-parity-tested against the
+    slab kernel (tests/test_pallas3d), so the gradient linearizes the
+    same physics the Pallas forward ran."""
+    nz, ny, nx = (int(s) for s in shape)
+    if k is None:
+        k = max_chunk(model)
+    base = pallas_generic.make_pallas_iterate_3d(
+        model, shape, dtype, interpret=interpret, fuse=1, present=present)
+    impl = base._impl
+    call_g = impl["call_g"]
+    if call_g is None:
+        raise ValueError(f"{model.name}: 3D diff step needs the "
+                         "in-kernel-globals flavor (SUM globals, "
+                         "nx % 128 == 0)")
+    lean = impl["lean_aux"]
+    zonal_si, zshift = impl["zonal_si"], impl["zshift"]
+    adv, cdtype = impl["adv"], impl["cdtype"]
+    n_globals = model.n_globals
+    from tclb_tpu.core.lattice import make_action_step
+    xla_step = make_action_step(model, "Iteration", present=present)
+
+    def _mk_step(params: SimParams, flags):
+        if params.time_series is not None:
+            raise ValueError(
+                "the 3D diff step has no Control-series flavor; use "
+                "engine='xla' for series designs")
+        @jax.custom_vjp
+        def chunk(fields, p, fl, itv):
+            flags_i32 = fl.astype(jnp.int32)
+            sett = p.settings.astype(cdtype)
+            if lean:
+                ztab = jnp.concatenate(
+                    [p.zone_table[j].astype(cdtype) for j in zonal_si])
+                aux = flags_i32.astype(cdtype)[None]
+
+                def call(f, it):
+                    return call_g(sett, it[None], ztab, f, aux)
+            else:
+                zones = flags_i32 >> zshift
+                aux = jnp.stack(
+                    [flags_i32.astype(cdtype)]
+                    + [p.zone_table[j].astype(cdtype)[zones]
+                       for j in zonal_si])
+
+                def call(f, it):
+                    return call_g(sett, it[None], f, aux)
+            f, gs, gl = fields, None, None
+            for j in range(k):
+                f, gpart = call(f, itv + adv * j)
+                g_now = gpart[:n_globals].sum(axis=1)
+                gs = g_now if gs is None else gs + g_now
+                gl = g_now
+            return f, gs, gl
+
+        def chunk_fwd(fields, p, fl, itv):
+            return chunk(fields, p, fl, itv), (fields, p, fl, itv)
+
+        def chunk_bwd(res, cot):
+            fields, p, fl, itv = res
+            cot_f, cot_g, cot_gl = cot
+
+            def ref(fs, pp):
+                st = LatticeState(
+                    fields=fs, flags=fl,
+                    globals_=jnp.zeros((n_globals,), cdtype),
+                    iteration=itv)
+                gs = None
+                for _ in range(k):
+                    st = xla_step(st, pp)
+                    gs = st.globals_ if gs is None else gs + st.globals_
+                return st.fields, gs, st.globals_
+
+            (_, gs_ref, gl_ref), vjp = jax.vjp(ref, fields, p)
+            cf, cp = vjp((cot_f.astype(fields.dtype),
+                          cot_g.astype(gs_ref.dtype),
+                          cot_gl.astype(gl_ref.dtype)))
+            return (cf, cp,
+                    np.zeros(np.shape(fl), jax.dtypes.float0),
+                    np.zeros(np.shape(itv), jax.dtypes.float0))
+
+        chunk.defvjp(chunk_fwd, chunk_bwd)
+
+        def step(state: LatticeState, p2: SimParams):
+            new_fields, g, g_last = chunk(state.fields, p2, state.flags,
+                                          state.iteration)
+            return LatticeState(
+                fields=new_fields, flags=state.flags,
+                globals_=g_last.astype(state.globals_.dtype),
+                iteration=state.iteration + adv * k), g
+        return step
+
+    def step(state: LatticeState, params: SimParams):
+        return _mk_step(params, state.flags)(state, params)
+
+    def prepare(state: LatticeState, params: SimParams):
+        return _mk_step(params, state.flags)
+
+    step.prepare = prepare
+    step.chunk = k
+    step.returns_inc = True
+    step.engine_name = (f"pallas_adjoint3d[{model.name},k={k},"
+                        f"bz={impl['bz']},bwd=xla]")
+    return step
+
+
 def make_diff_step(model: Model, shape, dtype=jnp.float32,
                    interpret: Optional[bool] = None,
                    present: Optional[set] = None,
@@ -149,7 +332,16 @@ def make_diff_step(model: Model, shape, dtype=jnp.float32,
     differentiated) each step, cotangents flowing to
     ``params.time_series`` — the reference's control-gradient tape.
     ``aux_grad`` (default = ``series``) controls whether the backward
-    kernel emits the aux-stack cotangent at all (an extra HBM write)."""
+    kernel emits the aux-stack cotangent at all (an extra HBM write).
+
+    3D shapes dispatch to :func:`_make_diff_step_3d` (z-slab Pallas
+    forward, XLA-chain backward; no series flavor)."""
+    if len(shape) == 3:
+        if series:
+            raise ValueError("3D diff step: no Control-series flavor")
+        return _make_diff_step_3d(model, shape, dtype,
+                                  interpret=interpret, present=present,
+                                  k=k)
     ny, nx = (int(s) for s in shape)
     if series:
         k = 1

@@ -1627,4 +1627,9 @@ def make_pallas_iterate_3d(model: Model, shape, dtype=jnp.float32,
 
     iterate.supports_series = True
     iterate.full_globals = bool(model.n_globals == 0 or call_g is not None)
+    # internals for the differentiable wrapper (ops/pallas_adjoint's 3D
+    # diff step drives call_g directly, outside the scanning iterate)
+    iterate._impl = dict(call_g=call_g, call_sg=call_sg, lean_aux=lean_aux,
+                         zonal_si=zonal_si, zshift=zshift, adv=adv,
+                         cdtype=cdtype, bz=bz, R=R)
     return iterate

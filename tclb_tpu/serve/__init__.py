@@ -6,7 +6,9 @@ many-case engine:
 
 * :mod:`tclb_tpu.serve.ensemble` — run N independent cases of one
   ``(model, shape, engine)`` class in a single device dispatch, with
-  per-case output bit-identical to N sequential runs;
+  per-case output bit-identical to N sequential runs; gradient-mode
+  plans (:class:`GradSpec`) batch N whole unsteady-adjoint sweeps the
+  same way;
 * :mod:`tclb_tpu.serve.cache` — LRU cache of AOT-compiled ensemble
   executables keyed on ``Model.fingerprint`` (+ JAX's persistent
   compilation cache via ``TCLB_COMPILE_CACHE``);
@@ -25,8 +27,9 @@ from tclb_tpu.serve.cache import (CompiledCache, default_cache,
                                   wire_persistent_cache)
 from tclb_tpu.serve.dispatcher import FleetDispatcher, route_job
 from tclb_tpu.serve.ensemble import (Case, EnsemblePlan, EnsembleResult,
-                                     run_ensemble)
-from tclb_tpu.serve.scheduler import Job, JobSpec, JobTimeout, Scheduler
+                                     GradSpec, run_ensemble)
+from tclb_tpu.serve.scheduler import (Job, JobSpec, JobTimeout, Scheduler,
+                                      make_grad_evaluator)
 
 __all__ = [
     "Case",
@@ -34,11 +37,13 @@ __all__ = [
     "EnsemblePlan",
     "EnsembleResult",
     "FleetDispatcher",
+    "GradSpec",
     "Job",
     "JobSpec",
     "JobTimeout",
     "Scheduler",
     "default_cache",
+    "make_grad_evaluator",
     "route_job",
     "run_ensemble",
     "wire_persistent_cache",

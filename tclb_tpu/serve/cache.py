@@ -75,6 +75,7 @@ class CompiledCache:
 
     def key_for(self, plan, batch: int, niter: int, init: bool,
                 device: Any = None) -> tuple:
+        grad = getattr(plan, "grad", None)
         return (plan.model.fingerprint,
                 plan.shape,
                 plan.engine_tag(batch),
@@ -83,7 +84,8 @@ class CompiledCache:
                 int(niter),
                 bool(init),
                 frozenset(plan.present or ()),
-                str(device))
+                str(device),
+                None if grad is None else grad.key())
 
     def get(self, plan, batch: int, niter: int, fn: Callable,
             init: bool = True, device: Any = None) -> Callable:
@@ -108,9 +110,11 @@ class CompiledCache:
                 return self._entries[key]
             self.misses += 1
             telemetry.counter("serve.cache.miss")
-            states, params = plan.abstract_inputs(batch, device=device)
+            # forward plans lower on (states, params); gradient plans on
+            # (thetas, states, params) — the plan owns the input tuple
+            abstract = plan.abstract_inputs(batch, device=device)
             lowered = jax.jit(fn, static_argnames=("niter",)).lower(
-                states, params, niter=niter)
+                *abstract, niter=niter)
             compiled = lowered.compile()
         self._entries[key] = compiled
         while len(self._entries) > self.capacity:

@@ -82,6 +82,10 @@ def route_job(spec: JobSpec, n_devices: int,
         # a prebuilt ensemble plan (zonal XML base) only exists on the
         # batched path; the sharded Lattice can't replay it
         return "lane", dict(info, reason="plan_base")
+    if spec.grad is not None:
+        # the batched adjoint is a lane program (the sharded Lattice has
+        # no reverse sweep); N gradient cases amortize on one lane
+        return "lane", dict(info, reason="grad")
     if spec.storage_dtype is not None and \
             jnp.dtype(spec.storage_dtype) != jnp.dtype(spec.dtype):
         # halo building block is f32-only (core/lattice.py rejects it)
@@ -183,9 +187,10 @@ class Lane:
                                     device=str(self.device),
                                     lane=self.index, batch=len(batch),
                                     job_ids=[j.id for j in batch]):
-                    states, params = plan.host_stacked_cases(
-                        [j.spec.case for j in batch])
-                    inputs = jax.device_put((states, params), self.device)
+                    inputs = jax.device_put(
+                        plan.host_stacked_cases(
+                            [j.spec.case for j in batch]),
+                        self.device)
                     jax.block_until_ready(inputs)
             except Exception as e:  # noqa: BLE001 - per-batch verdict
                 for j in batch:
@@ -544,7 +549,7 @@ class FleetDispatcher:
                 plan = spec.plan if spec.plan is not None else EnsemblePlan(
                     spec.model, spec.shape, flags=spec.flags,
                     dtype=spec.dtype, base_settings=spec.base_settings,
-                    storage_dtype=spec.storage_dtype)
+                    storage_dtype=spec.storage_dtype, grad=spec.grad)
                 self._plans[key] = plan
             return plan
 

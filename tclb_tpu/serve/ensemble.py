@@ -40,12 +40,37 @@ class Case:
 
     ``settings`` are plain ``name -> value`` assignments (derived
     settings update exactly like ``Lattice.set_setting``); ``zonal``
-    maps ``(name, zone_id) -> value`` into the case's zone table."""
+    maps ``(name, zone_id) -> value`` into the case's zone table.
+    ``theta`` is the design vector for gradient-mode plans (ignored —
+    and normally None — on forward plans)."""
 
     settings: dict[str, float] = dataclasses.field(default_factory=dict)
     zonal: dict[tuple[str, int], float] = dataclasses.field(
         default_factory=dict)
     name: str = ""
+    theta: Any = None
+
+
+@dataclasses.dataclass(frozen=True)
+class GradSpec:
+    """What a gradient-mode plan differentiates: the design space plus
+    the adjoint configuration of :func:`make_unsteady_gradient`.
+
+    ``key()`` is the CONTENT identity used for batch binning and the
+    compiled-executable cache (never ``id()``): two GradSpecs built from
+    the same design class over the same parameter names with the same
+    remat depth produce the same executable and must share it."""
+
+    design: Any
+    levels: Optional[int] = None
+    engine: str = "xla"
+    action: str = "Iteration"
+
+    def key(self) -> tuple:
+        d = self.design
+        return (type(d).__name__,
+                tuple(getattr(d, "names", ()) or ()),
+                self.levels, self.engine, self.action)
 
 
 @dataclasses.dataclass
@@ -53,6 +78,9 @@ class EnsembleResult:
     case: Case
     state: LatticeState            # this case's final (unstacked) state
     globals: dict[str, float]
+    # gradient-mode extras (None on forward plans)
+    objective: Optional[float] = None
+    grad: Any = None
 
 
 def case_params(model: Model, base: SimParams, case: Case,
@@ -98,8 +126,14 @@ class EnsemblePlan:
                  base_settings: Optional[dict[str, float]] = None,
                  base: Optional[Lattice] = None,
                  mode: str = "map",
-                 storage_dtype: Any = None):
+                 storage_dtype: Any = None,
+                 grad: Optional[GradSpec] = None):
         from tclb_tpu.ops.lbm import present_types
+        if grad is not None and storage_dtype is not None and \
+                jnp.dtype(storage_dtype) != jnp.dtype(dtype):
+            raise ValueError("gradient-mode plans do not support narrowed "
+                             "storage (the adjoint tape must round-trip "
+                             "bit-exactly)")
         if base is None:
             base = Lattice(model, tuple(int(s) for s in shape), dtype=dtype,
                            settings=base_settings,
@@ -132,8 +166,14 @@ class EnsemblePlan:
         self._iterate = make_ensemble_iterate(
             self.model, present=self.present, mode=mode,
             storage_dtype=(self.storage_dtype if narrowed else None))
+        self.grad = grad
 
     def engine_tag(self, batch: int) -> str:
+        if self.grad is not None:
+            g = self.grad
+            tag = (f"ensemble_grad[{self.model.name},b={batch},"
+                   f"design={g.key()[0]},lv={g.levels},eng={g.engine}")
+            return tag + "]"
         tag = f"ensemble_xla[{self.model.name},{self.mode},b={batch}"
         if jnp.dtype(self.storage_dtype) != jnp.dtype(self.dtype):
             tag += f",{np.dtype(self.storage_dtype).name}"
@@ -142,8 +182,37 @@ class EnsemblePlan:
     # -- pieces the cache compiles ----------------------------------------- #
 
     def build_fn(self, init: bool = True) -> Callable:
-        """The whole ensemble program as one jittable
-        ``fn(states, params, niter) -> states`` (init + bulk + final)."""
+        """The whole ensemble program as one jittable callable over this
+        plan's input tuple (see :meth:`abstract_inputs`).
+
+        Forward plans: ``fn(states, params, niter) -> states`` (init +
+        bulk + final).  Gradient plans: ``fn(thetas, states, params,
+        niter) -> (objs, grads, states)`` — N unsteady-adjoint
+        evaluations in ONE dispatch, each case's whole (forward +
+        reverse) sweep compiled as an isolated ``lax.map`` body so the
+        per-case gradient is bit-identical to running
+        :func:`make_unsteady_gradient` on that case alone (the mode="map"
+        parity contract, extended to reverse mode)."""
+        if self.grad is not None:
+            from tclb_tpu.adjoint.run import make_unsteady_gradient
+            g = self.grad
+
+            def gfn_fn(thetas, states: LatticeState, params: SimParams,
+                       niter: int):
+                gfn = make_unsteady_gradient(
+                    self.model, g.design, niter, action=g.action,
+                    levels=g.levels, engine=g.engine, shape=self.shape,
+                    dtype=self.dtype)
+
+                def one(args):
+                    th, st, pp = args
+                    if init:
+                        st = self._init_one(st, pp)
+                    return gfn(th, st, pp)
+
+                return jax.lax.map(one, (thetas, states, params))
+            return gfn_fn
+
         def fn(states: LatticeState, params: SimParams, niter: int
                ) -> LatticeState:
             if init:
@@ -151,9 +220,20 @@ class EnsemblePlan:
             return self._iterate(states, params, niter)
         return fn
 
+    def _init_one(self, state: LatticeState, params: SimParams
+                  ) -> LatticeState:
+        """Init for ONE (unstacked) case — the grad map body runs it
+        inside its own lax.map iteration so the whole per-case program
+        (init + forward + reverse) stays an isolated sequential trace."""
+        stacked = self._init(jax.tree.map(lambda x: x[None], state),
+                             jax.tree.map(lambda x: x[None], params))
+        return jax.tree.map(lambda x: x[0], stacked)
+
     def abstract_inputs(self, batch: int, device: Any = None) -> tuple:
         """``jax.ShapeDtypeStruct`` pytrees matching a batch-of-``batch``
-        call — what AOT lowering sees instead of real arrays.  With
+        call — what AOT lowering sees instead of real arrays.  Forward
+        plans get ``(states, params)``; gradient plans prepend the
+        stacked design vectors: ``(thetas, states, params)``.  With
         ``device`` the structs carry a ``SingleDeviceSharding`` so the
         compiled executable is pinned to that device (a fleet lane's
         executables never migrate)."""
@@ -167,12 +247,33 @@ class EnsemblePlan:
                                         sharding=sharding)
         states = jax.tree.map(sds, self.base_state)
         params = jax.tree.map(sds, self.base_params)
+        if self.grad is not None:
+            theta0 = self._theta_template()
+            return (jax.tree.map(sds, theta0), states, params)
         return states, params
+
+    def _theta_template(self):
+        """An abstract per-case design vector (shape/dtype only)."""
+        return jax.eval_shape(
+            lambda s, p: self.grad.design.get(s, p),
+            self.base_state, self.base_params)
+
+    def _case_theta(self, case: Case):
+        if case.theta is None:
+            raise ValueError(
+                f"gradient-mode plan needs Case.theta (case "
+                f"{case.name!r} has none)")
+        tmpl = self._theta_template()
+        return jax.tree.map(lambda t, th: jnp.asarray(th, t.dtype),
+                            tmpl, case.theta)
 
     def stack_cases(self, cases: Sequence[Case]) -> tuple:
         states = stack_trees([self.base_state] * len(cases))
         params = stack_trees([case_params(self.model, self.base_params, c,
                                           self.dtype) for c in cases])
+        if self.grad is not None:
+            thetas = stack_trees([self._case_theta(c) for c in cases])
+            return thetas, states, params
         return states, params
 
     def host_stacked_cases(self, cases: Sequence[Case]) -> tuple:
@@ -190,20 +291,34 @@ class EnsemblePlan:
                     for c in cases]
         params = jax.tree.map(
             lambda *xs: np.stack([np.asarray(x) for x in xs]), *per_case)
+        if self.grad is not None:
+            thetas = jax.tree.map(
+                lambda *xs: np.stack([np.asarray(x) for x in xs]),
+                *[self._case_theta(c) for c in cases])
+            return thetas, states, params
         return states, params
 
-    def results_from(self, cases: Sequence[Case], out: LatticeState
+    def results_from(self, cases: Sequence[Case], out
                      ) -> list[EnsembleResult]:
-        """Per-case results (input order) from a batched output state."""
-        finals = unstack_tree(out, len(cases))
+        """Per-case results (input order) from a batched output.  Forward
+        plans pass the stacked final state; gradient plans the
+        ``(objs, grads, states)`` triple from the batched adjoint."""
         m = self.model
+        objs = grads = None
+        if self.grad is not None:
+            objs, gstack, out = out
+            objs = np.asarray(objs)
+            grads = unstack_tree(gstack, len(cases))
+        finals = unstack_tree(out, len(cases))
         results = []
-        for case, st in zip(cases, finals):
+        for k, (case, st) in enumerate(zip(cases, finals)):
             vals = np.asarray(st.globals_)
             results.append(EnsembleResult(
                 case=case, state=st,
                 globals={g.name: float(vals[i])
-                         for i, g in enumerate(m.globals_)}))
+                         for i, g in enumerate(m.globals_)},
+                objective=(None if objs is None else float(objs[k])),
+                grad=(None if grads is None else grads[k])))
         return results
 
     def run(self, cases: Sequence[Case], niter: int,
@@ -211,15 +326,15 @@ class EnsemblePlan:
         """Run the batch; returns per-case results in input order."""
         cases = [c if isinstance(c, Case) else Case(settings=dict(c))
                  for c in cases]
-        states, params = self.stack_cases(cases)
+        inputs = self.stack_cases(cases)
         fn = self.build_fn(init=init)
         if cache is not None:
             compiled = cache.get(self, batch=len(cases), niter=niter,
                                  fn=fn, init=init)
-            out = compiled(states, params)
+            out = compiled(*inputs)
         else:
             out = jax.jit(fn, static_argnames=("niter",))(
-                states, params, niter)
+                *inputs, niter=niter)
         return self.results_from(cases, out)
 
     # -- sequential reference path ----------------------------------------- #
@@ -238,6 +353,21 @@ class EnsemblePlan:
         lat.params = case_params(self.model, self.base_params, case,
                                  self.dtype)
         lat.init()
+        if self.grad is not None:
+            from tclb_tpu.adjoint.run import make_unsteady_gradient
+            g = self.grad
+            gfn = make_unsteady_gradient(
+                self.model, g.design, niter, action=g.action,
+                levels=g.levels, engine=g.engine, shape=self.shape,
+                dtype=self.dtype)
+            obj, gr, final = gfn(self._case_theta(case), lat.state,
+                                 lat.params)
+            vals = np.asarray(final.globals_)
+            return EnsembleResult(
+                case=case, state=final,
+                globals={gg.name: float(vals[i])
+                         for i, gg in enumerate(self.model.globals_)},
+                objective=float(obj), grad=gr)
         if niter > 0:
             lat.iterate(niter)
         return EnsembleResult(case=case, state=lat.state,

@@ -36,7 +36,8 @@ from tclb_tpu.telemetry import live as tlive
 from tclb_tpu.core.registry import Model
 from tclb_tpu.ops import fusion
 from tclb_tpu.serve.cache import CompiledCache
-from tclb_tpu.serve.ensemble import Case, EnsemblePlan, EnsembleResult
+from tclb_tpu.serve.ensemble import (Case, EnsemblePlan, EnsembleResult,
+                                     GradSpec)
 from tclb_tpu.utils import log
 
 PENDING, RUNNING, DONE, FAILED = "pending", "running", "done", "failed"
@@ -65,6 +66,12 @@ class JobSpec:
     # base params a plain settings dict cannot express); must describe
     # the same (model, shape, flags, dtype) class as the fields above
     plan: Optional[EnsemblePlan] = None
+    # gradient mode: the job evaluates the unsteady adjoint of its case
+    # at Case.theta instead of a forward run — same-(class, grad) jobs
+    # batch into ONE dispatch of N whole (forward + reverse) sweeps, and
+    # the AOT cache keys the compiled VJP executable on GradSpec.key()
+    # content (never id())
+    grad: Optional[GradSpec] = None
     timeout_s: Optional[float] = None
     name: str = ""
 
@@ -135,7 +142,8 @@ def _bin_key(spec: JobSpec) -> tuple:
             str(jnp.dtype(spec.dtype)),
             str(jnp.dtype(spec.storage_dtype if spec.storage_dtype
                           is not None else spec.dtype)),
-            flags_digest, int(spec.niter), base)
+            flags_digest, int(spec.niter), base,
+            None if spec.grad is None else spec.grad.key())
 
 
 class Scheduler:
@@ -166,6 +174,9 @@ class Scheduler:
         self._plans: dict[tuple, EnsemblePlan] = {}
         self._jobs = 0
         self._lock = threading.Lock()
+        # held across a submit_many burst AND the worker's bin drain, so
+        # the worker's next batch sees a whole burst or none of it
+        self._admit = threading.Lock()
         self._closing = False
         self._worker: Optional[threading.Thread] = None
         # every live handle, so close() can sweep jobs whose timeout
@@ -206,10 +217,19 @@ class Scheduler:
             self.start()
         return job
 
+    def submit_many(self, specs: Sequence[JobSpec]) -> list[Job]:
+        """Admit a burst atomically: the worker's next bin drain sees the
+        whole burst, never a prefix — deterministic batch sizes (and
+        therefore deterministic compiled-executable cache keys) even when
+        the worker is already running between bursts."""
+        with self._admit:
+            jobs = [self.submit(s) for s in specs]
+        return jobs
+
     def run(self, specs: Sequence[JobSpec]) -> list[Job]:
         """Submit all, wait for all; returns the job handles (failed
         jobs keep their error on the handle instead of raising)."""
-        jobs = [self.submit(s) for s in specs]
+        jobs = self.submit_many(specs)
         self.start()
         for j in jobs:
             try:
@@ -270,7 +290,7 @@ class Scheduler:
             plan = spec.plan if spec.plan is not None else EnsemblePlan(
                 spec.model, spec.shape, flags=spec.flags, dtype=spec.dtype,
                 base_settings=spec.base_settings,
-                storage_dtype=spec.storage_dtype)
+                storage_dtype=spec.storage_dtype, grad=spec.grad)
             self._plans[key] = plan
         return plan
 
@@ -293,17 +313,21 @@ class Scheduler:
             first = self._queue.get(timeout=0.1)
         except queue.Empty:
             return None
-        key = _bin_key(first.spec)
-        cap = self.batch_cap(first.spec)
-        batch, requeue = [first], []
-        while len(batch) < cap:
-            try:
-                j = self._queue.get_nowait()
-            except queue.Empty:
-                break
-            (batch if _bin_key(j.spec) == key else requeue).append(j)
-        for j in requeue:
-            self._queue.put(j)
+        # blocks until any in-flight submit_many burst is fully queued:
+        # `first` may be a burst's head popped mid-admission, and binning
+        # a prefix would split the batch (and fork its cache key)
+        with self._admit:
+            key = _bin_key(first.spec)
+            cap = self.batch_cap(first.spec)
+            batch, requeue = [first], []
+            while len(batch) < cap:
+                try:
+                    j = self._queue.get_nowait()
+                except queue.Empty:
+                    break
+                (batch if _bin_key(j.spec) == key else requeue).append(j)
+            for j in requeue:
+                self._queue.put(j)
         return batch
 
     def _loop(self) -> None:
@@ -413,3 +437,36 @@ class Scheduler:
                 self._on_result(job)
             except Exception as e:  # noqa: BLE001 - callback is advisory
                 log.warning(f"serve: on_result callback failed: {e!r}")
+
+
+def make_grad_evaluator(scheduler: Scheduler, spec: JobSpec) -> Callable:
+    """Batched ``evaluate(thetas) -> [(objective, grad), ...]`` over a
+    gradient-mode job class — the serving client
+    :func:`tclb_tpu.adjoint.optimize.batched_descent` consumes.
+
+    Each call submits one job per candidate theta; all of them share the
+    template's bin key (same class, same :class:`GradSpec`), so a burst
+    of N candidates runs as ONE batched adjoint dispatch whose compiled
+    VJP executable is AOT-cached on content — a line search evaluating
+    the same candidate width every iteration reuses a single executable
+    for the whole optimization.  Submit-then-start keeps the binning
+    deterministic (build the scheduler with ``autostart=False``)."""
+    if spec.grad is None:
+        raise ValueError("make_grad_evaluator needs a gradient-mode "
+                         "JobSpec (spec.grad is None)")
+
+    def evaluate(thetas: Sequence[Any]) -> list[tuple[float, Any]]:
+        base_case = spec.case if spec.case is not None else Case()
+        specs = [dataclasses.replace(
+            spec,
+            case=dataclasses.replace(base_case, theta=th),
+            name=f"{spec.name or 'grad'}[{i}]")
+            for i, th in enumerate(thetas)]
+        jobs = scheduler.run(specs)
+        out = []
+        for j in jobs:
+            r = j.result()   # re-raises a failed job's stored error
+            out.append((r.objective, r.grad))
+        return out
+
+    return evaluate

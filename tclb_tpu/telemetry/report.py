@@ -90,6 +90,37 @@ def _serving_summary(evts: list[dict]) -> dict:
     return out
 
 
+def _adjoint_summary(evts: list[dict]) -> dict:
+    """The gradient-engine health numbers (from ``adjoint.sweep``
+    spans): per (model, mode) sweep counts, wall time, snapshots held,
+    recompute factor and spilled bytes.  Empty dict when the trace has
+    no adjoint activity."""
+    sweeps = [e for e in evts if e.get("kind") == "span"
+              and e.get("name") == "adjoint.sweep"]
+    if not sweeps:
+        return {}
+    rows: dict[str, dict] = {}
+    for s in sweeps:
+        key = f"{s.get('model', '?')}/{s.get('mode', '?')}"
+        row = rows.setdefault(key, {
+            "sweeps": 0, "total_s": 0.0, "peak_snapshots": 0,
+            "spill_bytes": 0, "recompute_factor": None,
+            "engine": s.get("engine")})
+        row["sweeps"] += 1
+        row["total_s"] += float(s.get("dur_s", 0.0))
+        row["peak_snapshots"] = max(row["peak_snapshots"],
+                                    int(s.get("peak_snapshots", 0) or 0))
+        row["spill_bytes"] += int(s.get("spill_bytes", 0) or 0)
+        if s.get("recompute_factor") is not None:
+            row["recompute_factor"] = float(s["recompute_factor"])
+        if s.get("engine") is not None:
+            row["engine"] = s["engine"]
+    for row in rows.values():
+        row["total_s"] = round(row["total_s"], 6)
+    return {"modes": dict(sorted(rows.items())),
+            "sweeps": sum(r["sweeps"] for r in rows.values())}
+
+
 def _fleet_summary(evts: list[dict]) -> dict:
     """The fleet dispatcher's health numbers: per-device occupancy (lane
     busy time over the ``serve.fleet`` lifetime span), queue waits, the
@@ -239,6 +270,7 @@ def summarize(evts: list[dict]) -> dict:
                 sum(nu * r for nu, r in rows) / tot, 4)
     return {"engines": engines, "spans": spans,
             "serving": _serving_summary(evts),
+            "adjoint": _adjoint_summary(evts),
             "fleet": _fleet_summary(evts),
             "engine_selected": [
                 {k: v for k, v in e.items() if k not in ("kind",)}
@@ -476,6 +508,20 @@ def format_text(summary: dict) -> str:
                 f"  compile cache: {sv['compile_lookups']} lookups, "
                 f"hit rate {_fmt(sv['cache_hit_rate_pct'], 1)}%, "
                 f"{_fmt(sv['compile_miss_s'], 3)}s compiling")
+        lines.append("")
+    if summary.get("adjoint"):
+        ad = summary["adjoint"]
+        lines.append("adjoint")
+        lines.append(f"  {'model/mode':<28} {'sweeps':>6} {'time_s':>10} "
+                     f"{'peak_snaps':>10} {'recompute':>10} "
+                     f"{'spill_MB':>9}")
+        for key, r in ad["modes"].items():
+            lines.append(
+                f"  {key:<28} {r['sweeps']:>6} "
+                f"{_fmt(r['total_s'], 3):>10} "
+                f"{r['peak_snapshots']:>10} "
+                f"{_fmt(r['recompute_factor'], 3):>10} "
+                f"{_fmt(r['spill_bytes'] / 1e6, 2):>9}")
         lines.append("")
     if summary.get("fleet"):
         fl = summary["fleet"]

@@ -208,6 +208,46 @@ def test_hygiene_fires_on_id_keyed_cache(tmp_path):
     assert fs[0].severity == "error"
 
 
+def test_hygiene_fires_on_unbounded_adjoint(tmp_path):
+    p = tmp_path / "naive.py"
+    p.write_text(
+        "import jax\n"
+        "from jax import lax\n"
+        "def make_naive_gradient(step, niter):\n"
+        "    def loss(theta, state):\n"
+        "        def body(c, _):\n"
+        "            return step(theta, c), None\n"
+        "        out, _ = lax.scan(body, state, None, length=niter)\n"
+        "        return out.sum()\n"
+        "    return jax.value_and_grad(loss)\n")
+    fs = hygiene.scan_unbounded_adjoint(paths=[str(p)])
+    assert [f.check for f in fs] == ["hygiene.unbounded_adjoint"]
+    assert fs[0].severity == "error"
+    assert "make_naive_gradient" in fs[0].message
+
+
+def test_hygiene_unbounded_adjoint_accepts_budgeted(tmp_path):
+    # a levels= budget (nested remat) or a snapshots= budget (revolve)
+    # in scope makes the same shape legitimate
+    p = tmp_path / "budgeted.py"
+    p.write_text(
+        "import jax\n"
+        "from jax import lax\n"
+        "def make_grad(step, niter, levels=2):\n"
+        "    def loss(theta, state):\n"
+        "        out, _ = lax.scan(lambda c, _: (step(theta, c), None),\n"
+        "                          state, None, length=niter)\n"
+        "        return out.sum()\n"
+        "    return jax.value_and_grad(loss)\n"
+        "def make_revolve(step, niter, snapshots):\n"
+        "    def loss(theta, state):\n"
+        "        out, _ = lax.scan(lambda c, _: (step(theta, c), None),\n"
+        "                          state, None, length=niter)\n"
+        "        return out.sum()\n"
+        "    return jax.vjp(loss)\n")
+    assert hygiene.scan_unbounded_adjoint(paths=[str(p)]) == []
+
+
 def test_hygiene_fires_on_dead_entry_point(tmp_path):
     eng = tmp_path / "ops"
     eng.mkdir()

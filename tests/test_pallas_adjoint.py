@@ -57,6 +57,9 @@ def test_supports_diff():
     # series flavor (control gradients)
     assert pallas_adjoint.supports_diff(m, (16, 128), jnp.float32,
                                         series=True)
+    # 3D hybrid (Pallas forward / XLA backward) is in scope now
+    assert pallas_adjoint.supports_diff(get_model("d3q19_adj"),
+                                        (8, 16, 128), jnp.float32)
 
 
 def test_design_needs_classifier():
@@ -175,6 +178,39 @@ def test_pallas_kuper_gradient():
     gx, gp = np.asarray(gx), np.asarray(gp)
     assert np.abs(gx).max() > 0.0
     np.testing.assert_allclose(gp, gx, rtol=1e-3, atol=2e-6)
+
+
+def test_pallas_3d_gradient_matches_xla():
+    """3D hybrid engine (d3q19_adj): Pallas runs the forward sweep, XLA
+    the backward — same traced action chain, so the gradients must agree
+    at f32 tolerance with the all-XLA adjoint."""
+    m = get_model("d3q19_adj")
+    shape = (6, 16, 128)
+    lat = Lattice(m, shape, dtype=jnp.float32,
+                  settings={"nu": 0.1, "Velocity": 0.02, "Porocity": 0.5,
+                            "DragInObj": 1.0})
+    flags = np.full(shape, m.flag_for("MRT"), np.uint16)
+    flags[:, 0, :] = flags[:, -1, :] = m.flag_for("Wall")
+    flags[1:4, 4:10, 20:40] |= m.flag_for("DesignSpace")
+    lat.set_flags(flags)
+    lat.init()
+    design = InternalTopology(m)
+    theta0 = design.get(lat.state, lat.params)
+    g_x = make_unsteady_gradient(m, design, 4, levels=1, engine="xla")
+    obj_x, gx, fin_x = g_x(theta0, lat.state, lat.params)
+    g_p = make_unsteady_gradient(m, design, 4, levels=1,
+                                 engine="pallas", shape=shape,
+                                 dtype=jnp.float32)
+    assert g_p.engine_name.startswith("pallas_adjoint3d")
+    assert "bwd=xla" in g_p.engine_name
+    obj_p, gp, fin_p = g_p(theta0, lat.state, lat.params)
+    gx, gp = np.asarray(gx), np.asarray(gp)
+    assert float(obj_x) == pytest.approx(float(obj_p), rel=1e-5)
+    assert np.abs(gx).max() > 0.0
+    np.testing.assert_allclose(gp, gx, rtol=1e-4, atol=1e-6)
+    np.testing.assert_allclose(np.asarray(fin_p.fields),
+                               np.asarray(fin_x.fields),
+                               rtol=1e-5, atol=1e-6)
 
 
 def test_pallas_gradient_vs_fd():

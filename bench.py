@@ -737,6 +737,78 @@ def bench_precision_ladder(results):
              2 * m.n_storage * 2 + 2)]
 
 
+def bench_gateway(results):
+    """Serving front door overhead: a parameter sweep submitted through
+    the HTTP gateway (validation + journal + admission + scheduler
+    rails) vs the same cases run directly on an EnsemblePlan.  The
+    interesting number is the per-job overhead the network path adds —
+    it should be dominated by the solve itself, with ONE compiled
+    executable shared by every case either way."""
+    import tempfile
+    import urllib.request
+
+    from tclb_tpu.control.sweep import expand_grid
+    from tclb_tpu.gateway.http import GatewayServer
+    from tclb_tpu.gateway.service import GatewayService
+    from tclb_tpu.models import get_model
+    from tclb_tpu.serve import EnsemblePlan
+
+    ny = nx = int(os.environ.get("TCLB_BENCH_GATEWAY_N", 64))
+    iters = int(os.environ.get("TCLB_BENCH_ITERS_GATEWAY", 50))
+    n_cases = int(os.environ.get("TCLB_BENCH_GATEWAY_CASES", 8))
+    grid = {"nu": f"0.02:0.08:{n_cases}"}
+    nodes = float(ny * nx)
+
+    # in-process baseline, warm AOT cache
+    from tclb_tpu.serve import CompiledCache
+    cache = CompiledCache(capacity=4)
+    plan = EnsemblePlan(get_model("d2q9"), (ny, nx),
+                        base_settings={"Velocity": 0.01})
+    cases = expand_grid(grid)
+    plan.run(cases, iters, cache=cache)
+    t0 = time.perf_counter()
+    plan.run(cases, iters, cache=cache)
+    direct_s = time.perf_counter() - t0
+
+    with tempfile.TemporaryDirectory() as root:
+        srv = GatewayServer(GatewayService(root)).start()
+        try:
+            body = json.dumps({
+                "model": "d2q9", "shape": [ny, nx], "niter": iters,
+                "params": {"Velocity": 0.01}, "sweep": grid}).encode()
+
+            def submit_and_wait():
+                req = urllib.request.Request(
+                    srv.url + "/v1/jobs", data=body, method="POST",
+                    headers={"Content-Type": "application/json"})
+                with urllib.request.urlopen(req, timeout=600) as r:
+                    jid = json.loads(r.read())["job"]["id"]
+                with urllib.request.urlopen(
+                        srv.url + f"/v1/jobs/{jid}/result?wait=600",
+                        timeout=600) as r:
+                    doc = json.loads(r.read())
+                assert doc["job"]["status"] == "done", doc
+                return doc
+
+            submit_and_wait()        # warmup: compile via the gateway
+            t0 = time.perf_counter()
+            submit_and_wait()
+            gw_s = time.perf_counter() - t0
+            stats = srv.service.cache.stats()
+        finally:
+            srv.stop()
+
+    assert stats["misses"] == 1, \
+        f"gateway sweep should compile once, saw {stats['misses']} misses"
+    results["gateway_direct_mlups"] = round(
+        nodes * n_cases * iters / direct_s / 1e6, 2)
+    results["gateway_http_mlups"] = round(
+        nodes * n_cases * iters / gw_s / 1e6, 2)
+    results["gateway_overhead_ms_per_job"] = round(
+        1e3 * (gw_s - direct_s), 2)
+    return []
+
+
 def main():
     import jax
 
@@ -762,6 +834,8 @@ def main():
         checks3d += bench_ensemble(results)
     with telemetry.span("bench.fleet"):
         checks3d += bench_fleet(results)
+    with telemetry.span("bench.gateway"):
+        checks3d += bench_gateway(results)
 
     dev = jax.devices()[0]
     hbm = HBM_GBS.get(dev.device_kind)

@@ -152,6 +152,13 @@ def main(argv=None) -> int:
     add_sweep_arguments(sw)
     sw.set_defaults(fn=run_sweep)
 
+    gw = sub.add_parser("gateway", help="multi-tenant HTTP serving "
+                        "gateway (persistent job store + admission "
+                        "control + checkpoint-backed resumability)")
+    from tclb_tpu.gateway.__main__ import add_gateway_arguments, run_gateway
+    add_gateway_arguments(gw)
+    gw.set_defaults(fn=run_gateway)
+
     ls = sub.add_parser("models", help="list the model catalogue")
     ls.add_argument("--verbose", "-v", action="store_true")
     ls.set_defaults(fn=_cmd_models)

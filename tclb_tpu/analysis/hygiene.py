@@ -694,6 +694,36 @@ def scan_device_work_in_monitor(paths=None) -> list:
     ``Lattice`` reference anywhere in the monitor module."""
     if paths is None:
         paths = [os.path.join(_PKG_ROOT, "telemetry", "http.py")]
+    return _scan_device_free_module(
+        paths, "hygiene.device_work_in_monitor",
+        "the monitor handler thread must only read registry/status "
+        "snapshots, never touch jax or device state (scrapes racing the "
+        "solve loop can deadlock dispatch); move the work behind a "
+        "status provider registered from the owning thread")
+
+
+def scan_device_work_in_gateway(paths=None) -> list:
+    """Same contract, serving front door: the gateway's HTTP handler
+    module (``gateway/http.py``) must never import jax or reference a
+    Lattice — handler threads validate, write store records, and wait on
+    plain events only.  Device work belongs to the
+    :class:`GatewayService` worker threads, so a slow or hostile client
+    can never fence, allocate on, or deadlock a device."""
+    if paths is None:
+        paths = [os.path.join(_PKG_ROOT, "gateway", "http.py")]
+    return _scan_device_free_module(
+        paths, "hygiene.device_work_in_gateway",
+        "the gateway handler thread must only validate, enqueue job "
+        "records and snapshot plain-python state, never touch jax or "
+        "device state (a slow client would be holding a device "
+        "hostage); move the work onto the GatewayService worker side")
+
+
+def _scan_device_free_module(paths, check_name: str, contract: str) -> list:
+    """Shared AST enforcement for modules whose threads must stay off
+    the device: no jax/jaxlib import, no ``device_put``/
+    ``block_until_ready``/``device_get`` call, no ``Lattice``
+    reference."""
     findings = []
     for path in paths:
         try:
@@ -708,12 +738,8 @@ def scan_device_work_in_monitor(paths=None) -> list:
 
         def flag(lineno: int, what: str) -> None:
             findings.append(Finding(
-                "hygiene.device_work_in_monitor", "error", "",
-                f"{rel}:{lineno} {what} — the monitor handler thread "
-                "must only read registry/status snapshots, never touch "
-                "jax or device state (scrapes racing the solve loop can "
-                "deadlock dispatch); move the work behind a status "
-                "provider registered from the owning thread",
+                check_name, "error", "",
+                f"{rel}:{lineno} {what} — {contract}",
                 f"{rel}:{lineno}"))
 
         for node in ast.walk(tree):
@@ -757,6 +783,7 @@ def check_repo(engine_dir=None, sources=None) -> list:
             + scan_ensemble_unsafe()
             + scan_unpinned_device_put()
             + scan_device_work_in_monitor()
+            + scan_device_work_in_gateway()
             + scan_unsafe_accum())
 
 

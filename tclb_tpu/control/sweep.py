@@ -62,6 +62,35 @@ def parse_param(spec: str) -> tuple[str, list[str]]:
     return name, [v.strip() for v in rhs.split(",") if v.strip()]
 
 
+def expand_grid(grid: dict) -> list[Case]:
+    """Cartesian sweep expansion for plain (units-free) grids — the
+    gateway's ``POST /v1/jobs`` ``sweep`` bodies.  Axis values are either
+    a ``"lo:hi:n"`` range string (same grammar as :func:`parse_param`)
+    or an explicit number list; values are already in lattice units (no
+    XML, no units engine).  An empty grid is one unnamed case."""
+    axes: list[tuple[str, list[float]]] = []
+    for name, raw in grid.items():
+        if isinstance(raw, str):
+            _, vals = parse_param(f"{name}={raw}")
+            axes.append((name, [float(v) for v in vals]))
+        elif isinstance(raw, (list, tuple)):
+            if not raw:
+                raise ValueError(f"sweep axis {name!r} is empty")
+            axes.append((name, [float(v) for v in raw]))
+        else:
+            raise ValueError(f"sweep axis {name!r} must be a 'lo:hi:n' "
+                             f"string or a number list")
+    if not axes:
+        return [Case(name="case0")]
+    cases = []
+    for combo in itertools.product(*(vals for _, vals in axes)):
+        settings = {name: v for (name, _), v in zip(axes, combo)}
+        cases.append(Case(settings=settings,
+                          name=",".join(f"{n}={v:g}"
+                                        for n, v in settings.items())))
+    return cases
+
+
 def load_setup(path: str, model: Optional[Model] = None,
                dtype: Any = None) -> SweepSetup:
     """Execute just the setup subtree of a config: units, geometry

@@ -1,0 +1,38 @@
+"""Network front door for the serving stack: HTTP job submission over
+the existing :class:`~tclb_tpu.serve.scheduler.Scheduler` rails.
+
+The gateway is the multi-tenant pod service the ROADMAP's "network
+serving plane" direction names: everything below the socket already
+exists (batched ensembles, the compiled-executable cache, fleet lanes,
+the monitor plane) — this package adds the socket:
+
+* :mod:`~tclb_tpu.gateway.http` — the stdlib-threaded HTTP API.  The
+  handler module is jax-free by static contract
+  (``hygiene.device_work_in_gateway``): handler threads only validate,
+  enqueue and snapshot plain-python state.
+* :mod:`~tclb_tpu.gateway.store` — the persistent job store: an
+  append-only JSONL journal compacted into atomic snapshots with the
+  checkpoint subsystem's fsync+rename helpers, so a gateway restart
+  recovers every queued/running/done job record.
+* :mod:`~tclb_tpu.gateway.tenancy` — per-tenant quotas and admission
+  control (structured 429s) over queue-depth signals.
+* :mod:`~tclb_tpu.gateway.service` — the jax-touching side: worker
+  threads that turn admitted records into ``JobSpec`` submissions, and
+  checkpoint-backed resumability for long jobs (periodic
+  ``CheckpointManager`` saves; a killed worker restarts from
+  ``latest()`` instead of iteration 0).
+"""
+
+from tclb_tpu.gateway.jobs import (CANCELLED, DONE, FAILED, QUEUED,  # noqa: F401
+                                   RUNNING, TERMINAL, JobRecord,
+                                   ValidationError, validate_body)
+from tclb_tpu.gateway.service import GatewayService  # noqa: F401
+from tclb_tpu.gateway.store import JobStore  # noqa: F401
+from tclb_tpu.gateway.tenancy import (AdmissionController,  # noqa: F401
+                                      TenancyConfig, TenantQuota)
+
+__all__ = [
+    "JobRecord", "JobStore", "GatewayService", "AdmissionController",
+    "TenancyConfig", "TenantQuota", "ValidationError", "validate_body",
+    "QUEUED", "RUNNING", "DONE", "FAILED", "CANCELLED", "TERMINAL",
+]

@@ -1,0 +1,80 @@
+"""Gateway CLI: ``python -m tclb_tpu gateway --port 8080 --store /var/jobs``.
+
+Stands up the full serving front door — persistent job store, admission
+control, scheduler, HTTP listener — and blocks until interrupted.  On
+restart with the same ``--store``, every non-terminal job is recovered:
+queued jobs re-run, resumable jobs continue from their newest
+checkpoint.
+"""
+
+from __future__ import annotations
+
+import argparse
+import sys
+import time
+
+
+def add_gateway_arguments(p: argparse.ArgumentParser) -> None:
+    p.add_argument("--host", default="127.0.0.1",
+                   help="bind address (default 127.0.0.1)")
+    p.add_argument("--port", type=int, default=8080,
+                   help="listen port (0 picks a free one)")
+    p.add_argument("--store", default="gateway-store",
+                   help="job store directory (journal + snapshots + "
+                   "per-job checkpoints); reuse it across restarts to "
+                   "recover jobs")
+    p.add_argument("--max-batch", type=int, default=None,
+                   help="cap cases per batched dispatch (default: "
+                   "memory-predicated)")
+    p.add_argument("--queue-limit", type=int, default=1024,
+                   help="global admission cap on queued cases")
+    p.add_argument("--quota-default", default=None, metavar="QUEUED[:WORK]",
+                   help="default per-tenant quota: max queued/running "
+                   "jobs, optionally :max inflight work "
+                   "(cells x niter x cases); '-' = unlimited")
+    p.add_argument("--quota", action="append", default=[],
+                   metavar="TENANT=QUEUED[:WORK]",
+                   help="per-tenant quota override (repeatable)")
+    p.add_argument("--monitor", default=None, metavar="[HOST]:PORT",
+                   help="also serve live /metrics + /status (the "
+                   "gateway registers its own status provider there)")
+
+
+def run_gateway(args) -> int:
+    from tclb_tpu.gateway.http import GatewayServer
+    from tclb_tpu.gateway.service import GatewayService
+    from tclb_tpu.gateway.tenancy import TenancyConfig
+
+    tenancy = TenancyConfig.parse(args.quota_default, args.quota)
+    monitor = None
+    if args.monitor:
+        from tclb_tpu.telemetry.http import MonitorServer
+        monitor = MonitorServer.from_spec(args.monitor).start()
+        print(f"monitor: {monitor.url}/status")
+    svc = GatewayService(args.store, tenancy=tenancy,
+                         queue_limit=args.queue_limit,
+                         max_batch=args.max_batch)
+    srv = GatewayServer(svc, host=args.host, port=args.port).start()
+    print(f"gateway: {srv.url}/v1/jobs  (store: {svc.store.root})")
+    try:
+        while True:
+            time.sleep(3600)
+    except KeyboardInterrupt:
+        print("gateway: shutting down")
+    finally:
+        srv.stop()
+        if monitor is not None:
+            monitor.stop()
+    return 0
+
+
+def main(argv=None) -> int:
+    p = argparse.ArgumentParser(
+        prog="tclb-gateway",
+        description="multi-tenant HTTP serving gateway")
+    add_gateway_arguments(p)
+    return run_gateway(p.parse_args(argv))
+
+
+if __name__ == "__main__":
+    sys.exit(main())

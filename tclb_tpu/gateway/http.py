@@ -1,0 +1,224 @@
+"""Gateway HTTP front door: job submission and retrieval over the wire.
+
+A stdlib-threaded (``http.server.ThreadingHTTPServer``) API surface over
+:class:`~tclb_tpu.gateway.service.GatewayService`:
+
+* ``POST /v1/jobs``                — submit one job (202), idempotent
+  retries via ``X-Idempotency-Key`` (200 + ``deduplicated``), quota
+  rejections as structured 429, validation problems as 400;
+* ``GET /v1/jobs[?tenant=&status=]`` — list job records;
+* ``GET /v1/jobs/<id>``            — one record;
+* ``GET /v1/jobs/<id>/result?wait=N`` — outcome; ``wait`` long-polls on
+  a plain event until the job is terminal (202 while in flight);
+* ``DELETE /v1/jobs/<id>`` (or ``POST /v1/jobs/<id>/cancel``) — cancel;
+* ``GET /healthz``                 — liveness.
+
+The tenant comes from the ``X-Tclb-Tenant`` header (or the body's
+``tenant`` key); unauthenticated multi-tenancy is a scoping mechanism,
+not a security boundary — put real auth in front for that.
+
+Hygiene contract (enforced by ``analysis.hygiene.device_work_in_gateway``):
+nothing in this module may touch jax, ``device_put``, or ``Lattice``
+state — handler threads only validate, write store records, and wait on
+events; the service's worker threads do every device-touching step.  A
+slow or hostile client can therefore never fence, allocate on, or
+deadlock a device.
+"""
+
+from __future__ import annotations
+
+import json
+import threading
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from typing import Optional
+from urllib.parse import parse_qs, urlparse
+
+_INDEX = (b"tclb_tpu gateway\n"
+          b"  POST   /v1/jobs                   submit a job\n"
+          b"  GET    /v1/jobs                   list jobs\n"
+          b"  GET    /v1/jobs/<id>              job record\n"
+          b"  GET    /v1/jobs/<id>/result?wait=N  outcome (long-poll)\n"
+          b"  DELETE /v1/jobs/<id>              cancel\n"
+          b"  GET    /healthz                   liveness\n")
+
+_MAX_BODY = 4 * 1024 * 1024  # a submission body is metadata, not data
+
+
+class _Handler(BaseHTTPRequestHandler):
+    server_version = "tclb-gateway"
+    protocol_version = "HTTP/1.1"
+
+    def log_message(self, fmt, *args):  # noqa: D102 — silence stderr
+        pass
+
+    @property
+    def service(self):
+        return self.server.service  # attached by GatewayServer.start
+
+    # -- plumbing ----------------------------------------------------------- #
+
+    def _send(self, code: int, body: bytes, ctype: str) -> None:
+        self.send_response(code)
+        self.send_header("Content-Type", ctype)
+        self.send_header("Content-Length", str(len(body)))
+        self.end_headers()
+        self.wfile.write(body)
+
+    def _send_json(self, code: int, doc: dict) -> None:
+        body = json.dumps(doc, indent=2, default=str).encode()
+        if code == 429 and "retry_after_s" in doc:
+            # surfaced as a real header too, for naive clients
+            self.send_response(429)
+            self.send_header("Retry-After",
+                             str(int(float(doc["retry_after_s"]) + 0.5)
+                                 or 1))
+            self.send_header("Content-Type", "application/json")
+            self.send_header("Content-Length", str(len(body) + 1))
+            self.end_headers()
+            self.wfile.write(body + b"\n")
+            return
+        self._send(code, body + b"\n", "application/json")
+
+    def _read_body(self) -> Optional[dict]:
+        n = int(self.headers.get("Content-Length") or 0)
+        if n <= 0 or n > _MAX_BODY:
+            return None
+        try:
+            return json.loads(self.rfile.read(n).decode())
+        except (ValueError, UnicodeDecodeError):
+            return None
+
+    # -- routes ------------------------------------------------------------- #
+
+    def do_POST(self):  # noqa: N802 — http.server API
+        url = urlparse(self.path)
+        parts = [p for p in url.path.split("/") if p]
+        try:
+            if parts[:2] == ["v1", "jobs"] and len(parts) == 2:
+                body = self._read_body()
+                if body is None:
+                    self._send_json(400, {"error": "body must be a JSON "
+                                                   "object"})
+                    return
+                code, doc = self.service.submit(
+                    body,
+                    tenant=self.headers.get("X-Tclb-Tenant"),
+                    idempotency_key=self.headers.get("X-Idempotency-Key"))
+                self._send_json(code, doc)
+            elif parts[:2] == ["v1", "jobs"] and len(parts) == 4 \
+                    and parts[3] == "cancel":
+                code, doc = self.service.cancel(parts[2])
+                self._send_json(code, doc)
+            else:
+                self._send_json(404, {"error": "no such route"})
+        except BrokenPipeError:  # pragma: no cover — client went away
+            pass
+        except Exception as e:  # noqa: BLE001 — a request must never
+            try:                # kill the gateway
+                self._send_json(500, {"error": repr(e)})
+            except Exception:  # noqa: BLE001
+                pass
+
+    def do_DELETE(self):  # noqa: N802 — http.server API
+        parts = [p for p in urlparse(self.path).path.split("/") if p]
+        try:
+            if parts[:2] == ["v1", "jobs"] and len(parts) == 3:
+                code, doc = self.service.cancel(parts[2])
+                self._send_json(code, doc)
+            else:
+                self._send_json(404, {"error": "no such route"})
+        except BrokenPipeError:  # pragma: no cover
+            pass
+        except Exception as e:  # noqa: BLE001
+            try:
+                self._send_json(500, {"error": repr(e)})
+            except Exception:  # noqa: BLE001
+                pass
+
+    def do_GET(self):  # noqa: N802 — http.server API
+        url = urlparse(self.path)
+        parts = [p for p in url.path.split("/") if p]
+        qs = parse_qs(url.query)
+        try:
+            if parts == ["healthz"]:
+                self._send_json(200, {"ok": True})
+            elif parts[:2] == ["v1", "jobs"] and len(parts) == 2:
+                code, doc = self.service.jobs(
+                    tenant=(qs.get("tenant") or [None])[0],
+                    status=(qs.get("status") or [None])[0])
+                self._send_json(code, doc)
+            elif parts[:2] == ["v1", "jobs"] and len(parts) == 3:
+                code, doc = self.service.job(parts[2])
+                self._send_json(code, doc)
+            elif parts[:2] == ["v1", "jobs"] and len(parts) == 4 \
+                    and parts[3] == "result":
+                wait = float((qs.get("wait") or ["0"])[0])
+                code, doc = self.service.result(parts[2], wait=wait)
+                self._send_json(code, doc)
+            elif not parts:
+                self._send(200, _INDEX, "text/plain; charset=utf-8")
+            else:
+                self._send_json(404, {"error": "no such route"})
+        except BrokenPipeError:  # pragma: no cover
+            pass
+        except Exception as e:  # noqa: BLE001
+            try:
+                self._send_json(500, {"error": repr(e)})
+            except Exception:  # noqa: BLE001
+                pass
+
+
+class GatewayServer:
+    """The network front door: a daemon-threaded HTTP server bound to a
+    :class:`GatewayService`.  ``start()`` starts the service (recovery +
+    worker) then the listener; ``stop()`` tears both down."""
+
+    def __init__(self, service, host: str = "127.0.0.1",
+                 port: int = 0) -> None:
+        self.service = service
+        self.host = host
+        self.port = port
+        self._server: Optional[ThreadingHTTPServer] = None
+        self._thread: Optional[threading.Thread] = None
+
+    @property
+    def url(self) -> str:
+        return "http://%s:%d" % (self.host, self.port)
+
+    def start(self) -> "GatewayServer":
+        if self._server is not None:
+            return self
+        self.service.start()
+        try:
+            srv = ThreadingHTTPServer((self.host, self.port), _Handler)
+        except Exception:
+            self.service.close()
+            raise
+        srv.daemon_threads = True
+        srv.service = self.service
+        self._server = srv
+        self.port = srv.server_address[1]
+        self._thread = threading.Thread(target=srv.serve_forever,
+                                        name="tclb-gateway-http",
+                                        daemon=True)
+        self._thread.start()
+        return self
+
+    def stop(self) -> None:
+        srv, self._server = self._server, None
+        if srv is None:
+            return
+        try:
+            srv.shutdown()
+            srv.server_close()
+        finally:
+            self.service.close()
+        if self._thread is not None:
+            self._thread.join(timeout=5)
+            self._thread = None
+
+    def __enter__(self) -> "GatewayServer":
+        return self.start()
+
+    def __exit__(self, *exc) -> None:
+        self.stop()

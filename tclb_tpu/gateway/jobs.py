@@ -1,0 +1,190 @@
+"""Gateway job records and submission validation — plain python only.
+
+A :class:`JobRecord` is the durable unit the store journals and the HTTP
+API serves back: the validated submission body plus lifecycle state.
+Everything in this module is JSON-round-trippable and jax-free — records
+are built and mutated on HTTP handler threads, which must never touch
+device state (the ``service`` worker threads do the jax work).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import math
+import time
+from typing import Any, Optional
+
+QUEUED, RUNNING = "queued", "running"
+DONE, FAILED, CANCELLED = "done", "failed", "cancelled"
+TERMINAL = frozenset({DONE, FAILED, CANCELLED})
+
+#: submission knobs the validator understands; anything else is a 400
+#: (catching typos like "iterations" for "niter" at the door)
+_KNOWN_KEYS = frozenset({
+    "model", "shape", "niter", "params", "sweep", "precision",
+    "storage_dtype", "resumable", "checkpoint_every", "timeout_s",
+    "tenant", "idempotency_key", "name", "digest",
+})
+
+_PRECISIONS = ("f32", "f64")
+_STORAGE_DTYPES = ("f32", "f64", "bf16")
+
+
+class ValidationError(ValueError):
+    """A malformed submission body (HTTP 400)."""
+
+
+@dataclasses.dataclass
+class JobRecord:
+    """One durable gateway job: the validated body + lifecycle state."""
+
+    id: str
+    tenant: str = "default"
+    body: dict = dataclasses.field(default_factory=dict)
+    status: str = QUEUED
+    idempotency_key: Optional[str] = None
+    created_ts: float = 0.0
+    updated_ts: float = 0.0
+    started_ts: Optional[float] = None
+    finished_ts: Optional[float] = None
+    # derived sizing, used by admission control (cells x niter x cases)
+    n_cases: int = 1
+    cells: int = 0
+    niter: int = 0
+    resumable: bool = False
+    checkpoint_every: int = 0
+    progress_iter: int = 0
+    resumed_from: Optional[int] = None
+    error: Optional[str] = None
+    # per-case outcome dicts ({name, settings, globals}) once done
+    results: Optional[list] = None
+
+    def work(self) -> int:
+        """The admission-control cost of this job: cells x niter x cases."""
+        return int(self.cells) * int(self.niter) * int(self.n_cases)
+
+    def touch(self) -> None:
+        self.updated_ts = round(time.time(), 6)
+
+    def to_dict(self) -> dict:
+        return dataclasses.asdict(self)
+
+    @classmethod
+    def from_dict(cls, doc: dict) -> "JobRecord":
+        known = {f.name for f in dataclasses.fields(cls)}
+        return cls(**{k: v for k, v in doc.items() if k in known})
+
+    def public(self) -> dict:
+        """The API view: the record without the raw body's bulk."""
+        doc = self.to_dict()
+        doc["work"] = self.work()
+        return doc
+
+
+def _require(cond: bool, msg: str) -> None:
+    if not cond:
+        raise ValidationError(msg)
+
+
+def validate_body(body: Any, known_models: Optional[list] = None) -> dict:
+    """Check a ``POST /v1/jobs`` body and derive the record sizing.
+
+    Pure syntactic validation — no model objects are built and no jax is
+    touched (this runs on the HTTP handler thread).  Returns a dict of
+    :class:`JobRecord` field overrides (``n_cases``/``cells``/``niter``/
+    ``resumable``/``checkpoint_every``).  Raises
+    :class:`ValidationError` on any problem."""
+    _require(isinstance(body, dict), "body must be a JSON object")
+    unknown = sorted(set(body) - _KNOWN_KEYS)
+    _require(not unknown, f"unknown keys: {unknown} "
+             f"(accepted: {sorted(_KNOWN_KEYS)})")
+
+    model = body.get("model")
+    _require(isinstance(model, str) and model,
+             "'model' must be a non-empty string")
+    if known_models is not None:
+        _require(model in known_models,
+                 f"unknown model {model!r} (have {sorted(known_models)})")
+
+    shape = body.get("shape")
+    _require(isinstance(shape, (list, tuple)) and len(shape) in (2, 3),
+             "'shape' must be a list of 2 or 3 ints")
+    for s in shape:
+        _require(isinstance(s, int) and not isinstance(s, bool) and s > 0,
+                 f"'shape' entries must be positive ints, got {s!r}")
+    cells = math.prod(int(s) for s in shape)
+
+    niter = body.get("niter")
+    _require(isinstance(niter, int) and not isinstance(niter, bool)
+             and niter > 0, "'niter' must be a positive int")
+
+    params = body.get("params", {})
+    _require(isinstance(params, dict), "'params' must be an object")
+    for k, v in params.items():
+        _require(isinstance(k, str) and isinstance(v, (int, float))
+                 and not isinstance(v, bool),
+                 f"'params' entries must be name -> number, got "
+                 f"{k!r}: {v!r}")
+
+    sweep = body.get("sweep", {})
+    _require(isinstance(sweep, dict), "'sweep' must be an object")
+    n_cases = 1
+    for k, v in sweep.items():
+        _require(isinstance(k, str), "'sweep' keys must be setting names")
+        n = _sweep_axis_len(k, v)
+        n_cases *= n
+    _require(n_cases >= 1, "'sweep' expands to zero cases")
+
+    precision = body.get("precision", "f32")
+    _require(precision in _PRECISIONS,
+             f"'precision' must be one of {_PRECISIONS}")
+    sdt = body.get("storage_dtype")
+    _require(sdt is None or sdt in _STORAGE_DTYPES,
+             f"'storage_dtype' must be one of {_STORAGE_DTYPES}")
+
+    resumable = bool(body.get("resumable", False))
+    every = body.get("checkpoint_every", 0)
+    _require(isinstance(every, int) and not isinstance(every, bool)
+             and every >= 0, "'checkpoint_every' must be an int >= 0")
+    if resumable:
+        _require(n_cases == 1,
+                 "resumable jobs take a single case (no 'sweep'); "
+                 "submit one job per point instead")
+    _require(isinstance(body.get("digest", False), bool),
+             "'digest' must be a bool")
+    timeout_s = body.get("timeout_s")
+    _require(timeout_s is None
+             or (isinstance(timeout_s, (int, float))
+                 and not isinstance(timeout_s, bool) and timeout_s > 0),
+             "'timeout_s' must be a positive number")
+
+    return {"n_cases": int(n_cases), "cells": int(cells),
+            "niter": int(niter), "resumable": resumable,
+            "checkpoint_every": int(every)}
+
+
+def _sweep_axis_len(name: str, spec: Any) -> int:
+    """Length of one sweep axis without materializing values (values
+    come later, on the worker, through control.sweep.expand_grid)."""
+    if isinstance(spec, (list, tuple)):
+        _require(len(spec) > 0, f"sweep axis {name!r} is an empty list")
+        for v in spec:
+            _require(isinstance(v, (int, float))
+                     and not isinstance(v, bool),
+                     f"sweep axis {name!r} entries must be numbers")
+        return len(spec)
+    if isinstance(spec, str):
+        parts = spec.split(":")
+        _require(len(parts) == 3,
+                 f"sweep axis {name!r} must be 'lo:hi:n' or a list")
+        try:
+            float(parts[0]), float(parts[1])
+            n = int(parts[2])
+        except ValueError:
+            raise ValidationError(
+                f"sweep axis {name!r}: bad range spec {spec!r}")
+        _require(n >= 1, f"sweep axis {name!r}: count must be >= 1")
+        return n
+    raise ValidationError(
+        f"sweep axis {name!r} must be a 'lo:hi:n' string or a number "
+        f"list, got {type(spec).__name__}")

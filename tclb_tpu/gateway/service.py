@@ -1,0 +1,523 @@
+"""Gateway service: admitted job records -> Scheduler submissions.
+
+The split that keeps the HTTP layer device-clean:
+
+* **handler-thread side** (``submit``/``job``/``jobs``/``result``/
+  ``cancel``/``_status``) — validation, admission control, store writes,
+  long-poll waits on plain ``threading.Event``s.  Zero jax.
+* **worker side** — a dispatcher thread turns queued records into
+  :class:`~tclb_tpu.serve.scheduler.JobSpec` bursts on the shared
+  :class:`Scheduler` (same-class cases of *different tenants* still bin
+  into one batched dispatch), and per-job threads drive **resumable**
+  jobs: the solve runs as checkpoint-sized segments through the same
+  scheduler rails, saving through :class:`CheckpointManager` after each
+  segment, so a SIGKILLed worker restarts from ``latest()`` instead of
+  iteration 0.  Every segment reuses one AOT-compiled executable (the
+  cache never keys on base state).
+
+Restart recovery: ``start()`` replays the job store and re-enqueues
+every non-terminal record — queued jobs run from scratch, resumable ones
+from their newest valid checkpoint (``gateway.resumed`` event).
+"""
+
+from __future__ import annotations
+
+import os
+import queue
+import threading
+import time
+from typing import Any, Optional
+
+from tclb_tpu import telemetry
+from tclb_tpu.gateway import jobs as J
+from tclb_tpu.gateway.jobs import JobRecord, ValidationError
+from tclb_tpu.gateway.store import JobStore
+from tclb_tpu.gateway.tenancy import AdmissionController, TenancyConfig
+from tclb_tpu.telemetry import live as tlive
+from tclb_tpu.utils import log
+
+
+def _now() -> float:
+    return round(time.time(), 6)
+
+
+def _state_digest(state) -> str:
+    """Content hash of a case's final fields — the bit-parity handle a
+    client can compare across serving paths (opt-in via ``digest``)."""
+    import hashlib
+
+    import numpy as np
+    arr = np.ascontiguousarray(np.asarray(state.fields))
+    return hashlib.sha256(arr.tobytes()).hexdigest()
+
+
+class GatewayService:
+    """The gateway's engine room: store + admission + scheduler glue."""
+
+    def __init__(self, store_root: str,
+                 tenancy: Optional[TenancyConfig] = None,
+                 queue_limit: Optional[int] = 1024,
+                 scheduler: Optional[Any] = None,
+                 max_batch: Optional[int] = None,
+                 cache: Optional[Any] = None,
+                 checkpoint_keep: int = 2,
+                 max_resumable: int = 4) -> None:
+        self.store = JobStore(store_root)
+        self.admission = AdmissionController(tenancy,
+                                             queue_limit=queue_limit)
+        self._cache = cache
+        self._sched = scheduler
+        self._owns_sched = scheduler is None
+        self._max_batch = max_batch
+        self.checkpoint_keep = max(1, int(checkpoint_keep))
+        self._work: queue.Queue[str] = queue.Queue()
+        self._done_events: dict[str, threading.Event] = {}
+        self._cancel: set[str] = set()
+        # scheduler job id -> (record id, case index) for async fan-in
+        self._pending_cases: dict[int, tuple[str, int]] = {}
+        self._case_slots: dict[str, list] = {}
+        self._lock = threading.RLock()
+        self._closing = False
+        self._worker: Optional[threading.Thread] = None
+        self._status_fn = None  # the exact callable given to register_status
+        self._resume_sem = threading.Semaphore(max(1, int(max_resumable)))
+        # plain-python tallies for /status (metrics live in the registry)
+        self._admitted = 0
+        self._rejected: dict[str, int] = {}
+        self._resumed = 0
+
+    # -- lifecycle ---------------------------------------------------------- #
+
+    @property
+    def cache(self):
+        """The scheduler's compiled-executable cache (built on start)."""
+        return self._cache
+
+    def start(self) -> "GatewayService":
+        if self._worker is not None:
+            return self
+        tlive.enable_live()  # gateway events -> /metrics registry
+        # pin ONE bound method: unregister_status only evicts the exact
+        # object it was given (attribute access rebinds each time)
+        self._status_fn = self._status
+        tlive.register_status("gateway", self._status_fn)
+        if self._sched is None:
+            from tclb_tpu.serve.cache import CompiledCache
+            from tclb_tpu.serve.scheduler import Scheduler
+            if self._cache is None:
+                self._cache = CompiledCache()
+            self._sched = Scheduler(max_batch=self._max_batch,
+                                    cache=self._cache,
+                                    on_result=self._on_sched_result,
+                                    autostart=True)
+        elif self._cache is None:
+            self._cache = getattr(self._sched, "cache", None)
+        self._recover()
+        self._worker = threading.Thread(target=self._loop,
+                                        name="tclb-gateway-worker",
+                                        daemon=True)
+        self._worker.start()
+        return self
+
+    def _recover(self) -> None:
+        """Re-enqueue every non-terminal record from the journal — a
+        restarted gateway picks its queue back up; resumable jobs will
+        restore from their newest checkpoint when they run."""
+        for rec in self.store.records():
+            if rec.status in J.TERMINAL:
+                continue
+            if rec.status == J.RUNNING:
+                rec.status = J.QUEUED
+                rec.touch()
+                self.store.put(rec)
+            telemetry.event("gateway.recovered", job_id=rec.id,
+                            tenant=rec.tenant, resumable=rec.resumable)
+            with self._lock:
+                self._done_events.setdefault(rec.id, threading.Event())
+            self._work.put(rec.id)
+
+    def close(self, wait: bool = True) -> None:
+        self._closing = True
+        started = self._worker is not None
+        if wait and started:
+            self._worker.join(timeout=30)
+        if self._owns_sched and self._sched is not None:
+            self._sched.close(wait=wait)
+        if self._status_fn is not None:
+            tlive.unregister_status("gateway", self._status_fn)
+            self._status_fn = None
+        if started:  # balance start()'s enable_live refcount
+            tlive.disable_live()
+        self.store.close()
+
+    def __enter__(self) -> "GatewayService":
+        return self.start()
+
+    def __exit__(self, *exc) -> None:
+        self.close()
+
+    # -- handler-thread API (zero device work) ------------------------------ #
+
+    def submit(self, body: Any, tenant: Optional[str] = None,
+               idempotency_key: Optional[str] = None
+               ) -> tuple[int, dict]:
+        """Validate + admit + persist + enqueue one submission; returns
+        ``(http_status, response_doc)``.  Safe on HTTP handler threads:
+        no jax, no device work — the worker thread does the heavy part."""
+        if self._closing:
+            return 503, {"error": "gateway is shutting down"}
+        if not isinstance(body, dict):
+            return 400, {"error": "invalid job",
+                         "detail": "body must be a JSON object"}
+        tenant = (tenant or body.get("tenant") or "default").strip()
+        idem = idempotency_key or body.get("idempotency_key")
+        try:
+            derived = J.validate_body(body,
+                                      known_models=self._model_names())
+        except ValidationError as e:
+            return 400, {"error": "invalid job", "detail": str(e)}
+        work = (derived["cells"] * derived["niter"]
+                * derived["n_cases"])
+        with self._lock:
+            existing = self.store.find_idempotent(tenant, idem)
+            if existing is not None:
+                return 200, {"job": existing.public(),
+                             "deduplicated": True}
+            rejection = self.admission.admit(
+                tenant, derived["n_cases"], work,
+                self.store.records(), queue_depth=self._queue_depth())
+            if rejection is not None:
+                self._rejected[rejection["reason"]] = \
+                    self._rejected.get(rejection["reason"], 0) + 1
+                telemetry.event("gateway.rejected", tenant=tenant,
+                                reason=rejection["reason"],
+                                model=body.get("model"))
+                telemetry.counter("gateway.jobs.rejected")
+                return 429, rejection
+            now = _now()
+            rec = JobRecord(id=self.store.new_id(), tenant=tenant,
+                            body=dict(body), idempotency_key=idem,
+                            created_ts=now, updated_ts=now, **derived)
+            self.store.put(rec)
+            self._done_events[rec.id] = threading.Event()
+            self._admitted += 1
+        telemetry.event("gateway.admitted", job_id=rec.id, tenant=tenant,
+                        model=body.get("model"), n_cases=rec.n_cases,
+                        niter=rec.niter, resumable=rec.resumable)
+        telemetry.counter("gateway.jobs.admitted")
+        self._work.put(rec.id)
+        return 202, {"job": rec.public()}
+
+    def job(self, job_id: str) -> tuple[int, dict]:
+        rec = self.store.get(job_id)
+        if rec is None:
+            return 404, {"error": f"no such job {job_id!r}"}
+        return 200, {"job": rec.public()}
+
+    def jobs(self, tenant: Optional[str] = None,
+             status: Optional[str] = None) -> tuple[int, dict]:
+        recs = self.store.records(tenant=tenant, status=status)
+        return 200, {"jobs": [r.public() for r in recs],
+                     "count": len(recs)}
+
+    def result(self, job_id: str,
+               wait: Optional[float] = None) -> tuple[int, dict]:
+        """The job's outcome; ``wait`` long-polls (bounded) on a plain
+        event until the job is terminal.  202 while still in flight."""
+        rec = self.store.get(job_id)
+        if rec is None:
+            return 404, {"error": f"no such job {job_id!r}"}
+        if wait and rec.status not in J.TERMINAL:
+            with self._lock:
+                ev = self._done_events.setdefault(job_id,
+                                                  threading.Event())
+            ev.wait(timeout=min(float(wait), 300.0))
+            rec = self.store.get(job_id) or rec
+        if rec.status not in J.TERMINAL:
+            return 202, {"job": rec.public()}
+        return 200, {"job": rec.public(), "results": rec.results}
+
+    def cancel(self, job_id: str) -> tuple[int, dict]:
+        """Cancel a job.  Queued jobs cancel immediately; a running
+        resumable job stops at its next segment boundary; a running
+        non-resumable job is already inside a device dispatch and cannot
+        be aborted (409)."""
+        with self._lock:
+            rec = self.store.get(job_id)
+            if rec is None:
+                return 404, {"error": f"no such job {job_id!r}"}
+            if rec.status in J.TERMINAL:
+                return 200, {"job": rec.public()}
+            self._cancel.add(job_id)
+            if rec.status == J.QUEUED:
+                self._finish_locked(rec, J.CANCELLED)
+                return 200, {"job": rec.public()}
+        if rec.resumable:
+            return 202, {"job": rec.public(),
+                         "detail": "cancelling at the next segment "
+                                   "boundary"}
+        return 409, {"job": rec.public(),
+                     "error": "job is inside a device dispatch; "
+                              "non-resumable jobs cannot be aborted "
+                              "mid-flight"}
+
+    def _status(self) -> dict:
+        """Plain-python /status provider fragment."""
+        by_status: dict[str, int] = {}
+        for rec in self.store.records():
+            by_status[rec.status] = by_status.get(rec.status, 0) + 1
+        with self._lock:
+            rejected = dict(self._rejected)
+            admitted = self._admitted
+            resumed = self._resumed
+        cache = self._cache
+        return {
+            "store": self.store.root,
+            "jobs": by_status,
+            "backlog": self._work.qsize(),
+            "admitted": admitted,
+            "rejected": rejected,
+            "resumed": resumed,
+            "cache": cache.stats() if cache is not None else None,
+            "closing": self._closing,
+        }
+
+    # -- handler-safe helpers ----------------------------------------------- #
+
+    _models_cache: Optional[list] = None
+
+    def _model_names(self) -> list:
+        if GatewayService._models_cache is None:
+            from tclb_tpu.models import list_models
+            GatewayService._models_cache = list(list_models())
+        return GatewayService._models_cache
+
+    def _queue_depth(self) -> int:
+        depth = self._work.qsize()
+        sched = self._sched
+        if sched is not None:
+            try:
+                depth += int(sched._status().get("queue_depth", 0))
+            except Exception:  # noqa: BLE001 — a signal, not a contract
+                pass
+        return depth
+
+    # -- worker side (jax-touching) ----------------------------------------- #
+
+    def _loop(self) -> None:
+        while not self._closing:
+            try:
+                jid = self._work.get(timeout=0.2)
+            except queue.Empty:
+                continue
+            rec = self.store.get(jid)
+            if rec is None or rec.status != J.QUEUED:
+                continue
+            try:
+                if rec.resumable:
+                    threading.Thread(target=self._run_resumable,
+                                     args=(rec,), daemon=True,
+                                     name=f"tclb-gateway-{rec.id}"
+                                     ).start()
+                else:
+                    self._dispatch(rec)
+            except BaseException as e:  # noqa: BLE001 — per-job verdict
+                log.warning(f"gateway: job {rec.id} failed to "
+                            f"dispatch: {e!r}")
+                rec.error = repr(e)
+                with self._lock:
+                    self._finish_locked(rec, J.FAILED)
+
+    def _job_pieces(self, rec: JobRecord):
+        """Model / dtypes / cases for one record (worker thread only)."""
+        import jax
+        import jax.numpy as jnp
+
+        from tclb_tpu.control.sweep import expand_grid
+        from tclb_tpu.models import get_model
+        model = get_model(rec.body["model"])
+        precision = rec.body.get("precision", "f32")
+        if precision == "f64":
+            jax.config.update("jax_enable_x64", True)
+        dtype = jnp.float64 if precision == "f64" else jnp.float32
+        sdt = {"bf16": jnp.bfloat16, "f32": jnp.float32,
+               "f64": jnp.float64}.get(rec.body.get("storage_dtype"))
+        cases = expand_grid(rec.body.get("sweep") or {})
+        return model, dtype, sdt, cases
+
+    def _dispatch(self, rec: JobRecord) -> None:
+        """Submit one record's cases as an atomic burst — same-class
+        cases (across records AND tenants) bin into batched dispatches
+        on the shared scheduler."""
+        from tclb_tpu.serve.scheduler import JobSpec
+        model, dtype, sdt, cases = self._job_pieces(rec)
+        shape = tuple(int(s) for s in rec.body["shape"])
+        params = dict(rec.body.get("params") or {})
+        specs = [JobSpec(model=model, shape=shape, case=c,
+                         niter=rec.niter, dtype=dtype, storage_dtype=sdt,
+                         base_settings=params or None,
+                         timeout_s=rec.body.get("timeout_s"),
+                         tenant=rec.tenant,
+                         name=f"{rec.id}/{c.name or i}")
+                 for i, c in enumerate(cases)]
+        rec.status = J.RUNNING
+        rec.started_ts = _now()
+        rec.touch()
+        self.store.put(rec)
+        with self._lock:
+            self._case_slots[rec.id] = [None] * len(specs)
+        jobs = self._sched.submit_many(specs)
+        with self._lock:
+            for i, j in enumerate(jobs):
+                self._pending_cases[j.id] = (rec.id, i)
+
+    def _on_sched_result(self, job) -> None:
+        """Scheduler ``on_result`` fan-in: collect per-case outcomes and
+        finish the record once every case is terminal."""
+        with self._lock:
+            ref = self._pending_cases.pop(job.id, None)
+            if ref is None:
+                return  # a resumable segment (driven synchronously)
+            rec_id, idx = ref
+            slots = self._case_slots.get(rec_id)
+            if slots is None:
+                return
+            slots[idx] = job
+            if any(s is None for s in slots):
+                return
+            del self._case_slots[rec_id]
+            rec = self.store.get(rec_id)
+        if rec is None:
+            return
+        results, errors = [], []
+        digest = bool(rec.body.get("digest"))
+        for s in slots:
+            if s.status == "done":
+                r = s._result
+                row = {"name": r.case.name,
+                       "settings": dict(r.case.settings),
+                       "globals": r.globals}
+                if digest:
+                    row["state_sha256"] = _state_digest(r.state)
+                results.append(row)
+            else:
+                results.append({"name": s.spec.name,
+                                "error": repr(s.error)})
+                errors.append(repr(s.error))
+        rec.results = results
+        if errors:
+            rec.error = "; ".join(errors[:4])
+        rec.progress_iter = rec.niter if not errors else rec.progress_iter
+        with self._lock:
+            self._finish_locked(rec, J.FAILED if errors else J.DONE)
+
+    def _ckpt_root(self, job_id: str) -> str:
+        return os.path.join(self.store.root, "ckpt", job_id)
+
+    def _run_resumable(self, rec: JobRecord) -> None:
+        with self._resume_sem:
+            try:
+                self._run_resumable_inner(rec)
+            except BaseException as e:  # noqa: BLE001 — per-job verdict
+                log.warning(f"gateway: resumable job {rec.id} "
+                            f"failed: {e!r}")
+                rec.error = repr(e)
+                with self._lock:
+                    self._finish_locked(rec, J.FAILED)
+
+    def _run_resumable_inner(self, rec: JobRecord) -> None:
+        """Drive one long job as checkpoint-sized segments through the
+        scheduler.  Each segment is a ``JobSpec`` whose plan continues
+        from the previous segment's final state (``init_on_run=False``
+        + ``rebase``); after each segment the lattice is saved through
+        :class:`CheckpointManager`.  On entry, a newest valid checkpoint
+        (from a previous incarnation of this process) short-circuits the
+        already-done prefix — the kill-resume contract, through the
+        serving path.  Segment boundaries are deterministic, so the
+        resumed trajectory is bit-identical to an uninterrupted one."""
+        import numpy as np
+
+        from tclb_tpu.checkpoint.manager import CheckpointManager
+        from tclb_tpu.core.lattice import Lattice
+        from tclb_tpu.serve.ensemble import Case, EnsemblePlan
+        from tclb_tpu.serve.scheduler import JobSpec
+        model, dtype, sdt, _ = self._job_pieces(rec)
+        shape = tuple(int(s) for s in rec.body["shape"])
+        params = dict(rec.body.get("params") or {})
+        niter = rec.niter
+        lat = Lattice(model, shape, dtype=dtype, storage_dtype=sdt,
+                      settings=params or None)
+        mgr = CheckpointManager(self._ckpt_root(rec.id),
+                                keep_last=self.checkpoint_keep)
+        newest = mgr.latest()
+        if newest is not None:
+            mgr.restore(lat, newest)
+            start = int(np.asarray(lat.state.iteration))
+            rec.resumed_from = start
+            with self._lock:
+                self._resumed += 1
+            telemetry.event("gateway.resumed", job_id=rec.id,
+                            tenant=rec.tenant, step=start, path=newest)
+            telemetry.counter("gateway.jobs.resumed")
+        else:
+            lat.init()
+            start = 0
+        rec.status = J.RUNNING
+        rec.started_ts = _now()
+        rec.progress_iter = start
+        rec.touch()
+        self.store.put(rec)
+        every = rec.checkpoint_every or max(1, niter // 10)
+        plan = EnsemblePlan(model, shape, dtype=dtype, storage_dtype=sdt,
+                            base=lat, init_on_run=False)
+        done = start
+        while done < niter:
+            if rec.id in self._cancel or self._closing:
+                with self._lock:
+                    self._finish_locked(rec, J.CANCELLED)
+                return
+            seg = min(every, niter - done)
+            spec = JobSpec(model=model, shape=shape,
+                           case=Case(name=rec.id), niter=seg,
+                           dtype=dtype, storage_dtype=sdt, plan=plan,
+                           tenant=rec.tenant, bin_tag=f"gw-{rec.id}",
+                           timeout_s=rec.body.get("timeout_s"),
+                           name=f"{rec.id}@{done}")
+            r = self._sched.submit(spec).result()
+            plan.rebase(r.state)
+            lat.state = r.state
+            done += seg
+            mgr.save(lat, step=done)
+            rec.progress_iter = done
+            rec.touch()
+            self.store.put(rec)
+        mgr.wait()
+        row = {"name": rec.id, "settings": params,
+               "globals": lat.get_globals()}
+        if rec.body.get("digest"):
+            row["state_sha256"] = _state_digest(lat.state)
+        rec.results = [row]
+        with self._lock:
+            self._finish_locked(rec, J.DONE)
+
+    # -- completion --------------------------------------------------------- #
+
+    def _finish_locked(self, rec: JobRecord, status: str) -> None:
+        """Terminal transition + durable write + wakeups.  Caller holds
+        ``_lock`` (or is single-threaded on this record)."""
+        rec.status = status
+        rec.finished_ts = _now()
+        rec.touch()
+        self.store.put(rec)
+        self._cancel.discard(rec.id)
+        ev = self._done_events.setdefault(rec.id, threading.Event())
+        ev.set()
+        wait_s = (None if rec.started_ts is None
+                  else round(rec.started_ts - rec.created_ts, 6))
+        telemetry.event("gateway.job_done", job_id=rec.id,
+                        tenant=rec.tenant, status=status,
+                        queue_wait_s=wait_s,
+                        wall_s=round(rec.finished_ts - rec.created_ts, 6),
+                        resumed=rec.resumed_from is not None)
+        telemetry.counter("gateway.jobs.done" if status == J.DONE
+                          else "gateway.jobs.failed")

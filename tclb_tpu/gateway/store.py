@@ -1,0 +1,172 @@
+"""Persistent job store: append-only JSONL journal + atomic snapshots.
+
+Durability model (the checkpoint subsystem's idioms, applied to job
+metadata):
+
+* every record mutation appends one ``{"op": "put", "record": ...}``
+  line to ``journal.jsonl`` (line-buffered — a SIGKILL loses at most the
+  line being written, never corrupts earlier ones);
+* every ``snapshot_every`` puts the whole store is compacted into
+  ``store.json`` via :func:`tclb_tpu.checkpoint.writer.atomic_write_bytes`
+  (temp + fsync + rename — readers never see a torn snapshot) and the
+  journal is truncated;
+* ``load()`` replays snapshot-then-journal, so a restarted gateway
+  recovers every queued/running/done record (:mod:`service` then
+  re-enqueues the non-terminal ones).
+
+Thread-safe; jax-free (HTTP handler threads write records directly).
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import threading
+from typing import Optional
+
+from tclb_tpu.checkpoint import writer
+from tclb_tpu.gateway.jobs import JobRecord
+
+SNAPSHOT_EVERY = 256
+
+
+class JobStore:
+    """Durable ``job_id -> JobRecord`` map with idempotency-key lookup."""
+
+    def __init__(self, root: str,
+                 snapshot_every: int = SNAPSHOT_EVERY) -> None:
+        self.root = os.path.abspath(root)
+        self.snapshot_every = max(1, int(snapshot_every))
+        self._snap_path = os.path.join(self.root, "store.json")
+        self._journal_path = os.path.join(self.root, "journal.jsonl")
+        self._lock = threading.RLock()
+        self._records: dict[str, JobRecord] = {}
+        # (tenant, idempotency_key) -> job id; a client retry after a
+        # dropped connection maps to the existing record, never a dupe
+        self._idem: dict[tuple[str, str], str] = {}
+        self._seq = 0
+        self._puts_since_snapshot = 0
+        self._journal = None
+        os.makedirs(self.root, exist_ok=True)
+        self._load()
+        self._open_journal()
+
+    # -- recovery ----------------------------------------------------------- #
+
+    def _load(self) -> None:
+        if os.path.exists(self._snap_path):
+            try:
+                with open(self._snap_path) as fh:
+                    doc = json.load(fh)
+                self._seq = int(doc.get("seq", 0))
+                for rd in doc.get("records", []):
+                    self._index(JobRecord.from_dict(rd))
+            except (OSError, ValueError, TypeError, KeyError):
+                # a torn snapshot cannot happen (atomic rename), but a
+                # hand-edited one can; fall back to the journal alone
+                self._records.clear()
+                self._idem.clear()
+        if os.path.exists(self._journal_path):
+            with open(self._journal_path) as fh:
+                for line in fh:
+                    line = line.strip()
+                    if not line:
+                        continue
+                    try:
+                        doc = json.loads(line)
+                    except json.JSONDecodeError:
+                        continue  # torn final line from a kill mid-write
+                    if doc.get("op") == "put":
+                        try:
+                            rec = JobRecord.from_dict(doc["record"])
+                        except (TypeError, KeyError):
+                            continue
+                        self._index(rec)
+                        self._seq = max(self._seq, _seq_of(rec.id))
+
+    def _index(self, rec: JobRecord) -> None:
+        self._records[rec.id] = rec
+        if rec.idempotency_key:
+            self._idem[(rec.tenant, rec.idempotency_key)] = rec.id
+
+    def _open_journal(self) -> None:
+        self._journal = open(self._journal_path, "a", buffering=1)
+
+    # -- mutation ----------------------------------------------------------- #
+
+    def new_id(self) -> str:
+        with self._lock:
+            self._seq += 1
+            return "j-%06d" % self._seq
+
+    def put(self, rec: JobRecord) -> None:
+        """Journal one record state (insert or overwrite), compacting
+        into an atomic snapshot every ``snapshot_every`` puts."""
+        with self._lock:
+            self._index(rec)
+            if self._journal is None:
+                # a late daemon thread finishing after close(): the
+                # final snapshot already captured everything durable
+                return
+            self._journal.write(
+                json.dumps({"op": "put", "record": rec.to_dict()}) + "\n")
+            self._puts_since_snapshot += 1
+            if self._puts_since_snapshot >= self.snapshot_every:
+                self.snapshot()
+
+    def snapshot(self) -> str:
+        """Compact the whole store into ``store.json`` (fsync + rename)
+        and truncate the journal."""
+        with self._lock:
+            doc = {"seq": self._seq,
+                   "records": [r.to_dict()
+                               for r in self._records.values()]}
+            writer.atomic_write_bytes(
+                self._snap_path,
+                json.dumps(doc, indent=1).encode())
+            self._journal.close()
+            self._journal = open(self._journal_path, "w", buffering=1)
+            self._puts_since_snapshot = 0
+            return self._snap_path
+
+    def close(self) -> None:
+        with self._lock:
+            if self._journal is not None:
+                self.snapshot()
+                self._journal.close()
+                self._journal = None
+
+    # -- queries ------------------------------------------------------------ #
+
+    def get(self, job_id: str) -> Optional[JobRecord]:
+        with self._lock:
+            return self._records.get(job_id)
+
+    def find_idempotent(self, tenant: str,
+                        key: Optional[str]) -> Optional[JobRecord]:
+        if not key:
+            return None
+        with self._lock:
+            jid = self._idem.get((tenant, key))
+            return self._records.get(jid) if jid else None
+
+    def records(self, tenant: Optional[str] = None,
+                status: Optional[str] = None) -> list[JobRecord]:
+        with self._lock:
+            out = list(self._records.values())
+        if tenant is not None:
+            out = [r for r in out if r.tenant == tenant]
+        if status is not None:
+            out = [r for r in out if r.status == status]
+        return sorted(out, key=lambda r: r.id)
+
+    def __len__(self) -> int:
+        with self._lock:
+            return len(self._records)
+
+
+def _seq_of(job_id: str) -> int:
+    try:
+        return int(job_id.rsplit("-", 1)[-1])
+    except ValueError:
+        return 0

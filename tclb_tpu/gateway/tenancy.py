@@ -1,0 +1,126 @@
+"""Per-tenant quotas and admission control — plain python only.
+
+Quotas bound two things per tenant: how many jobs may sit queued or
+running at once (``max_queued``), and how much *work* those jobs may
+represent (``max_inflight_work`` = sum of cells x niter x cases — the
+same working-set arithmetic the batch cap uses, so a tenant cannot park
+one enormous job inside a small job count).  On top of the per-tenant
+limits, a global ``queue_limit`` backpressures everyone using the
+scheduler's queue-depth signal.
+
+Rejections are structured (HTTP 429 with ``reason``/``limit``/
+``current``) so clients can distinguish "you are over quota" from "the
+pod is saturated" and back off accordingly.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Optional, Sequence
+
+from tclb_tpu.gateway.jobs import TERMINAL, JobRecord
+
+#: rejection reasons (stable API + metrics label values)
+REASON_MAX_QUEUED = "tenant_max_queued"
+REASON_MAX_WORK = "tenant_max_inflight_work"
+REASON_SATURATED = "queue_saturated"
+
+
+@dataclasses.dataclass(frozen=True)
+class TenantQuota:
+    """Limits for one tenant; ``None`` disables a limit."""
+
+    max_queued: Optional[int] = 64
+    max_inflight_work: Optional[int] = None
+
+    @classmethod
+    def parse(cls, spec: str) -> "TenantQuota":
+        """``QUEUED[:WORK]`` with ``-`` for unlimited, e.g. ``8:1e9``."""
+        parts = str(spec).split(":")
+        if len(parts) not in (1, 2):
+            raise ValueError(f"quota must be QUEUED[:WORK], got {spec!r}")
+
+        def num(s: str) -> Optional[int]:
+            s = s.strip()
+            if s in ("", "-"):
+                return None
+            return int(float(s))
+        work = num(parts[1]) if len(parts) == 2 else None
+        return cls(max_queued=num(parts[0]), max_inflight_work=work)
+
+
+@dataclasses.dataclass
+class TenancyConfig:
+    """The quota table: per-tenant overrides over a default."""
+
+    default: TenantQuota = dataclasses.field(default_factory=TenantQuota)
+    tenants: dict[str, TenantQuota] = dataclasses.field(
+        default_factory=dict)
+
+    def quota(self, tenant: str) -> TenantQuota:
+        return self.tenants.get(tenant, self.default)
+
+    @classmethod
+    def parse(cls, default_spec: Optional[str] = None,
+              tenant_specs: Sequence[str] = ()) -> "TenancyConfig":
+        """CLI surface: ``--quota-default 8:1e9`` and repeatable
+        ``--quota tenant=QUEUED[:WORK]``."""
+        default = (TenantQuota.parse(default_spec)
+                   if default_spec else TenantQuota())
+        tenants = {}
+        for spec in tenant_specs:
+            name, sep, rhs = str(spec).partition("=")
+            if not sep or not name.strip():
+                raise ValueError(
+                    f"--quota needs tenant=QUEUED[:WORK], got {spec!r}")
+            tenants[name.strip()] = TenantQuota.parse(rhs)
+        return cls(default=default, tenants=tenants)
+
+
+class AdmissionController:
+    """Stateless admission decisions over the store + scheduler signals.
+
+    ``admit`` returns ``None`` to accept, or a structured rejection dict
+    (the 429 body) naming the reason, the limit hit, and the current
+    level — computed from the tenant's non-terminal records plus the
+    global queue depth the scheduler's status provider reports."""
+
+    def __init__(self, config: Optional[TenancyConfig] = None,
+                 queue_limit: Optional[int] = 1024) -> None:
+        self.config = config or TenancyConfig()
+        self.queue_limit = queue_limit
+
+    def admit(self, tenant: str, n_cases: int, work: int,
+              active: Sequence[JobRecord],
+              queue_depth: int = 0) -> Optional[dict]:
+        active = [r for r in active if r.status not in TERMINAL]
+        if self.queue_limit is not None \
+                and queue_depth + n_cases > self.queue_limit:
+            return _reject(REASON_SATURATED, tenant,
+                           limit=self.queue_limit,
+                           current=queue_depth,
+                           detail="scheduler queue is saturated; "
+                                  "retry with backoff")
+        q = self.config.quota(tenant)
+        mine = [r for r in active if r.tenant == tenant]
+        if q.max_queued is not None and len(mine) + 1 > q.max_queued:
+            return _reject(REASON_MAX_QUEUED, tenant,
+                           limit=q.max_queued, current=len(mine),
+                           detail="tenant has too many queued/running "
+                                  "jobs; wait for completions")
+        if q.max_inflight_work is not None:
+            inflight = sum(r.work() for r in mine)
+            if inflight + work > q.max_inflight_work:
+                return _reject(REASON_MAX_WORK, tenant,
+                               limit=q.max_inflight_work,
+                               current=inflight,
+                               detail="tenant inflight work "
+                                      "(cells x niter x cases) over "
+                                      "quota")
+        return None
+
+
+def _reject(reason: str, tenant: str, limit, current, detail: str) -> dict:
+    return {"error": "quota exceeded", "reason": reason, "tenant": tenant,
+            "limit": limit, "current": current, "detail": detail,
+            "retry_after_s": 1.0}

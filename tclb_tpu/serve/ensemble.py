@@ -127,7 +127,8 @@ class EnsemblePlan:
                  base: Optional[Lattice] = None,
                  mode: str = "map",
                  storage_dtype: Any = None,
-                 grad: Optional[GradSpec] = None):
+                 grad: Optional[GradSpec] = None,
+                 init_on_run: bool = True):
         from tclb_tpu.ops.lbm import present_types
         if grad is not None and storage_dtype is not None and \
                 jnp.dtype(storage_dtype) != jnp.dtype(dtype):
@@ -167,6 +168,9 @@ class EnsemblePlan:
             self.model, present=self.present, mode=mode,
             storage_dtype=(self.storage_dtype if narrowed else None))
         self.grad = grad
+        # init_on_run=False plans continue from base_state as-is (resume
+        # segments): run() skips the Init stage unless told otherwise
+        self.init_on_run = bool(init_on_run)
 
     def engine_tag(self, batch: int) -> str:
         if self.grad is not None:
@@ -321,9 +325,21 @@ class EnsemblePlan:
                 grad=(None if grads is None else grads[k])))
         return results
 
+    def rebase(self, state: LatticeState) -> None:
+        """Replace the shared base state in place — a resume segment
+        starts every case from the previous segment's final state.  The
+        lazy host mirror is invalidated; params, flags and the compiled
+        engine are untouched (the AOT cache key never hashes base_state,
+        so every segment reuses one compiled executable)."""
+        self.base_state = state
+        self._host_state = None
+
     def run(self, cases: Sequence[Case], niter: int,
-            cache=None, init: bool = True) -> list[EnsembleResult]:
-        """Run the batch; returns per-case results in input order."""
+            cache=None, init: Optional[bool] = None
+            ) -> list[EnsembleResult]:
+        """Run the batch; returns per-case results in input order.
+        ``init=None`` follows the plan's ``init_on_run`` default."""
+        init = self.init_on_run if init is None else bool(init)
         cases = [c if isinstance(c, Case) else Case(settings=dict(c))
                  for c in cases]
         inputs = self.stack_cases(cases)

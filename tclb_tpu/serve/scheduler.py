@@ -23,9 +23,9 @@ from __future__ import annotations
 
 import dataclasses
 import hashlib
-import queue
 import threading
 import time
+from collections import OrderedDict, deque
 from typing import Any, Callable, Optional, Sequence
 
 import jax.numpy as jnp
@@ -74,6 +74,16 @@ class JobSpec:
     grad: Optional[GradSpec] = None
     timeout_s: Optional[float] = None
     name: str = ""
+    # multi-tenancy: jobs queue per tenant and the bin loop drains them
+    # round-robin (fair share); the empty string is the default tenant
+    tenant: str = ""
+    # opaque binning discriminator: jobs with different tags never share
+    # a batched dispatch even when everything else matches — the gateway
+    # stamps one per resumable job, whose plan carries job-private
+    # restored state that _bin_key's base-params digest cannot see.  The
+    # compiled-executable cache never keys on it, so tagged jobs still
+    # share AOT executables
+    bin_tag: str = ""
 
 
 class Job:
@@ -135,14 +145,15 @@ def _bin_key(spec: JobSpec) -> tuple:
         h = hashlib.sha1()
         h.update(np.asarray(spec.plan.base_params.settings).tobytes())
         h.update(np.asarray(spec.plan.base_params.zone_table).tobytes())
-        base: tuple = ("plan", h.hexdigest()[:16])
+        base: tuple = ("plan", h.hexdigest()[:16],
+                       bool(spec.plan.init_on_run))
     else:
         base = tuple(sorted((spec.base_settings or {}).items()))
     return (spec.model.fingerprint, tuple(spec.shape),
             str(jnp.dtype(spec.dtype)),
             str(jnp.dtype(spec.storage_dtype if spec.storage_dtype
                           is not None else spec.dtype)),
-            flags_digest, int(spec.niter), base,
+            flags_digest, int(spec.niter), base, spec.bin_tag,
             None if spec.grad is None else spec.grad.key())
 
 
@@ -170,13 +181,19 @@ class Scheduler:
         self._seq_runner = sequential_runner or (
             lambda plan, case, niter: plan.run_sequential(case, niter))
         self._on_result = on_result
-        self._queue: queue.Queue[Job] = queue.Queue()
+        # fair-share pending queues: one FIFO deque per tenant, drained
+        # round-robin by the bin loop (single-tenant deployments see the
+        # exact FIFO order a plain queue gave)
+        self._pending: OrderedDict[str, deque[Job]] = OrderedDict()
+        self._rr_last: Optional[str] = None
         self._plans: dict[tuple, EnsemblePlan] = {}
         self._jobs = 0
         self._lock = threading.Lock()
         # held across a submit_many burst AND the worker's bin drain, so
         # the worker's next batch sees a whole burst or none of it
-        self._admit = threading.Lock()
+        # (reentrant: submit() runs under it inside submit_many)
+        self._admit = threading.RLock()
+        self._avail = threading.Condition(self._admit)
         self._closing = False
         self._worker: Optional[threading.Thread] = None
         # every live handle, so close() can sweep jobs whose timeout
@@ -208,7 +225,9 @@ class Scheduler:
             self._jobs += 1
             job = Job(spec, self._jobs)
             self._inflight[job.id] = job
-        self._queue.put(job)
+        with self._avail:
+            self._pending.setdefault(spec.tenant, deque()).append(job)
+            self._avail.notify()
         telemetry.counter("serve.jobs.submitted")
         telemetry.event("serve.job_queued", job_id=job.id,
                         name=spec.name, model=spec.model.name,
@@ -246,7 +265,14 @@ class Scheduler:
                          "status": j.status,
                          "age_s": round(now - j.submitted, 3)}
                         for j in list(self._inflight.values())[:64]]
-        return {"queue_depth": self._queue.qsize(),
+        # never nested inside _lock: submit_many holds _admit and takes
+        # _lock, so _lock -> _avail here would deadlock against it
+        with self._avail:
+            depth = sum(len(d) for d in self._pending.values())
+            per_tenant = {t: len(d) for t, d in self._pending.items()
+                          if d}
+        return {"queue_depth": depth,
+                "queue_depth_by_tenant": per_tenant,
                 "jobs_submitted": self._jobs,
                 "inflight": inflight,
                 "closing": self._closing}
@@ -306,28 +332,67 @@ class Scheduler:
             cap = min(cap, int(self.max_batch))
         return max(1, cap)
 
-    def _take_batch(self) -> Optional[list[Job]]:
-        """One compatible batch off the queue (blocks briefly for the
-        first job; non-compatible jobs are requeued for the next lap)."""
-        try:
-            first = self._queue.get(timeout=0.1)
-        except queue.Empty:
+    def _pop_next_locked(self) -> Optional[Job]:
+        """The next batch head: round-robin across tenants with pending
+        work, FIFO within a tenant.  Caller holds ``_avail``."""
+        tenants = [t for t, d in self._pending.items() if d]
+        if not tenants:
             return None
-        # blocks until any in-flight submit_many burst is fully queued:
-        # `first` may be a burst's head popped mid-admission, and binning
-        # a prefix would split the batch (and fork its cache key)
-        with self._admit:
-            key = _bin_key(first.spec)
-            cap = self.batch_cap(first.spec)
-            batch, requeue = [first], []
-            while len(batch) < cap:
-                try:
-                    j = self._queue.get_nowait()
-                except queue.Empty:
+        start = 0
+        if self._rr_last is not None and self._rr_last in tenants:
+            start = tenants.index(self._rr_last) + 1
+        t = tenants[start % len(tenants)]
+        self._rr_last = t
+        return self._pending[t].popleft()
+
+    def _fill_batch_locked(self, batch: list[Job], key: tuple,
+                           cap: int) -> None:
+        """Fill ``batch`` with bin-compatible jobs up to ``cap``: one job
+        per tenant per pass (fair interleave), FIFO scan within each
+        tenant.  Incompatible jobs keep their queue position — no
+        requeue-to-tail reordering.  Caller holds ``_avail``."""
+        tenants = list(self._pending.keys())
+        if not tenants:
+            return
+        head = batch[0].spec.tenant
+        start = (tenants.index(head) + 1) if head in tenants else 0
+        order = tenants[start:] + tenants[:start]
+        cursor = {t: 0 for t in order}
+        progress = True
+        while len(batch) < cap and progress:
+            progress = False
+            for t in order:
+                if len(batch) >= cap:
                     break
-                (batch if _bin_key(j.spec) == key else requeue).append(j)
-            for j in requeue:
-                self._queue.put(j)
+                dq = self._pending.get(t)
+                i = cursor[t]
+                while dq is not None and i < len(dq):
+                    if _bin_key(dq[i].spec) == key:
+                        batch.append(dq[i])
+                        del dq[i]
+                        progress = True
+                        break
+                    i += 1
+                cursor[t] = i
+
+    def _take_batch(self) -> Optional[list[Job]]:
+        """One compatible batch off the pending queues (blocks briefly
+        for the first job).  Holding ``_avail`` (the admission lock's
+        condition) for the whole drain means an in-flight submit_many
+        burst is either fully visible or not at all — binning a prefix
+        would split the batch and fork its cache key."""
+        with self._avail:
+            job = self._pop_next_locked()
+            if job is None:
+                self._avail.wait(timeout=0.1)
+                job = self._pop_next_locked()
+                if job is None:
+                    return None
+            key = _bin_key(job.spec)
+            cap = self.batch_cap(job.spec)
+            batch = [job]
+            if cap > 1:
+                self._fill_batch_locked(batch, key, cap)
         return batch
 
     def _loop(self) -> None:
@@ -378,7 +443,8 @@ class Scheduler:
         with telemetry.span("serve.batch", batch=len(live), capacity=cap,
                             model=spec.model.name, niter=int(spec.niter),
                             engine=plan.engine_tag(len(live)),
-                            wait_s=waits, job_ids=job_ids) as sp:
+                            wait_s=waits, job_ids=job_ids,
+                            tenants=[j.spec.tenant for j in live]) as sp:
             results: Optional[list[EnsembleResult]] = None
             err: Optional[BaseException] = None
             for attempt in range(1 + self.retries):
@@ -417,7 +483,14 @@ class Scheduler:
                             error=repr(err))
             with telemetry.job_context(j.id):
                 try:
-                    r = self._seq_runner(plan, j.spec.case, spec.niter)
+                    if plan.init_on_run:
+                        r = self._seq_runner(plan, j.spec.case, spec.niter)
+                    else:
+                        # a continuation plan's state lives in base_state;
+                        # run_sequential would re-init from scratch, so
+                        # degrade to a singleton batch instead
+                        r = plan.run([j.spec.case], spec.niter,
+                                     cache=self.cache)[0]
                     j._finish(r, None)
                 except Exception as e:  # noqa: BLE001 - per-job verdict
                     j._finish(None, e)

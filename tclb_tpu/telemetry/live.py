@@ -84,6 +84,20 @@ _META = {
                            "Process counters from telemetry.counter(), "
                            "by name"),
     "tclb_events_total": ("counter", "Telemetry events observed, by kind"),
+    "tclb_gateway_admissions_total": ("counter",
+                                      "Gateway jobs admitted, by tenant"),
+    "tclb_gateway_rejections_total": ("counter",
+                                      "Gateway submissions rejected, by "
+                                      "reason/tenant"),
+    "tclb_gateway_resumed_total": ("counter",
+                                   "Gateway jobs resumed from a "
+                                   "checkpoint instead of iteration 0"),
+    "tclb_gateway_jobs_total": ("counter",
+                                "Gateway jobs finished, by terminal "
+                                "status"),
+    "tclb_gateway_queue_wait_seconds": ("histogram",
+                                        "Gateway job wait from admission "
+                                        "to first dispatch"),
 }
 
 CONTENT_TYPE = "text/plain; version=0.0.4; charset=utf-8"
@@ -326,6 +340,21 @@ def _observe(doc: dict) -> None:
     elif kind == "serve.job_done":
         reg.count("tclb_jobs_total", 1.0,
                   status=str(doc.get("status", "?")))
+    elif kind == "gateway.admitted":
+        reg.count("tclb_gateway_admissions_total", 1.0,
+                  tenant=str(doc.get("tenant", "?")))
+    elif kind == "gateway.rejected":
+        reg.count("tclb_gateway_rejections_total", 1.0,
+                  reason=str(doc.get("reason", "?")),
+                  tenant=str(doc.get("tenant", "?")))
+    elif kind == "gateway.resumed":
+        reg.count("tclb_gateway_resumed_total", 1.0)
+    elif kind == "gateway.job_done":
+        reg.count("tclb_gateway_jobs_total", 1.0,
+                  status=str(doc.get("status", "?")))
+        if doc.get("queue_wait_s") is not None:
+            reg.observe("tclb_gateway_queue_wait_seconds",
+                        doc["queue_wait_s"])
 
 
 def enable_live() -> MetricsRegistry:

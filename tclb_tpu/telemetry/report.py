@@ -179,6 +179,52 @@ def _fleet_summary(evts: list[dict]) -> dict:
     }
 
 
+def _gateway_summary(evts: list[dict]) -> dict:
+    """The serving front door's health numbers (from ``gateway.*``
+    events): admissions, rejections by reason, per-tenant queue-wait
+    percentiles and the resumed-job count.  Empty dict when the trace
+    has no gateway activity."""
+    admitted = [e for e in evts if e.get("kind") == "gateway.admitted"]
+    rejected = [e for e in evts if e.get("kind") == "gateway.rejected"]
+    resumed = [e for e in evts if e.get("kind") == "gateway.resumed"]
+    done = [e for e in evts if e.get("kind") == "gateway.job_done"]
+    recovered = sum(1 for e in evts
+                    if e.get("kind") == "gateway.recovered")
+    if not admitted and not rejected and not done:
+        return {}
+    by_reason: dict[str, int] = {}
+    for e in rejected:
+        r = str(e.get("reason", "?"))
+        by_reason[r] = by_reason.get(r, 0) + 1
+    by_status: dict[str, int] = {}
+    waits: dict[str, list] = {}
+    for e in done:
+        by_status[str(e.get("status", "?"))] = \
+            by_status.get(str(e.get("status", "?")), 0) + 1
+        if e.get("queue_wait_s") is not None:
+            waits.setdefault(str(e.get("tenant", "?")), []).append(
+                float(e["queue_wait_s"]))
+    tenants: dict[str, dict] = {}
+    for t, vals in sorted(waits.items()):
+        p50, p95 = _percentile(vals, 0.50), _percentile(vals, 0.95)
+        tenants[t] = {
+            "jobs": len(vals),
+            "queue_wait_p50_s": None if p50 is None else round(p50, 6),
+            "queue_wait_p95_s": None if p95 is None else round(p95, 6)}
+    total = len(admitted) + len(rejected)
+    return {
+        "admitted": len(admitted),
+        "rejected": len(rejected),
+        "admission_rate_pct": (round(100.0 * len(admitted) / total, 2)
+                               if total else None),
+        "rejections_by_reason": dict(sorted(by_reason.items())),
+        "jobs_by_status": dict(sorted(by_status.items())),
+        "resumed": len(resumed),
+        "recovered": recovered,
+        "tenants": tenants,
+    }
+
+
 def summarize(evts: list[dict]) -> dict:
     """Aggregate one trace into the report structure (all plain dicts,
     JSON-serializable as-is)."""
@@ -272,6 +318,7 @@ def summarize(evts: list[dict]) -> dict:
             "serving": _serving_summary(evts),
             "adjoint": _adjoint_summary(evts),
             "fleet": _fleet_summary(evts),
+            "gateway": _gateway_summary(evts),
             "engine_selected": [
                 {k: v for k, v in e.items() if k not in ("kind",)}
                 for e in selected],
@@ -376,6 +423,41 @@ def compare(base: dict, other: dict, threshold: float = 0.05) -> dict:
             out["regressions"].append({
                 "what": "fleet_lanes_active", "base": la, "other": lb})
         out["fleet"] = row
+    # gateway health: a falling admission rate means quota/saturation
+    # rejections grew; a growing queue-wait p95 (worst tenant) means
+    # jobs sit admitted-but-undispatched longer — both are front-door
+    # regressions the span timings cannot see
+    ga = base.get("gateway") or {}
+    gb = other.get("gateway") or {}
+    if ga or gb:
+        def worst_p95(g: dict):
+            vals = [t.get("queue_wait_p95_s")
+                    for t in (g.get("tenants") or {}).values()
+                    if t.get("queue_wait_p95_s") is not None]
+            return max(vals) if vals else None
+        row = {"base_admission_rate_pct": ga.get("admission_rate_pct"),
+               "other_admission_rate_pct": gb.get("admission_rate_pct"),
+               "base_queue_wait_p95_s": worst_p95(ga),
+               "other_queue_wait_p95_s": worst_p95(gb)}
+        av, bv = ga.get("admission_rate_pct"), gb.get("admission_rate_pct")
+        if av and bv is not None:
+            delta = (bv - av) / av
+            row["admission_rate_delta_pct"] = round(100 * delta, 2)
+            if delta < -threshold:
+                out["regressions"].append({
+                    "what": "gateway_admission_rate", "base": av,
+                    "other": bv,
+                    "delta_pct": row["admission_rate_delta_pct"]})
+        wa, wb = worst_p95(ga), worst_p95(gb)
+        if wa and wb is not None:
+            delta = (wb - wa) / wa
+            row["queue_wait_p95_delta_pct"] = round(100 * delta, 2)
+            if delta > threshold:
+                out["regressions"].append({
+                    "what": "gateway_queue_wait_p95", "base": wa,
+                    "other": wb,
+                    "delta_pct": row["queue_wait_p95_delta_pct"]})
+        out["gateway"] = row
     # fallback-chain drift is a regression signal of its own (an engine
     # newly failing to compile shows up here before any timing does)
     fb_a = [(f.get("from"), f.get("to")) for f in base.get("fallbacks", [])]
@@ -544,6 +626,28 @@ def format_text(summary: dict) -> str:
             f"  queue wait p50 {_fmt(fl['queue_wait_p50_s'], 4)}s  "
             f"p95 {_fmt(fl['queue_wait_p95_s'], 4)}s")
         lines.append("")
+    if summary.get("gateway"):
+        gw = summary["gateway"]
+        lines.append("gateway")
+        lines.append(
+            f"  admitted {gw['admitted']}  rejected {gw['rejected']}  "
+            f"admission rate {_fmt(gw['admission_rate_pct'], 1)}%  "
+            f"resumed {gw['resumed']}  recovered {gw['recovered']}")
+        if gw["rejections_by_reason"]:
+            lines.append("  rejections: " + "  ".join(
+                f"{r}={n}" for r, n in gw["rejections_by_reason"].items()))
+        if gw["jobs_by_status"]:
+            lines.append("  outcomes:   " + "  ".join(
+                f"{s}={n}" for s, n in gw["jobs_by_status"].items()))
+        if gw["tenants"]:
+            lines.append(f"  {'tenant':<28} {'jobs':>6} {'wait_p50_s':>11} "
+                         f"{'wait_p95_s':>11}")
+            for t, r in gw["tenants"].items():
+                lines.append(
+                    f"  {t:<28} {r['jobs']:>6} "
+                    f"{_fmt(r['queue_wait_p50_s'], 4):>11} "
+                    f"{_fmt(r['queue_wait_p95_s'], 4):>11}")
+        lines.append("")
     if summary["engine_selected"]:
         lines.append("engine selections")
         for e in summary["engine_selected"]:
@@ -614,6 +718,15 @@ def format_compare_text(diff: dict) -> str:
             f"{_fmt(fl['other_staging_overlap_pct'], 1)}%, lanes "
             f"{_fmt(fl['base_lanes_active'])} -> "
             f"{_fmt(fl['other_lanes_active'])}")
+    if diff.get("gateway"):
+        gw = diff["gateway"]
+        lines.append(
+            "  gateway: admission rate "
+            f"{_fmt(gw['base_admission_rate_pct'], 1)}% -> "
+            f"{_fmt(gw['other_admission_rate_pct'], 1)}%, "
+            "queue wait p95 "
+            f"{_fmt(gw['base_queue_wait_p95_s'], 4)}s -> "
+            f"{_fmt(gw['other_queue_wait_p95_s'], 4)}s")
     if diff.get("fallback_drift"):
         lines.append("  fallback drift: "
                      f"base={diff['fallback_drift']['base']} "

@@ -1,0 +1,619 @@
+"""Gateway subsystem tests: submission validation, the persistent job
+store's journal/snapshot recovery, per-tenant admission control
+(structured 429s), idempotent retries, the fair-share scheduler queue,
+HTTP end-to-end bit-parity against the in-process ensemble path, the
+gateway hygiene check, the telemetry Gateway table, and (slow) the
+kill-resume contract through the serving path.
+"""
+
+import json
+import os
+import signal
+import subprocess
+import sys
+import time
+import urllib.error
+import urllib.request
+
+import numpy as np
+import pytest
+
+from tclb_tpu import telemetry
+from tclb_tpu.analysis import hygiene
+from tclb_tpu.control.sweep import expand_grid
+from tclb_tpu.gateway import jobs as J
+from tclb_tpu.gateway.jobs import JobRecord, ValidationError, validate_body
+from tclb_tpu.gateway.service import GatewayService
+from tclb_tpu.gateway.store import JobStore
+from tclb_tpu.gateway.tenancy import (
+    REASON_MAX_QUEUED, REASON_MAX_WORK, REASON_SATURATED,
+    AdmissionController, TenancyConfig, TenantQuota)
+from tclb_tpu.serve import Case, EnsemblePlan, JobSpec, Scheduler
+from tclb_tpu.telemetry import live, report
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+@pytest.fixture(autouse=True)
+def _sink_off():
+    telemetry.disable()
+    live.registry().reset()
+    yield
+    telemetry.disable()
+    live.registry().reset()
+
+
+# --------------------------------------------------------------------------- #
+# Submission validation
+# --------------------------------------------------------------------------- #
+
+
+def test_validate_body_derives_sizing():
+    d = validate_body({"model": "d2q9", "shape": [16, 32], "niter": 10,
+                       "sweep": {"nu": "0.01:0.05:3",
+                                 "Velocity": [0.01, 0.02]}})
+    assert d == {"n_cases": 6, "cells": 512, "niter": 10,
+                 "resumable": False, "checkpoint_every": 0}
+
+
+@pytest.mark.parametrize("body,needle", [
+    ({"shape": [4, 4], "niter": 1}, "model"),
+    ({"model": "d2q9", "niter": 1}, "shape"),
+    ({"model": "d2q9", "shape": [4], "niter": 1}, "shape"),
+    ({"model": "d2q9", "shape": [4, 0], "niter": 1}, "positive"),
+    ({"model": "d2q9", "shape": [4, 4]}, "niter"),
+    ({"model": "d2q9", "shape": [4, 4], "niter": 1,
+      "iterations": 5}, "unknown keys"),
+    ({"model": "d2q9", "shape": [4, 4], "niter": 1,
+      "params": {"nu": "x"}}, "params"),
+    ({"model": "d2q9", "shape": [4, 4], "niter": 1,
+      "sweep": {"nu": "0.1:0.2"}}, "lo:hi:n"),
+    ({"model": "d2q9", "shape": [4, 4], "niter": 1,
+      "sweep": {"nu": []}}, "empty"),
+    ({"model": "d2q9", "shape": [4, 4], "niter": 1,
+      "precision": "f16"}, "precision"),
+    ({"model": "d2q9", "shape": [4, 4], "niter": 1,
+      "resumable": True, "sweep": {"nu": [0.1, 0.2]}}, "single case"),
+])
+def test_validate_body_rejects(body, needle):
+    with pytest.raises(ValidationError, match=needle):
+        validate_body(body)
+
+
+def test_validate_body_checks_model_catalogue():
+    with pytest.raises(ValidationError, match="unknown model"):
+        validate_body({"model": "nope", "shape": [4, 4], "niter": 1},
+                      known_models=["d2q9"])
+
+
+def test_expand_grid_matches_axis_lengths():
+    cases = expand_grid({"nu": "0.01:0.05:3", "Velocity": [0.01, 0.02]})
+    assert len(cases) == 6
+    assert cases[0].settings == {"nu": 0.01, "Velocity": 0.01}
+    assert cases[-1].settings == {"nu": 0.05, "Velocity": 0.02}
+    assert expand_grid({})[0].name == "case0"
+
+
+# --------------------------------------------------------------------------- #
+# Persistent job store
+# --------------------------------------------------------------------------- #
+
+
+def _rec(store, **kw):
+    kw.setdefault("id", store.new_id())
+    rec = JobRecord(**kw)
+    store.put(rec)
+    return rec
+
+
+def test_store_journal_roundtrip(tmp_path):
+    root = str(tmp_path / "store")
+    st = JobStore(root)
+    a = _rec(st, tenant="acme", body={"model": "d2q9"},
+             idempotency_key="k1")
+    b = _rec(st, tenant="beta", status=J.RUNNING)
+    a.status = J.DONE
+    a.results = [{"globals": {"x": 1.5}}]
+    st.put(a)
+    # journal-only recovery (no snapshot yet): a reopened store sees the
+    # LAST state of each record and continues the id sequence
+    st2 = JobStore(root)
+    assert len(st2) == 2
+    assert st2.get(a.id).status == J.DONE
+    assert st2.get(a.id).results == [{"globals": {"x": 1.5}}]
+    assert st2.get(b.id).status == J.RUNNING
+    assert st2.find_idempotent("acme", "k1").id == a.id
+    assert st2.find_idempotent("beta", "k1") is None
+    assert st2.new_id() == "j-000003"
+
+
+def test_store_snapshot_compacts_journal(tmp_path):
+    root = str(tmp_path / "store")
+    st = JobStore(root, snapshot_every=4)
+    recs = [_rec(st) for _ in range(4)]  # 4th put triggers a snapshot
+    assert os.path.exists(os.path.join(root, "store.json"))
+    assert os.path.getsize(os.path.join(root, "journal.jsonl")) == 0
+    st2 = JobStore(root)
+    assert sorted(r.id for r in st2.records()) \
+        == sorted(r.id for r in recs)
+
+
+def test_store_skips_torn_journal_line(tmp_path):
+    root = str(tmp_path / "store")
+    st = JobStore(root)
+    ok = _rec(st, tenant="acme")
+    st._journal.write('{"op": "put", "record": {"id": "j-9')  # torn
+    st._journal.flush()
+    st2 = JobStore(root)
+    assert [r.id for r in st2.records()] == [ok.id]
+
+
+# --------------------------------------------------------------------------- #
+# Quotas and admission control
+# --------------------------------------------------------------------------- #
+
+
+def test_quota_parse_grammar():
+    assert TenantQuota.parse("8") == TenantQuota(8, None)
+    assert TenantQuota.parse("8:1e6") == TenantQuota(8, 1000000)
+    assert TenantQuota.parse("-:5") == TenantQuota(None, 5)
+    with pytest.raises(ValueError):
+        TenantQuota.parse("1:2:3")
+    cfg = TenancyConfig.parse("4", ["acme=16:1e9"])
+    assert cfg.quota("acme") == TenantQuota(16, 10 ** 9)
+    assert cfg.quota("other") == TenantQuota(4, None)
+
+
+def test_admission_rejects_with_structured_reasons():
+    cfg = TenancyConfig.parse("2:1000", [])
+    adm = AdmissionController(cfg, queue_limit=10)
+    done = JobRecord(id="j-1", tenant="t", status=J.DONE,
+                     cells=1, niter=1)
+    run = JobRecord(id="j-2", tenant="t", status=J.RUNNING,
+                    cells=10, niter=10)  # work 100
+    # terminal records never count against the quota
+    assert adm.admit("t", 1, 100, [done, run]) is None
+    r = adm.admit("t", 1, 100, [done, run,
+                                JobRecord(id="j-3", tenant="t")])
+    assert r["reason"] == REASON_MAX_QUEUED and r["limit"] == 2
+    r = adm.admit("t", 1, 950, [run])
+    assert r["reason"] == REASON_MAX_WORK and r["current"] == 100
+    r = adm.admit("t", 8, 1, [], queue_depth=5)
+    assert r["reason"] == REASON_SATURATED
+    assert r["retry_after_s"] > 0
+    # another tenant's load never hits t's per-tenant limits
+    other = [JobRecord(id=f"j-{i}", tenant="u") for i in range(5)]
+    assert adm.admit("t", 1, 1, other) is None
+
+
+# --------------------------------------------------------------------------- #
+# Fair-share scheduler queue + bin_tag isolation
+# --------------------------------------------------------------------------- #
+
+
+def _plan_specs(plan, nus, niter=6, **kw):
+    return [JobSpec(model=plan.model, shape=plan.shape,
+                    case=Case(settings={"nu": v}, name=f"nu={v}"),
+                    niter=niter, flags=plan.flags,
+                    base_settings={"nu": 0.05, "Velocity": 0.02},
+                    name=f"nu={v}", **kw) for v in nus]
+
+
+def _channel_plan(ny=12, nx=24, **kw):
+    from tclb_tpu.models import get_model
+    m = get_model("d2q9")
+    flags = np.full((ny, nx), m.flag_for("MRT"), dtype=np.uint16)
+    flags[0, :] = flags[-1, :] = m.flag_for("Wall")
+    return EnsemblePlan(m, (ny, nx), flags=flags,
+                        base_settings={"nu": 0.05, "Velocity": 0.02}, **kw)
+
+
+def test_scheduler_fair_share_across_tenants():
+    """One tenant pre-loading N jobs cannot starve another: with a
+    batch cap of 1 (no co-batching), dispatch order alternates between
+    the tenants rather than draining the first tenant's backlog."""
+    order = []
+
+    def runner(plan, cases, niter):
+        order.extend(c.name for c in cases)
+        return ["ok"] * len(cases)
+
+    plan = _channel_plan()
+    with Scheduler(max_batch=1, batch_runner=runner,
+                   autostart=False) as sched:
+        specs = (_plan_specs(plan, (0.01, 0.02, 0.03), tenant="big")
+                 + _plan_specs(plan, (0.07, 0.08), tenant="small"))
+        jobs = sched.run(specs)
+    assert all(j.status == "done" for j in jobs)
+    # round-robin: big, small, big, small, big
+    assert order == ["nu=0.01", "nu=0.07", "nu=0.02", "nu=0.08",
+                     "nu=0.03"]
+
+
+def test_scheduler_fair_share_still_cobatches_across_tenants():
+    """Fairness orders the queue; it does not fragment batches — cases
+    of the SAME ensemble class from different tenants still share one
+    dispatch."""
+    batches = []
+
+    def runner(plan, cases, niter):
+        batches.append([c.name for c in cases])
+        return ["ok"] * len(cases)
+
+    plan = _channel_plan()
+    with Scheduler(max_batch=8, batch_runner=runner,
+                   autostart=False) as sched:
+        specs = (_plan_specs(plan, (0.01, 0.02), tenant="a")
+                 + _plan_specs(plan, (0.03, 0.04), tenant="b"))
+        sched.run(specs)
+    assert len(batches) == 1
+    assert sorted(batches[0]) == ["nu=0.01", "nu=0.02", "nu=0.03",
+                                  "nu=0.04"]
+
+
+def test_bin_tag_splits_batches_but_not_plans():
+    """Jobs with different bin_tags never share a dispatch (the gateway
+    stamps one per resumable job whose plan carries private state), even
+    when every other bin-key component matches."""
+    batches = []
+
+    def runner(plan, cases, niter):
+        batches.append([c.name for c in cases])
+        return ["ok"] * len(cases)
+
+    plan = _channel_plan()
+    with Scheduler(max_batch=8, batch_runner=runner,
+                   autostart=False) as sched:
+        specs = (_plan_specs(plan, (0.01, 0.02), bin_tag="gw-j1")
+                 + _plan_specs(plan, (0.03, 0.04), bin_tag="gw-j2"))
+        sched.run(specs)
+    assert len(batches) == 2
+    assert sorted(len(b) for b in batches) == [2, 2]
+
+
+# --------------------------------------------------------------------------- #
+# HTTP end-to-end: parity, idempotency, quotas, recovery
+# --------------------------------------------------------------------------- #
+
+
+def _http(url, method="GET", body=None, headers=None):
+    req = urllib.request.Request(
+        url, method=method,
+        data=json.dumps(body).encode() if body is not None else None,
+        headers={"Content-Type": "application/json", **(headers or {})})
+    try:
+        with urllib.request.urlopen(req, timeout=300) as resp:
+            return resp.status, json.loads(resp.read()), resp.headers
+    except urllib.error.HTTPError as e:
+        return e.code, json.loads(e.read()), e.headers
+
+
+def test_gateway_http_end_to_end(tmp_path):
+    """A 4-case sweep submitted over HTTP runs through the scheduler
+    rails with ONE compiled executable and lands bit-identical to the
+    in-process ensemble path; retries dedupe; quota violations 429; the
+    rejection reaches the metrics registry."""
+    from tclb_tpu.gateway.http import GatewayServer
+    svc = GatewayService(str(tmp_path / "store"),
+                         tenancy=TenancyConfig.parse("2", []))
+    with GatewayServer(svc) as srv:
+        body = {"model": "d2q9", "shape": [12, 24], "niter": 8,
+                "params": {"Velocity": 0.02},
+                "sweep": {"nu": "0.02:0.11:4"}, "digest": True}
+        hdrs = {"X-Idempotency-Key": "sweep-1", "X-Tclb-Tenant": "acme"}
+        code, doc, _ = _http(srv.url + "/v1/jobs", "POST", body, hdrs)
+        assert code == 202 and doc["job"]["n_cases"] == 4
+        jid = doc["job"]["id"]
+
+        # client retry with the same key -> the SAME record, no dupe
+        code, doc, _ = _http(srv.url + "/v1/jobs", "POST", body, hdrs)
+        assert code == 200 and doc["deduplicated"] \
+            and doc["job"]["id"] == jid
+        assert len(svc.store.records()) == 1
+
+        code, doc, _ = _http(
+            srv.url + f"/v1/jobs/{jid}/result?wait=300")
+        assert code == 200 and doc["job"]["status"] == J.DONE
+        results = doc["results"]
+        cases = expand_grid({"nu": "0.02:0.11:4"})
+        assert [r["name"] for r in results] == [c.name for c in cases]
+
+        # one ensemble class -> exactly one compile for all 4 cases
+        assert svc.cache.stats()["misses"] == 1
+
+        # bit-parity vs the in-process path: same cases, same plan
+        # construction as the service's worker (flagless lattice)
+        import jax.numpy as jnp
+        from tclb_tpu.models import get_model
+        plan = EnsemblePlan(get_model("d2q9"), (12, 24),
+                            dtype=jnp.float32,
+                            base_settings={"Velocity": 0.02})
+        ref = plan.run(cases, 8)
+        from tclb_tpu.gateway.service import _state_digest
+        for got, want in zip(results, ref):
+            assert got["state_sha256"] == _state_digest(want.state)
+            assert got["globals"] == want.globals
+
+        # quota: acme allows 2 queued/running; the DONE job does not
+        # count, so two quick submits pass and the third 429s
+        slow = {"model": "d2q9", "shape": [12, 24], "niter": 2000,
+                "resumable": True, "checkpoint_every": 1000}
+        codes = []
+        for i in range(3):
+            c, d, h = _http(srv.url + "/v1/jobs", "POST", slow,
+                            {"X-Tclb-Tenant": "acme"})
+            codes.append(c)
+        assert codes.count(429) >= 1
+        assert d["reason"] == REASON_MAX_QUEUED
+        assert d["error"] == "quota exceeded" and d["tenant"] == "acme"
+        assert h["Retry-After"] is not None
+        text = live.prometheus_text()
+        assert 'tclb_gateway_rejections_total{' in text
+        assert 'reason="tenant_max_queued"' in text
+        assert "tclb_gateway_admissions_total" in text
+
+        # the gateway publishes a /status provider while running
+        snap = live.status_snapshot()
+        assert "gateway" in snap
+        assert snap["gateway"]["cache"]["misses"] >= 1
+
+        code, doc, _ = _http(srv.url + "/v1/jobs/j-999999")
+        assert code == 404
+        code, doc, _ = _http(srv.url + "/v1/jobs", "POST",
+                             {"model": "d2q9"})
+        assert code == 400
+    # provider unregisters on close
+    assert "gateway" not in live.status_snapshot()
+
+
+def test_gateway_recovers_queued_jobs_across_restart(tmp_path):
+    """A record left queued/running by a dead process is re-enqueued on
+    start() and runs to completion (the journal replay path)."""
+    root = str(tmp_path / "store")
+    st = JobStore(root)
+    rec = JobRecord(id=st.new_id(), tenant="t", status=J.RUNNING,
+                    body={"model": "d2q9", "shape": [8, 16], "niter": 4},
+                    n_cases=1, cells=128, niter=4)
+    st.put(rec)
+    st.close()
+    svc = GatewayService(root)
+    svc.start()
+    try:
+        code, doc = svc.result(rec.id, wait=300)
+        assert code == 200 and doc["job"]["status"] == J.DONE
+        assert doc["results"][0]["globals"] is not None
+    finally:
+        svc.close()
+
+
+def test_gateway_cancel_queued_job(tmp_path):
+    # not started: the worker never picks the job up, so it stays queued
+    svc = GatewayService(str(tmp_path / "store"))
+    code, doc = svc.submit({"model": "d2q9", "shape": [8, 16],
+                            "niter": 4})
+    assert code == 202
+    jid = doc["job"]["id"]
+    code, doc = svc.cancel(jid)
+    assert code == 200 and doc["job"]["status"] == J.CANCELLED
+    code, doc = svc.cancel(jid)  # idempotent
+    assert code == 200 and doc["job"]["status"] == J.CANCELLED
+    svc.store.close()
+
+
+# --------------------------------------------------------------------------- #
+# Hygiene: the gateway handler module stays off the device
+# --------------------------------------------------------------------------- #
+
+
+def test_gateway_http_module_is_device_free():
+    assert hygiene.scan_device_work_in_gateway() == []
+
+
+def test_gateway_hygiene_flags_device_work(tmp_path):
+    bad = tmp_path / "http.py"
+    bad.write_text(
+        "import jax\n"
+        "from tclb_tpu.core.lattice import Lattice\n"
+        "def handler(req):\n"
+        "    x = jax.device_put(req)\n"
+        "    return jax.numpy.sum(x)\n")
+    found = hygiene.scan_device_work_in_gateway([str(bad)])
+    assert all(f.check == "hygiene.device_work_in_gateway"
+               for f in found)
+    whats = " ".join(f.message for f in found)
+    assert "imports jax" in whats
+    assert "imports Lattice" in whats
+    assert "device_put" in whats
+    # the repo-wide sweep chains the gateway scan (its zero-error
+    # verdict over the real tree is already pinned by
+    # test_analysis.test_repo_hygiene_clean — don't pay for a second
+    # full check_repo() lap here, just pin the wiring)
+    import inspect
+    assert "scan_device_work_in_gateway" in \
+        inspect.getsource(hygiene.check_repo)
+
+
+# --------------------------------------------------------------------------- #
+# Telemetry: the Gateway report table and regression compare
+# --------------------------------------------------------------------------- #
+
+
+def _gw_events(rejects=1):
+    evts = [
+        {"kind": "gateway.admitted", "tenant": "acme", "job_id": "j-1"},
+        {"kind": "gateway.admitted", "tenant": "beta", "job_id": "j-2"},
+        {"kind": "gateway.resumed", "job_id": "j-1", "step": 40},
+        {"kind": "gateway.job_done", "tenant": "acme", "status": "done",
+         "queue_wait_s": 0.5, "wall_s": 2.0, "resumed": True},
+        {"kind": "gateway.job_done", "tenant": "beta", "status": "done",
+         "queue_wait_s": 1.5, "wall_s": 3.0, "resumed": False},
+    ]
+    evts += [{"kind": "gateway.rejected", "tenant": "beta",
+              "reason": "tenant_max_queued"}] * rejects
+    return evts
+
+
+def test_report_gateway_table():
+    s = report.summarize(_gw_events())
+    gw = s["gateway"]
+    assert gw["admitted"] == 2 and gw["rejected"] == 1
+    assert gw["rejections_by_reason"] == {"tenant_max_queued": 1}
+    assert gw["resumed"] == 1
+    assert gw["tenants"]["acme"]["queue_wait_p50_s"] == 0.5
+    assert gw["tenants"]["beta"]["queue_wait_p95_s"] == 1.5
+    txt = report.format_text(s)
+    assert "gateway" in txt and "tenant_max_queued=1" in txt
+    assert "acme" in txt
+
+
+def test_report_compare_flags_admission_regression():
+    base = report.summarize(_gw_events(rejects=0))
+    other = report.summarize(_gw_events(rejects=6))
+    diff = report.compare(base, other)
+    whats = [r["what"] for r in diff["regressions"]]
+    assert "gateway_admission_rate" in whats
+    assert "gateway" in report.format_compare_text(diff)
+
+
+def test_report_compare_flags_queue_wait_regression():
+    base = report.summarize(_gw_events())
+    slow = [dict(e) for e in _gw_events()]
+    for e in slow:
+        if e["kind"] == "gateway.job_done":
+            e["queue_wait_s"] = 40.0
+    diff = report.compare(base, report.summarize(slow))
+    whats = [r["what"] for r in diff["regressions"]]
+    assert "gateway_queue_wait_p95" in whats
+
+
+def test_live_registry_counts_gateway_events():
+    live.enable_live()
+    try:
+        telemetry.event("gateway.admitted", tenant="t")
+        telemetry.event("gateway.rejected", tenant="t",
+                        reason="queue_saturated")
+        telemetry.event("gateway.job_done", tenant="t", status="done",
+                        queue_wait_s=0.25)
+        text = live.prometheus_text()
+        assert 'tclb_gateway_admissions_total{tenant="t"} 1' in text
+        assert 'reason="queue_saturated"' in text
+        assert 'tclb_gateway_jobs_total{status="done"} 1' in text
+        assert "tclb_gateway_queue_wait_seconds" in text
+    finally:
+        live.disable_live()
+
+
+# --------------------------------------------------------------------------- #
+# Kill-resume through the serving path (slow)
+# --------------------------------------------------------------------------- #
+
+GATEWAY_WORKER = """
+import sys
+from tclb_tpu.gateway.http import GatewayServer
+from tclb_tpu.gateway.service import GatewayService
+import time
+
+store, portfile = sys.argv[1], sys.argv[2]
+srv = GatewayServer(GatewayService(store), port=0).start()
+with open(portfile + ".tmp", "w") as fh:
+    fh.write(str(srv.port))
+import os
+os.rename(portfile + ".tmp", portfile)
+while True:
+    time.sleep(1)
+"""
+
+
+def _spawn_gateway(tmp_path, store, tag):
+    script = tmp_path / "worker.py"
+    script.write_text(GATEWAY_WORKER)
+    portfile = tmp_path / f"port-{tag}"
+    env = dict(os.environ, JAX_PLATFORMS="cpu", PYTHONPATH=REPO)
+    proc = subprocess.Popen(
+        [sys.executable, str(script), str(store), str(portfile)],
+        env=env, cwd=REPO, stdout=subprocess.PIPE,
+        stderr=subprocess.PIPE, text=True)
+    deadline = time.time() + 120
+    while not portfile.exists():
+        if proc.poll() is not None:
+            raise AssertionError(
+                f"gateway worker died: {proc.stderr.read()}")
+        if time.time() > deadline:
+            proc.kill()
+            raise AssertionError("gateway worker never published a port")
+        time.sleep(0.1)
+    return proc, int(portfile.read_text())
+
+
+_RESUMABLE_BODY = {"model": "d2q9", "shape": [16, 32], "niter": 60,
+                   "params": {"nu": 0.05}, "resumable": True,
+                   "checkpoint_every": 10, "digest": True,
+                   "idempotency_key": "kill-resume"}
+
+
+@pytest.mark.slow
+def test_gateway_kill_resume_bit_identical(tmp_path):
+    """SIGKILL a gateway worker mid-solve of an HTTP-submitted resumable
+    job; a restarted worker (same store) resumes from the newest
+    checkpoint — not iteration 0 — and finishes bit-identical to an
+    uninterrupted gateway run of the same job."""
+    # uninterrupted reference run, own store (same segment cadence)
+    ref_store = tmp_path / "ref-store"
+    proc, port = _spawn_gateway(tmp_path, ref_store, "ref")
+    try:
+        code, doc, _ = _http(f"http://127.0.0.1:{port}/v1/jobs", "POST",
+                             _RESUMABLE_BODY)
+        assert code == 202, doc
+        jid = doc["job"]["id"]
+        code, doc, _ = _http(
+            f"http://127.0.0.1:{port}/v1/jobs/{jid}/result?wait=300")
+        assert code == 200, doc
+        ref = doc["results"][0]
+        assert doc["job"]["resumed_from"] is None
+    finally:
+        proc.kill()
+        proc.wait()
+
+    # interrupted run: kill -9 once the second checkpoint lands (the
+    # job is mid-solve: 60 iterations total, checkpoints every 10)
+    store = tmp_path / "store"
+    proc, port = _spawn_gateway(tmp_path, store, "a")
+    try:
+        code, doc, _ = _http(f"http://127.0.0.1:{port}/v1/jobs", "POST",
+                             _RESUMABLE_BODY)
+        assert code == 202, doc
+        jid = doc["job"]["id"]
+        ckroot = store / "ckpt" / jid
+        deadline = time.time() + 240
+        while True:
+            steps = sorted(os.listdir(ckroot)) if ckroot.exists() else []
+            if len(steps) >= 2:
+                break
+            assert time.time() < deadline, "no checkpoint appeared"
+            assert proc.poll() is None
+            time.sleep(0.2)
+    finally:
+        proc.send_signal(signal.SIGKILL)
+        proc.wait()
+    assert proc.returncode == -signal.SIGKILL
+
+    # restart on the same store: recovery re-enqueues the job and it
+    # must resume from CheckpointManager.latest(), not from scratch
+    proc, port = _spawn_gateway(tmp_path, store, "b")
+    try:
+        code, doc, _ = _http(
+            f"http://127.0.0.1:{port}/v1/jobs/{jid}/result?wait=300")
+        assert code == 200, doc
+        job = doc["job"]
+        assert job["status"] == J.DONE
+        assert job["resumed_from"] is not None and job["resumed_from"] > 0
+        assert job["progress_iter"] == 60
+        got = doc["results"][0]
+        # the kill-resume contract: final state and globals are
+        # bit-identical to the uninterrupted run (JSON float64
+        # round-trips exactly, so == is a bit comparison)
+        assert got["state_sha256"] == ref["state_sha256"]
+        assert got["globals"] == ref["globals"]
+    finally:
+        proc.kill()
+        proc.wait()

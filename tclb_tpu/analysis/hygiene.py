@@ -773,6 +773,77 @@ def _scan_device_free_module(paths, check_name: str, contract: str) -> list:
     return findings
 
 
+def scan_unpoliced_retry(paths=None) -> list:
+    """Retry discipline for the serving stack: a retry loop in
+    ``tclb_tpu/serve`` or ``tclb_tpu/gateway`` — a ``for``/``while``
+    that catches exceptions and sleeps a *fixed* amount before going
+    around again — must run through :class:`serve.retry.RetryPolicy`.
+
+    Hand-rolled fixed-delay retries are exactly what chaos testing
+    punishes: no exponential backoff, no jitter (retry stampedes), and
+    no deadline awareness, so a retry ladder can outlive the caller's
+    submitted ``timeout_s``.  The structural signature is a loop whose
+    body contains an ``except`` handler AND a constant-argument
+    ``sleep(...)``, inside a function that never references
+    ``RetryPolicy``/``retry_policy``."""
+    if paths is None:
+        paths = (_py_files(os.path.join(_PKG_ROOT, "serve"))
+                 + _py_files(os.path.join(_PKG_ROOT, "gateway")))
+    findings = []
+    for path in paths:
+        try:
+            with open(path) as fh:
+                tree = ast.parse(fh.read())
+        except (OSError, SyntaxError) as e:
+            findings.append(Finding(
+                "hygiene.unparseable", "error", "",
+                f"cannot parse {path}: {e}", path))
+            continue
+        rel = os.path.relpath(path, _REPO_ROOT)
+        for fn in ast.walk(tree):
+            if not isinstance(fn, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                continue
+            policed = False
+            for n in ast.walk(fn):
+                if (isinstance(n, ast.Name) and n.id == "RetryPolicy") \
+                        or (isinstance(n, (ast.Attribute, ast.keyword))
+                            and (getattr(n, "attr", None) == "retry_policy"
+                                 or getattr(n, "arg", None)
+                                 == "retry_policy")):
+                    policed = True
+                    break
+            if policed:
+                continue
+            for loop in ast.walk(fn):
+                if not isinstance(loop, (ast.For, ast.While)):
+                    continue
+                has_except = any(isinstance(n, ast.ExceptHandler)
+                                 for n in ast.walk(loop))
+                sleep_line = None
+                for n in ast.walk(loop):
+                    if isinstance(n, ast.Call):
+                        f = n.func
+                        name = f.id if isinstance(f, ast.Name) else \
+                            (f.attr if isinstance(f, ast.Attribute)
+                             else None)
+                        if name == "sleep" and n.args \
+                                and isinstance(n.args[0], ast.Constant):
+                            sleep_line = n.lineno
+                            break
+                if has_except and sleep_line is not None:
+                    findings.append(Finding(
+                        "hygiene.unpoliced_retry", "error", "",
+                        f"{rel}:{sleep_line} {fn.name}: retry loop with a "
+                        "fixed sleep bypasses RetryPolicy — hand-rolled "
+                        "backoff has no jitter and no deadline awareness, "
+                        "so retries can stampede and outlive the caller's "
+                        "timeout_s; compute delays with "
+                        "serve.retry.RetryPolicy.next_delay",
+                        f"{rel}:{sleep_line}"))
+                    break  # one finding per function is enough signal
+    return findings
+
+
 def check_repo(engine_dir=None, sources=None) -> list:
     from tclb_tpu.analysis.precision import scan_unsafe_accum
     return (scan_dead_entry_points(engine_dir, sources)
@@ -784,6 +855,7 @@ def check_repo(engine_dir=None, sources=None) -> list:
             + scan_unpinned_device_put()
             + scan_device_work_in_monitor()
             + scan_device_work_in_gateway()
+            + scan_unpoliced_retry()
             + scan_unsafe_accum())
 
 

@@ -35,13 +35,15 @@ from tclb_tpu.checkpoint.manifest import (CheckpointError, MANIFEST_NAME,
 from tclb_tpu.checkpoint.writer import (atomic_path, atomic_write_bytes,
                                         resolve_npz, strip_suffix,
                                         with_suffix)
-from tclb_tpu.checkpoint.manager import CheckpointManager
+from tclb_tpu.checkpoint.manager import (CheckpointManager,
+                                         CheckpointSaveError)
 from tclb_tpu.checkpoint.restore import (apply_restored_solver_state,
                                          collect_solver_state, load_any,
                                          restore_lattice, save_checkpoint)
 
 __all__ = [
-    "CheckpointError", "CheckpointManager", "MANIFEST_NAME",
+    "CheckpointError", "CheckpointManager", "CheckpointSaveError",
+    "MANIFEST_NAME",
     "SCHEMA_VERSION", "apply_restored_solver_state", "atomic_path",
     "atomic_write_bytes", "collect_solver_state", "is_checkpoint_dir",
     "load_any", "read_manifest", "resolve_npz", "restore_lattice",

@@ -14,6 +14,7 @@ with a real SIGKILL.
 
 from __future__ import annotations
 
+import errno
 import os
 import re
 import shutil
@@ -27,6 +28,22 @@ from tclb_tpu.checkpoint import writer
 from tclb_tpu.utils import log
 
 _STEP_RE = re.compile(r"^step_(\d{8,})$")
+
+
+class CheckpointSaveError(RuntimeError):
+    """One checkpoint *save* failed in a survivable way (e.g. disk full).
+
+    Callers that can continue without this particular checkpoint — the
+    gateway's resumable runner, a solve loop with periodic saves —
+    should catch this, mark the unit of work failed-but-resumable, and
+    keep the process alive.  ``step`` is the step whose save failed;
+    ``kind`` names the failure class (currently ``"enospc"``)."""
+
+    def __init__(self, message: str, step: Optional[int] = None,
+                 kind: str = "io"):
+        super().__init__(message)
+        self.step = step
+        self.kind = kind
 
 
 class CheckpointManager:
@@ -106,6 +123,38 @@ class CheckpointManager:
         # fixed temp name (no pid): under multi-host every process writes
         # its shards into the same directory on the shared filesystem
         tmp = final + ".tmp"
+        try:
+            self._write_inner(step, captured, tmp, final, t0, multihost)
+        except OSError as e:
+            if e.errno != errno.ENOSPC:
+                raise
+            self._enospc(step, tmp, e)
+
+    def _enospc(self, step: int, tmp: str, err: OSError) -> None:
+        """Disk-full degradation: drop the torn temp dir, emergency-prune
+        to the single newest committed checkpoint, and fail the *save*
+        with a structured error — never the process.  Resumability is
+        preserved: the newest committed step stays restorable."""
+        shutil.rmtree(tmp, ignore_errors=True)
+        pruned = []
+        steps = self.steps()
+        for _s, path in steps[:-1]:
+            shutil.rmtree(path, ignore_errors=True)
+            pruned.append(path)
+        telemetry.event("checkpoint.enospc", step=step, root=self.root,
+                        pruned=pruned, error=repr(err))
+        telemetry.counter("checkpoint.enospc")
+        log.warning(f"checkpoint: save at step {step} hit ENOSPC; "
+                    f"emergency-pruned {len(pruned)} old checkpoint(s), "
+                    "failing the save (newest committed step kept)")
+        raise CheckpointSaveError(
+            f"checkpoint save at step {step} failed: no space left on "
+            f"device (emergency-pruned {len(pruned)} old checkpoint(s))",
+            step=step, kind="enospc") from err
+
+    def _write_inner(self, step: int, captured: dict, tmp: str,
+                     final: str, t0: float,
+                     multihost: bool = False) -> None:
         if multihost:
             import jax
             main = jax.process_index() == 0

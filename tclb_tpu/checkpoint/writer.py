@@ -20,6 +20,8 @@ from typing import Callable, Iterator, Optional
 
 import numpy as np
 
+from tclb_tpu import faults
+
 
 # -- path normalization ------------------------------------------------------- #
 # One place for the ".npz"/".npy" suffix rules: a suffix is only ever the
@@ -176,8 +178,15 @@ def write_npy(path: str, arr: np.ndarray, codec: str = "none") -> dict:
     crc = zlib.crc32(raw) & 0xFFFFFFFF
     if codec != "none":
         path = path + CODEC_SUFFIX[codec]
+    payload = compress_bytes(raw, codec)
+    # the chaos seam for checkpoint IO: `enospc` raises before the open
+    # (disk full), `slow` stalls the fsync path, `torn` truncates the
+    # payload so CRC verification downstream must catch it
+    mode = faults.fire("checkpoint.write", file=os.path.basename(path))
+    if mode == "torn":
+        payload = payload[:max(1, len(payload) // 2)]
     with open(path, "wb") as f:
-        f.write(compress_bytes(raw, codec))
+        f.write(payload)
         f.flush()
         os.fsync(f.fileno())
     rec = {"file": os.path.basename(path),

@@ -1,0 +1,251 @@
+"""Deterministic, seeded fault injection for chaos testing.
+
+The serving stack's failure handling (retry ladders, device eviction,
+checkpoint atomicity, journal replay) is only trustworthy if it is
+*exercised* — so every failure seam registers a named injection point
+and calls :func:`fire` on its hot path.  The call is a strict no-op
+unless a fault plan is installed (same single-boolean discipline as
+:mod:`tclb_tpu.telemetry`): no locks, no RNG, no clock reads on the
+disabled path.
+
+Injection points (the authoritative registry — :func:`fire` rejects
+unknown names so a typo cannot silently disable a chaos schedule):
+
+========================  ===================================================
+``serve.lane_dispatch``   compiled-executable dispatch on a fleet lane /
+                          scheduler batch (``dispatcher._run_batched``)
+``serve.stage``           host staging ``device_put`` (``Lane._stage_loop``)
+``serve.compile``         AOT compile on a cache miss (``CompiledCache.get``)
+``checkpoint.write``      checkpoint shard IO (``writer.write_npy``):
+                          ``enospc`` / ``torn`` / ``slow`` fsync
+``store.journal``         JobStore journal append (``store.JobStore.put``)
+``gateway.request``       gateway request handling (``GatewayService.submit``)
+========================  ===================================================
+
+Modes: ``error`` raises :class:`InjectedFault`; ``enospc`` raises
+``OSError(ENOSPC)``; ``slow`` sleeps ``delay`` seconds then proceeds;
+``torn`` returns the token ``"torn"`` — the seam truncates its write so
+the torn-file tolerance machinery (CRC verify, journal replay) gets
+exercised rather than faked.
+
+Activation, exactly like telemetry: ``TCLB_FAULTS=<spec>`` in the
+environment (parsed at import) or :func:`install` with a
+:class:`FaultPlan`.  Spec grammar — ``;``-separated clauses, each either
+``seed=N`` or ``point[:mode][:key=val]*``::
+
+    TCLB_FAULTS="seed=7;serve.lane_dispatch:error:n=2;checkpoint.write:enospc:n=1:after=1"
+
+Rule knobs: ``p`` (probability per hit, default 1), ``n`` (max
+injections, default unlimited), ``after`` (skip the first N hits),
+``delay`` (seconds, ``slow`` mode).  Determinism: each rule owns a
+``random.Random`` seeded from ``(plan seed, point, rule index)``, and
+hit counts are kept per point — a schedule replays identically as long
+as each point's call sequence does, independent of cross-point thread
+interleaving.
+
+Every injection emits a ``fault.injected`` telemetry event + counter;
+crash-mode injections (``error``/``enospc``/``torn``) are flight-recorder
+dump triggers (telemetry/live.py) so each injected crash leaves a
+post-mortem ring dump.
+"""
+
+from __future__ import annotations
+
+import errno
+import os
+import random
+import threading
+import time
+from dataclasses import dataclass
+from typing import Optional
+
+from tclb_tpu import telemetry
+
+POINTS = frozenset({
+    "serve.lane_dispatch",
+    "serve.stage",
+    "serve.compile",
+    "checkpoint.write",
+    "store.journal",
+    "gateway.request",
+})
+
+MODES = frozenset({"error", "enospc", "torn", "slow"})
+CRASH_MODES = frozenset({"error", "enospc", "torn"})
+
+
+class InjectedFault(RuntimeError):
+    """A fault deliberately raised by an ``error``-mode injection rule."""
+
+
+@dataclass(frozen=True)
+class FaultRule:
+    """One clause of a fault plan: when ``point`` fires, maybe inject."""
+
+    point: str
+    mode: str = "error"
+    prob: float = 1.0
+    times: Optional[int] = None     # max injections; None = unlimited
+    after: int = 0                  # skip the first `after` hits
+    delay_s: float = 0.05           # slow-mode stall
+
+    def __post_init__(self) -> None:
+        if self.point not in POINTS:
+            raise ValueError(f"unknown injection point {self.point!r}; "
+                             f"known: {sorted(POINTS)}")
+        if self.mode not in MODES:
+            raise ValueError(f"unknown fault mode {self.mode!r}; "
+                             f"known: {sorted(MODES)}")
+        if not 0.0 <= self.prob <= 1.0:
+            raise ValueError(f"p must be in [0, 1], got {self.prob}")
+
+
+@dataclass(frozen=True)
+class FaultPlan:
+    """A seeded set of rules: what to break, where, and how often."""
+
+    rules: tuple[FaultRule, ...] = ()
+    seed: int = 0
+
+    @classmethod
+    def parse(cls, spec: str) -> "FaultPlan":
+        """Parse the ``TCLB_FAULTS`` grammar (see module docstring)."""
+        rules: list[FaultRule] = []
+        seed = 0
+        for clause in spec.split(";"):
+            clause = clause.strip()
+            if not clause:
+                continue
+            if clause.startswith("seed="):
+                seed = int(clause[5:])
+                continue
+            parts = clause.split(":")
+            point = parts[0]
+            mode = "error"
+            kw: dict = {}
+            for part in parts[1:]:
+                if "=" not in part:
+                    mode = part
+                    continue
+                k, v = part.split("=", 1)
+                if k == "p":
+                    kw["prob"] = float(v)
+                elif k == "n":
+                    kw["times"] = int(v)
+                elif k == "after":
+                    kw["after"] = int(v)
+                elif k == "delay":
+                    kw["delay_s"] = float(v)
+                else:
+                    raise ValueError(
+                        f"unknown fault-rule knob {k!r} in {clause!r}")
+            rules.append(FaultRule(point, mode, **kw))
+        return cls(rules=tuple(rules), seed=seed)
+
+
+class _RuleState:
+    """Mutable per-rule bookkeeping behind one installed plan."""
+
+    __slots__ = ("rule", "rng", "injected")
+
+    def __init__(self, rule: FaultRule, seed: int, index: int):
+        self.rule = rule
+        self.rng = random.Random(f"{seed}:{rule.point}:{index}")
+        self.injected = 0
+
+
+_lock = threading.Lock()
+_active = False                     # the single-boolean no-op gate
+_plan: Optional[FaultPlan] = None
+_states: list[_RuleState] = []
+_hits: dict[str, int] = {}          # per-point call counts
+
+
+def active() -> bool:
+    return _active
+
+
+def install(plan: FaultPlan) -> None:
+    """Install (or replace) the process-wide fault plan."""
+    global _active, _plan
+    with _lock:
+        _plan = plan
+        _states[:] = [_RuleState(r, plan.seed, i)
+                      for i, r in enumerate(plan.rules)]
+        _hits.clear()
+        _active = bool(plan.rules)
+
+
+def uninstall() -> None:
+    """Remove the fault plan; :func:`fire` returns to the no-op path."""
+    global _active, _plan
+    with _lock:
+        _plan = None
+        _states.clear()
+        _hits.clear()
+        _active = False
+
+
+def stats() -> dict:
+    """Per-rule injection counts + per-point hit counts (for asserts)."""
+    with _lock:
+        return {
+            "hits": dict(_hits),
+            "injected": [{"point": s.rule.point, "mode": s.rule.mode,
+                          "count": s.injected} for s in _states],
+        }
+
+
+def fire(point: str, **ctx) -> Optional[str]:
+    """Evaluate the installed plan at a named injection point.
+
+    No-op (returns None) when no plan is installed.  Otherwise the first
+    matching rule whose predicate passes injects: ``error``/``enospc``
+    raise, ``slow`` sleeps then returns None, ``torn`` returns the token
+    ``"torn"`` for the seam to act on.  ``ctx`` fields are stamped onto
+    the ``fault.injected`` telemetry event.
+    """
+    if not _active:
+        return None
+    if point not in POINTS:
+        raise ValueError(f"unregistered injection point {point!r}")
+    with _lock:
+        if not _active:
+            return None
+        hit = _hits.get(point, 0) + 1
+        _hits[point] = hit
+        chosen: Optional[_RuleState] = None
+        for st in _states:
+            r = st.rule
+            if r.point != point or hit <= r.after:
+                continue
+            if r.times is not None and st.injected >= r.times:
+                continue
+            if r.prob < 1.0 and st.rng.random() >= r.prob:
+                continue
+            st.injected += 1
+            chosen = st
+            break
+    if chosen is None:
+        return None
+    rule = chosen.rule
+    telemetry.event("fault.injected", point=point, mode=rule.mode,
+                    hit=hit, injection=chosen.injected, **ctx)
+    telemetry.counter("faults.injected")
+    if rule.mode == "slow":
+        time.sleep(rule.delay_s)
+        return None
+    if rule.mode == "torn":
+        return "torn"
+    if rule.mode == "enospc":
+        raise OSError(errno.ENOSPC,
+                      f"injected fault at {point}: no space left on device")
+    raise InjectedFault(f"injected fault at {point} "
+                        f"(hit {hit}, injection {chosen.injected})")
+
+
+# env activation, mirroring TCLB_TELEMETRY: opt in at import time
+_env_spec = os.environ.get("TCLB_FAULTS")
+if _env_spec:
+    install(FaultPlan.parse(_env_spec))
+del _env_spec

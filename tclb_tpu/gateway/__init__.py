@@ -29,10 +29,13 @@ from tclb_tpu.gateway.jobs import (CANCELLED, DONE, FAILED, QUEUED,  # noqa: F40
 from tclb_tpu.gateway.service import GatewayService  # noqa: F401
 from tclb_tpu.gateway.store import JobStore  # noqa: F401
 from tclb_tpu.gateway.tenancy import (AdmissionController,  # noqa: F401
-                                      TenancyConfig, TenantQuota)
+                                      RateLimiter, RateSpec,
+                                      TenancyConfig, TenantQuota,
+                                      TokenAuth)
 
 __all__ = [
     "JobRecord", "JobStore", "GatewayService", "AdmissionController",
-    "TenancyConfig", "TenantQuota", "ValidationError", "validate_body",
+    "RateLimiter", "RateSpec", "TenancyConfig", "TenantQuota", "TokenAuth",
+    "ValidationError", "validate_body",
     "QUEUED", "RUNNING", "DONE", "FAILED", "CANCELLED", "TERMINAL",
 ]

@@ -35,6 +35,20 @@ def add_gateway_arguments(p: argparse.ArgumentParser) -> None:
     p.add_argument("--quota", action="append", default=[],
                    metavar="TENANT=QUEUED[:WORK]",
                    help="per-tenant quota override (repeatable)")
+    p.add_argument("--token", action="append", default=[],
+                   metavar="TENANT=SECRET",
+                   help="per-tenant bearer token (repeatable); with any "
+                   "configured, submissions need Authorization: Bearer")
+    p.add_argument("--rate-default", default=None, metavar="RPS[:BURST]",
+                   help="default per-tenant submission rate limit "
+                   "(token bucket; 429 + Retry-After on excess)")
+    p.add_argument("--rate", action="append", default=[],
+                   metavar="TENANT=RPS[:BURST]",
+                   help="per-tenant rate override (repeatable)")
+    p.add_argument("--retain-secs", type=float, default=None,
+                   help="TTL for terminal job records; expired ones are "
+                   "garbage-collected at snapshot compaction "
+                   "(default: keep forever)")
     p.add_argument("--monitor", default=None, metavar="[HOST]:PORT",
                    help="also serve live /metrics + /status (the "
                    "gateway registers its own status provider there)")
@@ -43,9 +57,12 @@ def add_gateway_arguments(p: argparse.ArgumentParser) -> None:
 def run_gateway(args) -> int:
     from tclb_tpu.gateway.http import GatewayServer
     from tclb_tpu.gateway.service import GatewayService
-    from tclb_tpu.gateway.tenancy import TenancyConfig
+    from tclb_tpu.gateway.tenancy import (RateLimiter, TenancyConfig,
+                                          TokenAuth)
 
     tenancy = TenancyConfig.parse(args.quota_default, args.quota)
+    auth = TokenAuth.parse(args.token)
+    rate = RateLimiter.parse(args.rate_default, args.rate)
     monitor = None
     if args.monitor:
         from tclb_tpu.telemetry.http import MonitorServer
@@ -53,7 +70,9 @@ def run_gateway(args) -> int:
         print(f"monitor: {monitor.url}/status")
     svc = GatewayService(args.store, tenancy=tenancy,
                          queue_limit=args.queue_limit,
-                         max_batch=args.max_batch)
+                         max_batch=args.max_batch,
+                         auth=auth, rate=rate,
+                         retain_secs=args.retain_secs)
     srv = GatewayServer(svc, host=args.host, port=args.port).start()
     print(f"gateway: {srv.url}/v1/jobs  (store: {svc.store.root})")
     try:

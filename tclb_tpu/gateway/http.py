@@ -14,8 +14,10 @@ A stdlib-threaded (``http.server.ThreadingHTTPServer``) API surface over
 * ``GET /healthz``                 — liveness.
 
 The tenant comes from the ``X-Tclb-Tenant`` header (or the body's
-``tenant`` key); unauthenticated multi-tenancy is a scoping mechanism,
-not a security boundary — put real auth in front for that.
+``tenant`` key).  With ``--token TENANT=SECRET`` configured, a
+submission must also carry ``Authorization: Bearer <secret>`` for the
+tenant it claims (401 at the door, before admission control); without
+tokens, multi-tenancy is a scoping mechanism, not a security boundary.
 
 Hygiene contract (enforced by ``analysis.hygiene.device_work_in_gateway``):
 nothing in this module may touch jax, ``device_put``, or ``Lattice``
@@ -79,6 +81,14 @@ class _Handler(BaseHTTPRequestHandler):
             return
         self._send(code, body + b"\n", "application/json")
 
+    def _bearer(self) -> Optional[str]:
+        """The ``Authorization: Bearer <secret>`` token, if presented."""
+        auth = self.headers.get("Authorization") or ""
+        scheme, _, token = auth.partition(" ")
+        if scheme.lower() == "bearer" and token.strip():
+            return token.strip()
+        return None
+
     def _read_body(self) -> Optional[dict]:
         n = int(self.headers.get("Content-Length") or 0)
         if n <= 0 or n > _MAX_BODY:
@@ -103,7 +113,8 @@ class _Handler(BaseHTTPRequestHandler):
                 code, doc = self.service.submit(
                     body,
                     tenant=self.headers.get("X-Tclb-Tenant"),
-                    idempotency_key=self.headers.get("X-Idempotency-Key"))
+                    idempotency_key=self.headers.get("X-Idempotency-Key"),
+                    auth_token=self._bearer())
                 self._send_json(code, doc)
             elif parts[:2] == ["v1", "jobs"] and len(parts) == 4 \
                     and parts[3] == "cancel":

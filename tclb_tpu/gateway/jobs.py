@@ -56,6 +56,9 @@ class JobRecord:
     progress_iter: int = 0
     resumed_from: Optional[int] = None
     error: Optional[str] = None
+    # failure class for structured errors ("checkpoint_enospc", ...);
+    # lets clients distinguish failed-resumable jobs from hard failures
+    error_kind: Optional[str] = None
     # per-case outcome dicts ({name, settings, globals}) once done
     results: Optional[list] = None
 

@@ -28,11 +28,13 @@ import threading
 import time
 from typing import Any, Optional
 
-from tclb_tpu import telemetry
+from tclb_tpu import faults, telemetry
+from tclb_tpu.checkpoint.manager import CheckpointSaveError
 from tclb_tpu.gateway import jobs as J
 from tclb_tpu.gateway.jobs import JobRecord, ValidationError
 from tclb_tpu.gateway.store import JobStore
-from tclb_tpu.gateway.tenancy import AdmissionController, TenancyConfig
+from tclb_tpu.gateway.tenancy import (AdmissionController, RateLimiter,
+                                      TenancyConfig, TokenAuth)
 from tclb_tpu.telemetry import live as tlive
 from tclb_tpu.utils import log
 
@@ -61,10 +63,15 @@ class GatewayService:
                  max_batch: Optional[int] = None,
                  cache: Optional[Any] = None,
                  checkpoint_keep: int = 2,
-                 max_resumable: int = 4) -> None:
-        self.store = JobStore(store_root)
+                 max_resumable: int = 4,
+                 auth: Optional[TokenAuth] = None,
+                 rate: Optional[RateLimiter] = None,
+                 retain_secs: Optional[float] = None) -> None:
+        self.store = JobStore(store_root, retain_secs=retain_secs)
         self.admission = AdmissionController(tenancy,
                                              queue_limit=queue_limit)
+        self.auth = auth or TokenAuth()
+        self.rate = rate or RateLimiter()
         self._cache = cache
         self._sched = scheduler
         self._owns_sched = scheduler is None
@@ -159,18 +166,42 @@ class GatewayService:
     # -- handler-thread API (zero device work) ------------------------------ #
 
     def submit(self, body: Any, tenant: Optional[str] = None,
-               idempotency_key: Optional[str] = None
-               ) -> tuple[int, dict]:
+               idempotency_key: Optional[str] = None,
+               auth_token: Optional[str] = None) -> tuple[int, dict]:
         """Validate + admit + persist + enqueue one submission; returns
         ``(http_status, response_doc)``.  Safe on HTTP handler threads:
-        no jax, no device work — the worker thread does the heavy part."""
+        no jax, no device work — the worker thread does the heavy part.
+
+        Door order: auth (401) -> rate limit (429, ``rate_limited``) ->
+        validation (400) -> admission control (429, quota reasons)."""
         if self._closing:
             return 503, {"error": "gateway is shutting down"}
+        try:
+            faults.fire("gateway.request", op="submit")
+        except (OSError, faults.InjectedFault) as e:
+            # the request fails, the gateway does not
+            return 500, {"error": "internal error", "detail": repr(e)}
         if not isinstance(body, dict):
             return 400, {"error": "invalid job",
                          "detail": "body must be a JSON object"}
         tenant = (tenant or body.get("tenant") or "default").strip()
         idem = idempotency_key or body.get("idempotency_key")
+        if not self.auth.check(tenant, auth_token):
+            telemetry.event("gateway.unauthorized", tenant=tenant)
+            telemetry.counter("gateway.unauthorized")
+            return 401, {"error": "unauthorized", "tenant": tenant,
+                         "detail": "missing or wrong bearer token for "
+                                   "this tenant"}
+        limited = self.rate.allow(tenant)
+        if limited is not None:
+            with self._lock:
+                self._rejected[limited["reason"]] = \
+                    self._rejected.get(limited["reason"], 0) + 1
+            telemetry.event("gateway.rejected", tenant=tenant,
+                            reason=limited["reason"],
+                            model=body.get("model"))
+            telemetry.counter("gateway.jobs.rejected")
+            return 429, limited
         try:
             derived = J.validate_body(body,
                                       known_models=self._model_names())
@@ -418,6 +449,17 @@ class GatewayService:
         with self._resume_sem:
             try:
                 self._run_resumable_inner(rec)
+            except CheckpointSaveError as e:
+                # survivable save failure (e.g. disk full after the
+                # emergency prune): this job fails *resumable* — its
+                # newest committed checkpoint is intact, so a re-submit
+                # (or restart) picks up from there.  The process lives.
+                log.warning(f"gateway: resumable job {rec.id} failed on "
+                            f"checkpoint save: {e}")
+                rec.error = str(e)
+                rec.error_kind = f"checkpoint_{e.kind}"
+                with self._lock:
+                    self._finish_locked(rec, J.FAILED)
             except BaseException as e:  # noqa: BLE001 — per-job verdict
                 log.warning(f"gateway: resumable job {rec.id} "
                             f"failed: {e!r}")

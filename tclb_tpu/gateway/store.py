@@ -22,21 +22,31 @@ from __future__ import annotations
 import json
 import os
 import threading
+import time
 from typing import Optional
 
+from tclb_tpu import faults, telemetry
 from tclb_tpu.checkpoint import writer
-from tclb_tpu.gateway.jobs import JobRecord
+from tclb_tpu.gateway.jobs import TERMINAL, JobRecord
 
 SNAPSHOT_EVERY = 256
 
 
 class JobStore:
-    """Durable ``job_id -> JobRecord`` map with idempotency-key lookup."""
+    """Durable ``job_id -> JobRecord`` map with idempotency-key lookup.
+
+    ``retain_secs`` (None = keep forever) is the result-retention TTL:
+    terminal records whose ``finished_ts`` is older than the TTL are
+    garbage-collected during snapshot compaction (and their idempotency
+    keys released)."""
 
     def __init__(self, root: str,
-                 snapshot_every: int = SNAPSHOT_EVERY) -> None:
+                 snapshot_every: int = SNAPSHOT_EVERY,
+                 retain_secs: Optional[float] = None) -> None:
         self.root = os.path.abspath(root)
         self.snapshot_every = max(1, int(snapshot_every))
+        self.retain_secs = None if retain_secs is None else float(retain_secs)
+        self.degraded = False
         self._snap_path = os.path.join(self.root, "store.json")
         self._journal_path = os.path.join(self.root, "journal.jsonl")
         self._lock = threading.RLock()
@@ -81,6 +91,14 @@ class JobStore:
                             rec = JobRecord.from_dict(doc["record"])
                         except (TypeError, KeyError):
                             continue
+                        cur = self._records.get(rec.id)
+                        if cur is not None and \
+                                (cur.updated_ts or 0.0) > \
+                                (rec.updated_ts or 0.0):
+                            # a crash between the snapshot rename and the
+                            # journal truncate leaves a pre-compaction
+                            # tail: never regress a newer snapshot image
+                            continue
                         self._index(rec)
                         self._seq = max(self._seq, _seq_of(rec.id))
 
@@ -101,23 +119,57 @@ class JobStore:
 
     def put(self, rec: JobRecord) -> None:
         """Journal one record state (insert or overwrite), compacting
-        into an atomic snapshot every ``snapshot_every`` puts."""
+        into an atomic snapshot every ``snapshot_every`` puts.
+
+        Journal IO failures (disk full, torn write) *degrade* the store
+        — the in-memory index stays authoritative and serving continues;
+        durability catches up at the next successful snapshot — they
+        never propagate into the request path."""
         with self._lock:
             self._index(rec)
             if self._journal is None:
                 # a late daemon thread finishing after close(): the
                 # final snapshot already captured everything durable
                 return
-            self._journal.write(
-                json.dumps({"op": "put", "record": rec.to_dict()}) + "\n")
+            line = json.dumps({"op": "put", "record": rec.to_dict()}) + "\n"
+            try:
+                mode = faults.fire("store.journal", job=rec.id)
+                if mode == "torn":
+                    line = line[:max(1, len(line) // 2)]
+                self._journal.write(line)
+            except (OSError, faults.InjectedFault) as e:
+                if not self.degraded:
+                    self.degraded = True
+                    telemetry.event("gateway.store_degraded",
+                                    error=repr(e), job=rec.id)
+                    telemetry.counter("gateway.store_degraded")
+                return
             self._puts_since_snapshot += 1
             if self._puts_since_snapshot >= self.snapshot_every:
                 self.snapshot()
 
+    def _expired(self, now: float) -> list[JobRecord]:
+        if self.retain_secs is None:
+            return []
+        cutoff = now - self.retain_secs
+        return [r for r in self._records.values()
+                if r.status in TERMINAL
+                and r.finished_ts is not None and r.finished_ts < cutoff]
+
     def snapshot(self) -> str:
         """Compact the whole store into ``store.json`` (fsync + rename)
-        and truncate the journal."""
+        and truncate the journal.  Retention GC happens here: terminal
+        records past the TTL are dropped from the compacted image."""
         with self._lock:
+            expired = self._expired(time.time())
+            for rec in expired:
+                self._records.pop(rec.id, None)
+                if rec.idempotency_key:
+                    self._idem.pop((rec.tenant, rec.idempotency_key), None)
+            if expired:
+                telemetry.event("gateway.store_gc", removed=len(expired),
+                                retain_secs=self.retain_secs)
+                telemetry.counter("gateway.store_gc", len(expired))
             doc = {"seq": self._seq,
                    "records": [r.to_dict()
                                for r in self._records.values()]}
@@ -127,6 +179,7 @@ class JobStore:
             self._journal.close()
             self._journal = open(self._journal_path, "w", buffering=1)
             self._puts_since_snapshot = 0
+            self.degraded = False
             return self._snap_path
 
     def close(self) -> None:

@@ -16,7 +16,10 @@ pod is saturated" and back off accordingly.
 from __future__ import annotations
 
 import dataclasses
-from typing import Optional, Sequence
+import hmac
+import threading
+import time
+from typing import Callable, Optional, Sequence
 
 from tclb_tpu.gateway.jobs import TERMINAL, JobRecord
 
@@ -24,6 +27,7 @@ from tclb_tpu.gateway.jobs import TERMINAL, JobRecord
 REASON_MAX_QUEUED = "tenant_max_queued"
 REASON_MAX_WORK = "tenant_max_inflight_work"
 REASON_SATURATED = "queue_saturated"
+REASON_RATE = "rate_limited"
 
 
 @dataclasses.dataclass(frozen=True)
@@ -124,3 +128,134 @@ def _reject(reason: str, tenant: str, limit, current, detail: str) -> dict:
     return {"error": "quota exceeded", "reason": reason, "tenant": tenant,
             "limit": limit, "current": current, "detail": detail,
             "retry_after_s": 1.0}
+
+
+class TokenAuth:
+    """Per-tenant bearer tokens, checked at the door (before admission).
+
+    An empty token table means the gateway is open (the default, and
+    what every pre-auth deployment gets).  With tokens configured, a
+    submission must carry ``Authorization: Bearer <secret>`` matching
+    the token of the tenant it claims — compared constant-time so the
+    check leaks nothing about prefix matches."""
+
+    def __init__(self, tokens: Optional[dict[str, str]] = None) -> None:
+        self.tokens = dict(tokens or {})
+
+    @classmethod
+    def parse(cls, specs: Sequence[str] = ()) -> "TokenAuth":
+        """CLI surface: repeatable ``--token TENANT=SECRET``."""
+        tokens = {}
+        for spec in specs:
+            name, sep, secret = str(spec).partition("=")
+            if not sep or not name.strip() or not secret:
+                raise ValueError(
+                    f"--token needs TENANT=SECRET, got {spec!r}")
+            tokens[name.strip()] = secret
+        return cls(tokens)
+
+    @property
+    def enabled(self) -> bool:
+        return bool(self.tokens)
+
+    def check(self, tenant: str, presented: Optional[str]) -> bool:
+        """True when ``presented`` is the tenant's secret (or auth is
+        off).  Unknown tenants are compared against a dummy so timing
+        does not reveal which tenant names exist."""
+        if not self.tokens:
+            return True
+        if not presented:
+            return False
+        expected = self.tokens.get(tenant)
+        if expected is None:
+            hmac.compare_digest(presented, "invalid-tenant-placeholder")
+            return False
+        return hmac.compare_digest(presented, expected)
+
+
+@dataclasses.dataclass(frozen=True)
+class RateSpec:
+    """Token-bucket parameters: sustained ``rps`` with ``burst`` room."""
+
+    rps: float
+    burst: float
+
+    @classmethod
+    def parse(cls, spec: str) -> "RateSpec":
+        """``RPS[:BURST]``, e.g. ``5`` or ``5:20`` (burst defaults to
+        max(1, rps))."""
+        parts = str(spec).split(":")
+        if len(parts) not in (1, 2):
+            raise ValueError(f"rate must be RPS[:BURST], got {spec!r}")
+        rps = float(parts[0])
+        if rps <= 0:
+            raise ValueError(f"rate rps must be > 0, got {spec!r}")
+        burst = float(parts[1]) if len(parts) == 2 else max(1.0, rps)
+        if burst < 1:
+            raise ValueError(f"rate burst must be >= 1, got {spec!r}")
+        return cls(rps=rps, burst=burst)
+
+
+class RateLimiter:
+    """Per-tenant token buckets below the auth check, above admission.
+
+    Distinct failure domain from quotas: a 429 with
+    ``reason="rate_limited"`` means "slow down your request *rate*",
+    while the quota reasons mean "you hold too much *inflight work*".
+    ``clock`` is injectable for deterministic tests."""
+
+    def __init__(self, default: Optional[RateSpec] = None,
+                 tenants: Optional[dict[str, RateSpec]] = None,
+                 clock: Callable[[], float] = time.monotonic) -> None:
+        self.default = default
+        self.tenants = dict(tenants or {})
+        self._clock = clock
+        self._lock = threading.Lock()
+        # tenant -> [tokens, last_refill_ts]
+        self._buckets: dict[str, list[float]] = {}
+
+    @classmethod
+    def parse(cls, default_spec: Optional[str] = None,
+              tenant_specs: Sequence[str] = ()) -> "RateLimiter":
+        """CLI surface: ``--rate-default RPS[:BURST]`` and repeatable
+        ``--rate TENANT=RPS[:BURST]``."""
+        default = RateSpec.parse(default_spec) if default_spec else None
+        tenants = {}
+        for spec in tenant_specs:
+            name, sep, rhs = str(spec).partition("=")
+            if not sep or not name.strip():
+                raise ValueError(
+                    f"--rate needs TENANT=RPS[:BURST], got {spec!r}")
+            tenants[name.strip()] = RateSpec.parse(rhs)
+        return cls(default=default, tenants=tenants)
+
+    @property
+    def enabled(self) -> bool:
+        return self.default is not None or bool(self.tenants)
+
+    def allow(self, tenant: str) -> Optional[dict]:
+        """``None`` to accept; a structured 429 body (with
+        ``retry_after_s`` = time until one token refills) to reject."""
+        spec = self.tenants.get(tenant, self.default)
+        if spec is None:
+            return None
+        now = self._clock()
+        with self._lock:
+            bucket = self._buckets.get(tenant)
+            if bucket is None:
+                bucket = [spec.burst, now]
+                self._buckets[tenant] = bucket
+            tokens, last = bucket
+            tokens = min(spec.burst, tokens + (now - last) * spec.rps)
+            if tokens >= 1.0:
+                bucket[0] = tokens - 1.0
+                bucket[1] = now
+                return None
+            bucket[0] = tokens
+            bucket[1] = now
+            retry_after = (1.0 - tokens) / spec.rps
+        return {"error": "rate limited", "reason": REASON_RATE,
+                "tenant": tenant, "limit": spec.rps,
+                "current": round(tokens, 4),
+                "detail": "tenant request rate over limit; slow down",
+                "retry_after_s": round(retry_after, 4)}

@@ -28,6 +28,7 @@ from tclb_tpu.serve.cache import (CompiledCache, default_cache,
 from tclb_tpu.serve.dispatcher import FleetDispatcher, route_job
 from tclb_tpu.serve.ensemble import (Case, EnsemblePlan, EnsembleResult,
                                      GradSpec, run_ensemble)
+from tclb_tpu.serve.retry import RetryPolicy
 from tclb_tpu.serve.scheduler import (Job, JobSpec, JobTimeout, Scheduler,
                                       make_grad_evaluator)
 
@@ -41,6 +42,7 @@ __all__ = [
     "Job",
     "JobSpec",
     "JobTimeout",
+    "RetryPolicy",
     "Scheduler",
     "default_cache",
     "make_grad_evaluator",

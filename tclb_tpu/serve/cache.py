@@ -23,7 +23,7 @@ from typing import Any, Callable, Optional
 
 import jax
 
-from tclb_tpu import telemetry
+from tclb_tpu import faults, telemetry
 from tclb_tpu.utils import log
 
 _persistent_wired = False
@@ -110,6 +110,8 @@ class CompiledCache:
                 return self._entries[key]
             self.misses += 1
             telemetry.counter("serve.cache.miss")
+            faults.fire("serve.compile", model=plan.model.name,
+                        batch=int(batch))
             # forward plans lower on (states, params); gradient plans on
             # (thetas, states, params) — the plan owns the input tuple
             abstract = plan.abstract_inputs(batch, device=device)

@@ -42,7 +42,7 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
-from tclb_tpu import telemetry
+from tclb_tpu import faults, telemetry
 from tclb_tpu.telemetry import live as tlive
 from tclb_tpu.core.lattice import Lattice
 from tclb_tpu.ops import fusion
@@ -50,6 +50,7 @@ from tclb_tpu.parallel.mesh import (choose_decomposition,
                                     decomposition_overhead, make_mesh)
 from tclb_tpu.serve.cache import CompiledCache
 from tclb_tpu.serve.ensemble import Case, EnsemblePlan, EnsembleResult
+from tclb_tpu.serve.retry import RetryPolicy
 from tclb_tpu.serve.scheduler import (DONE, Job, JobSpec, JobTimeout,
                                       PENDING, RUNNING, _bin_key)
 from tclb_tpu.utils import log
@@ -187,6 +188,8 @@ class Lane:
                                     device=str(self.device),
                                     lane=self.index, batch=len(batch),
                                     job_ids=[j.id for j in batch]):
+                    faults.fire("serve.stage", lane=self.index,
+                                batch=len(batch))
                     inputs = jax.device_put(
                         plan.host_stacked_cases(
                             [j.spec.case for j in batch]),
@@ -254,7 +257,15 @@ class Lane:
                             stall_s=round(stall_s, 6), first=first,
                             wait_s=item.waits, job_ids=job_ids) as sp:
             self._current_job_ids = job_ids
-            for attempt in range(1 + d.retries):
+            # the batch deadline is the earliest member's: a retry may
+            # never start past the moment any co-batched caller times out
+            bd = None
+            for j in batch:
+                if j.spec.timeout_s is not None:
+                    t = j.submitted + j.spec.timeout_s
+                    bd = t if bd is None else min(bd, t)
+            policy = d.retry_policy
+            for attempt in range(policy.max_attempts):
                 for j in batch:
                     j.attempts += 1
                 try:
@@ -264,11 +275,22 @@ class Lane:
                     break
                 except Exception as e:  # noqa: BLE001 - degrade below
                     err = e
-                    if attempt < d.retries:
-                        telemetry.counter("serve.batch.retry")
-                        log.warning(f"fleet lane {self.index}: batched run "
-                                    f"failed (attempt {attempt + 1}): {e!r};"
-                                    " retrying")
+                    delay = policy.next_delay(
+                        attempt, deadline=bd,
+                        key=f"lane{self.index}:{job_ids[0]}")
+                    if delay is None:
+                        break
+                    telemetry.counter("serve.batch.retry")
+                    telemetry.event(
+                        "serve.batch.retry", lane=self.index,
+                        attempt=attempt + 1, delay_s=round(delay, 6),
+                        job_ids=job_ids,
+                        deadline_in_s=(None if bd is None else
+                                       round(bd - time.monotonic(), 6)))
+                    log.warning(f"fleet lane {self.index}: batched run "
+                                f"failed (attempt {attempt + 1}): {e!r};"
+                                f" retrying in {delay:.3f}s")
+                    time.sleep(delay)
             self.batches += 1
             if results is not None:
                 sp.add(outcome="ok", retries=attempt)
@@ -283,7 +305,7 @@ class Lane:
             sp.add(outcome="degraded", error=repr(err))
             telemetry.counter("serve.batch.degraded")
             log.warning(f"fleet lane {self.index}: batched run failed after "
-                        f"{1 + d.retries} attempts ({err!r}); degrading "
+                        f"{attempt + 1} attempt(s) ({err!r}); degrading "
                         f"{len(batch)} job(s) to sequential")
         telemetry.set_job(None)
         any_ok = False
@@ -340,12 +362,29 @@ class FleetDispatcher:
                  sequential_runner: Optional[Callable] = None,
                  on_result: Optional[Callable[[Job], None]] = None,
                  autostart: bool = True,
-                 monitor: Optional[str] = None):
+                 monitor: Optional[str] = None,
+                 retry_policy: Optional[RetryPolicy] = None,
+                 probe_interval_s: Optional[float] = None,
+                 probe_runner: Optional[Callable] = None):
         self.devices = list(devices) if devices is not None \
             else list(jax.devices())
         self.max_batch = max_batch
-        self.retries = max(0, int(retries))
+        self.retry_policy = retry_policy if retry_policy is not None \
+            else RetryPolicy.from_retries(retries)
+        self.retries = self.retry_policy.retries
         self.evict_after = max(1, int(evict_after))
+        # lane probation: when set, an evicted lane is re-probed every
+        # `probe_interval_s` seconds with a canary and reinstated on
+        # success.  Opt-in (constructor or TCLB_FLEET_PROBE_S) — the
+        # default fleet keeps permanent eviction and its all-evicted
+        # fast-fail contract.
+        if probe_interval_s is None:
+            env = os.environ.get("TCLB_FLEET_PROBE_S")
+            probe_interval_s = float(env) if env else None
+        self.probe_interval_s = probe_interval_s
+        self._probe_runner = probe_runner or self._default_probe
+        self._probe_threads: list[threading.Thread] = []
+        self._stop_probes = threading.Event()
         self.shard_min_work = shard_min_work
         self.autostart = autostart
         self._batch_runner = batch_runner or self._run_batched
@@ -457,7 +496,9 @@ class FleetDispatcher:
             self._sharded.put(job)
         else:
             telemetry.counter("serve.route_lane")
-            if all(l.evicted for l in self.lanes):
+            if all(l.evicted for l in self.lanes) \
+                    and self.probe_interval_s is None:
+                # no probation: the fleet is permanently dead, fail fast
                 job._finish(None, RuntimeError(
                     "fleet: all lanes evicted; no device can serve the job"))
                 self._stream(job)
@@ -481,8 +522,11 @@ class FleetDispatcher:
 
     def close(self, wait: bool = True, join_timeout: float = 60.0) -> None:
         self._closing = True
+        self._stop_probes.set()
         if wait and self._started:
             deadline = time.monotonic() + join_timeout
+            for t in self._probe_threads:
+                t.join(timeout=1.0)
             if self._shard_worker is not None:
                 # first: it may degrade a failed sharded job back onto
                 # the lane queue, which the stagers must still drain
@@ -598,6 +642,8 @@ class FleetDispatcher:
     def _run_batched(self, lane: Lane, plan: EnsemblePlan,
                      cases: Sequence[Case], niter: int,
                      inputs: tuple) -> list[EnsembleResult]:
+        faults.fire("serve.lane_dispatch", rail="lane", lane=lane.index,
+                    batch=len(cases))
         compiled = lane.cache.get(plan, batch=len(cases), niter=int(niter),
                                   fn=plan.build_fn(init=True), init=True,
                                   device=lane.device)
@@ -699,8 +745,10 @@ class FleetDispatcher:
         """Hand an evicted lane's staged-but-unexecuted jobs back to the
         shared queue for the surviving lanes.  With no survivor left the
         jobs fail here — re-queueing after the all-evicted drain would
-        strand them (nobody polls a dead fleet's queue)."""
-        if all(l.evicted for l in self.lanes):
+        strand them (nobody polls a dead fleet's queue) — unless lane
+        probation is on, in which case they wait for a reinstatement."""
+        if all(l.evicted for l in self.lanes) \
+                and self.probe_interval_s is None:
             for j in batch:
                 if not j._done.is_set():
                     j._finish(None, RuntimeError(
@@ -716,6 +764,13 @@ class FleetDispatcher:
         telemetry.counter("serve.jobs.redistributed", inc=len(batch))
 
     def _lane_evicted(self, lane: Lane) -> None:
+        if self.probe_interval_s is not None and not self._closing:
+            t = threading.Thread(target=self._probe_loop, args=(lane,),
+                                 name=f"tclb-fleet-probe-{lane.index}",
+                                 daemon=True)
+            self._probe_threads.append(t)
+            t.start()
+            return  # probation: queued jobs wait for a reinstatement
         if all(l.evicted for l in self.lanes):
             log.warning("fleet: ALL lanes evicted; failing queued jobs")
             while True:
@@ -728,6 +783,58 @@ class FleetDispatcher:
                         "fleet: all lanes evicted; no device can serve "
                         "the job"))
                     self._stream(j)
+
+    # -- lane probation ------------------------------------------------------ #
+
+    def _default_probe(self, lane: Lane) -> None:
+        """Canary: land a tiny buffer on the lane device and fence it.
+        Raises when the device is still unhealthy."""
+        jax.block_until_ready(
+            jax.device_put(np.zeros(8, np.float32), lane.device))
+
+    def _probe_loop(self, lane: Lane) -> None:
+        interval = self.probe_interval_s
+        while not self._closing and lane.evicted:
+            if self._stop_probes.wait(interval):
+                return
+            if self._closing or not lane.evicted:
+                return
+            try:
+                self._probe_runner(lane)
+            except Exception as e:  # noqa: BLE001 - still unhealthy
+                telemetry.event("serve.device_probe_failed",
+                                lane=lane.index, device=lane.device_str,
+                                error=repr(e))
+                continue
+            self._reinstate(lane)
+            return
+
+    def _reinstate(self, lane: Lane) -> None:
+        """Rejoin a probed-healthy lane: restart its stage/exec threads
+        (both exited on eviction) and let it pull from the shared queue
+        again — redistribution back happens by construction."""
+        # the old threads exited on eviction (stage loop breaks, its
+        # final None sentinel makes exec return); join them and drain
+        # the sentinel so the fresh exec thread doesn't eat it
+        me = threading.current_thread()
+        for t in (lane._stager, lane._exec):
+            if t is not None and t is not me:
+                t.join(timeout=10.0)
+        while True:
+            try:
+                item = lane._staged.get_nowait()
+            except queue.Empty:
+                break
+            if item is not None:
+                self._redistribute(item.batch)
+        lane.failstreak = 0
+        lane.evicted = False
+        lane.start()
+        telemetry.event("serve.device_reinstated", device=lane.device_str,
+                        lane=lane.index)
+        telemetry.counter("serve.device_reinstated")
+        log.warning(f"fleet: lane {lane.index} ({lane.device_str}) "
+                    "probed healthy; reinstated")
 
     def _stream(self, job: Job) -> None:
         self._inflight.pop(job.id, None)

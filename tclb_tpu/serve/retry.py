@@ -1,0 +1,77 @@
+"""Unified retry policy: exponential backoff + deterministic jitter,
+bounded by a per-job attempt budget AND the caller's deadline.
+
+Every retry loop in ``serve/`` and ``gateway/`` goes through a
+:class:`RetryPolicy` (enforced by ``hygiene.unpoliced_retry``): scattered
+``for attempt in range(1 + retries)`` loops with fixed sleeps can't
+honor a submitted deadline, and a fleet of lanes retrying in lockstep
+hammers a recovering device — backoff plus jitter spreads them out,
+and the deadline cap guarantees a retry never starts after the moment
+the caller would already have timed out.
+
+The jitter is *deterministic*: seeded from ``(key, attempt)`` so chaos
+schedules replay bit-identically run to run.
+"""
+
+from __future__ import annotations
+
+import random
+import time
+from dataclasses import dataclass
+from typing import Optional
+
+
+@dataclass(frozen=True)
+class RetryPolicy:
+    """Backoff schedule for one class of retried operation.
+
+    ``max_attempts`` counts the first try: ``max_attempts=3`` means at
+    most 2 retries.  The delay before retry ``k`` (0-based index of the
+    failed attempt) is ``base_delay_s * multiplier**k``, capped at
+    ``max_delay_s``, then jittered by ``±jitter`` (fractional)."""
+
+    max_attempts: int = 2
+    base_delay_s: float = 0.05
+    max_delay_s: float = 2.0
+    multiplier: float = 2.0
+    jitter: float = 0.25
+
+    def __post_init__(self) -> None:
+        if self.max_attempts < 1:
+            raise ValueError("max_attempts must be >= 1")
+        if self.base_delay_s < 0 or self.max_delay_s < self.base_delay_s:
+            raise ValueError("need 0 <= base_delay_s <= max_delay_s")
+        if not 0.0 <= self.jitter < 1.0:
+            raise ValueError("jitter must be in [0, 1)")
+
+    @classmethod
+    def from_retries(cls, retries: int, **kw) -> "RetryPolicy":
+        """Back-compat shim for the old ``retries=N`` constructor args."""
+        return cls(max_attempts=1 + max(0, int(retries)), **kw)
+
+    @property
+    def retries(self) -> int:
+        return self.max_attempts - 1
+
+    def backoff(self, attempt: int, key: Optional[str] = None) -> float:
+        """Deterministic jittered delay after failed attempt ``attempt``."""
+        d = min(self.max_delay_s,
+                self.base_delay_s * self.multiplier ** max(0, attempt))
+        if self.jitter and d > 0:
+            r = random.Random(f"{key}:{attempt}").random()
+            d *= 1.0 + self.jitter * (2.0 * r - 1.0)
+        return d
+
+    def next_delay(self, attempt: int, deadline: Optional[float] = None,
+                   key: Optional[str] = None) -> Optional[float]:
+        """Delay to sleep before retrying after failed attempt
+        ``attempt`` (0-based), or None when the attempt budget or the
+        deadline (``time.monotonic()`` scale) is exhausted — the caller
+        must stop retrying.  The cap is *start-of-retry*: if sleeping
+        the delay would land past the deadline, there is no retry."""
+        if attempt + 1 >= self.max_attempts:
+            return None
+        d = self.backoff(attempt, key=key)
+        if deadline is not None and time.monotonic() + d >= deadline:
+            return None
+        return d

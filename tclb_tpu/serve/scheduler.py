@@ -31,11 +31,12 @@ from typing import Any, Callable, Optional, Sequence
 import jax.numpy as jnp
 import numpy as np
 
-from tclb_tpu import telemetry
+from tclb_tpu import faults, telemetry
 from tclb_tpu.telemetry import live as tlive
 from tclb_tpu.core.registry import Model
 from tclb_tpu.ops import fusion
 from tclb_tpu.serve.cache import CompiledCache
+from tclb_tpu.serve.retry import RetryPolicy
 from tclb_tpu.serve.ensemble import (Case, EnsemblePlan, EnsembleResult,
                                      GradSpec)
 from tclb_tpu.utils import log
@@ -172,10 +173,13 @@ class Scheduler:
                  batch_runner: Optional[Callable] = None,
                  sequential_runner: Optional[Callable] = None,
                  on_result: Optional[Callable[[Job], None]] = None,
-                 autostart: bool = True):
+                 autostart: bool = True,
+                 retry_policy: Optional[RetryPolicy] = None):
         self.max_batch = max_batch
         self.autostart = autostart
-        self.retries = max(0, int(retries))
+        self.retry_policy = retry_policy if retry_policy is not None \
+            else RetryPolicy.from_retries(retries)
+        self.retries = self.retry_policy.retries
         self.cache = cache if cache is not None else CompiledCache()
         self._batch_runner = batch_runner or self._run_batched
         self._seq_runner = sequential_runner or (
@@ -414,6 +418,8 @@ class Scheduler:
 
     def _run_batched(self, plan: EnsemblePlan, cases: Sequence[Case],
                      niter: int) -> list[EnsembleResult]:
+        faults.fire("serve.lane_dispatch", rail="scheduler",
+                    batch=len(cases))
         return plan.run(cases, niter, cache=self.cache)
 
     def _serve_batch(self, batch: list[Job]) -> None:
@@ -447,7 +453,15 @@ class Scheduler:
                             tenants=[j.spec.tenant for j in live]) as sp:
             results: Optional[list[EnsembleResult]] = None
             err: Optional[BaseException] = None
-            for attempt in range(1 + self.retries):
+            # the batch deadline is the earliest member's: a retry may
+            # never start past the moment any co-batched caller times out
+            bd = None
+            for j in live:
+                if j.spec.timeout_s is not None:
+                    d = j.submitted + j.spec.timeout_s
+                    bd = d if bd is None else min(bd, d)
+            policy = self.retry_policy
+            for attempt in range(policy.max_attempts):
                 for j in live:
                     j.attempts += 1
                 try:
@@ -456,11 +470,20 @@ class Scheduler:
                     break
                 except Exception as e:  # noqa: BLE001 - degrade below
                     err = e
-                    if attempt < self.retries:
-                        telemetry.counter("serve.batch.retry")
-                        log.warning(f"serve: batched run failed "
-                                    f"(attempt {attempt + 1}): {e!r}; "
-                                    "retrying")
+                    delay = policy.next_delay(attempt, deadline=bd,
+                                              key=f"batch:{job_ids[0]}")
+                    if delay is None:
+                        break
+                    telemetry.counter("serve.batch.retry")
+                    telemetry.event(
+                        "serve.batch.retry", attempt=attempt + 1,
+                        delay_s=round(delay, 6), job_ids=job_ids,
+                        deadline_in_s=(None if bd is None else
+                                       round(bd - time.monotonic(), 6)))
+                    log.warning(f"serve: batched run failed "
+                                f"(attempt {attempt + 1}): {e!r}; "
+                                f"retrying in {delay:.3f}s")
+                    time.sleep(delay)
             if results is not None:
                 sp.add(outcome="ok", retries=attempt)
                 telemetry.set_job(None)
@@ -468,13 +491,13 @@ class Scheduler:
                     j._finish(r, None)
                     self._stream(j)
                 return
-            # bounded retries exhausted: degrade to the sequential path
-            # per job — one bad case (or a batched-compile failure) must
-            # not take down its batch-mates
+            # retry budget (or the deadline) exhausted: degrade to the
+            # sequential path per job — one bad case (or a batched-
+            # compile failure) must not take down its batch-mates
             sp.add(outcome="degraded", error=repr(err))
             telemetry.counter("serve.batch.degraded")
             log.warning(f"serve: batched run failed after "
-                        f"{1 + self.retries} attempts ({err!r}); "
+                        f"{attempt + 1} attempt(s) ({err!r}); "
                         f"degrading {len(live)} job(s) to sequential")
         telemetry.set_job(None)
         for j in live:

@@ -77,6 +77,11 @@ _META = {
     "tclb_engine_fallbacks_total": ("counter", "Engine dispatch fallbacks"),
     "tclb_devices_evicted_total": ("counter",
                                    "Devices evicted from the fleet"),
+    "tclb_devices_reinstated_total": ("counter",
+                                      "Evicted devices probed healthy and "
+                                      "returned to the fleet"),
+    "tclb_faults_injected_total": ("counter",
+                                   "Chaos faults injected, by point/mode"),
     "tclb_checkpoint_last_unix_ts": ("gauge",
                                      "Unix time of the last checkpoint "
                                      "save"),
@@ -98,6 +103,10 @@ _META = {
     "tclb_gateway_queue_wait_seconds": ("histogram",
                                         "Gateway job wait from admission "
                                         "to first dispatch"),
+    "tclb_gateway_unauthorized_total": ("counter",
+                                        "Gateway submissions refused for a "
+                                        "missing/wrong bearer token, by "
+                                        "tenant"),
 }
 
 CONTENT_TYPE = "text/plain; version=0.0.4; charset=utf-8"
@@ -337,12 +346,22 @@ def _observe(doc: dict) -> None:
     elif kind == "serve.device_evicted":
         reg.count("tclb_devices_evicted_total", 1.0,
                   lane=str(doc.get("lane", "?")))
+    elif kind == "serve.device_reinstated":
+        reg.count("tclb_devices_reinstated_total", 1.0,
+                  lane=str(doc.get("lane", "?")))
+    elif kind == "fault.injected":
+        reg.count("tclb_faults_injected_total", 1.0,
+                  point=str(doc.get("point", "?")),
+                  mode=str(doc.get("mode", "?")))
     elif kind == "serve.job_done":
         reg.count("tclb_jobs_total", 1.0,
                   status=str(doc.get("status", "?")))
     elif kind == "gateway.admitted":
         reg.count("tclb_gateway_admissions_total", 1.0,
                   tenant=str(doc.get("tenant", "?")))
+    elif kind == "gateway.unauthorized":
+        reg.count("tclb_gateway_unauthorized_total", 1.0,
+                  tenant=doc.get("tenant", ""))
     elif kind == "gateway.rejected":
         reg.count("tclb_gateway_rejections_total", 1.0,
                   reason=str(doc.get("reason", "?")),
@@ -419,8 +438,16 @@ class FlightRecorder:
 
     def record(self, doc: dict) -> None:
         self._ring.append(doc)
-        if doc.get("kind") in DUMP_KINDS:
-            self.dump(reason=str(doc.get("kind")))
+        kind = doc.get("kind")
+        if kind in DUMP_KINDS:
+            self.dump(reason=str(kind))
+        elif kind == "fault.injected":
+            # crash-mode injections (error/enospc/torn) get a dump so
+            # every injected failure leaves a forensic trail; `slow`
+            # injections are latency, not crashes — no dump
+            from tclb_tpu import faults
+            if doc.get("mode") in faults.CRASH_MODES:
+                self.dump(reason=f"fault.injected:{doc.get('point')}")
 
     def events(self) -> list[dict]:
         return list(self._ring)

@@ -225,6 +225,31 @@ def _gateway_summary(evts: list[dict]) -> dict:
     }
 
 
+def _faults_summary(evts: list[dict]) -> dict:
+    """Chaos-injection accounting (``fault.injected`` events) next to
+    the recovery signals the faults should have triggered: retries,
+    evictions/reinstatements, store degradations, checkpoint ENOSPC
+    prunes.  Empty dict when the trace has no injected faults."""
+    injected = [e for e in evts if e.get("kind") == "fault.injected"]
+    if not injected:
+        return {}
+    by_point: dict[str, int] = {}
+    for e in injected:
+        key = f"{e.get('point', '?')}:{e.get('mode', '?')}"
+        by_point[key] = by_point.get(key, 0) + 1
+    def count(k: str) -> int:
+        return sum(1 for e in evts if e.get("kind") == k)
+    return {
+        "injected": len(injected),
+        "by_point_mode": dict(sorted(by_point.items())),
+        "retries": count("serve.batch.retry"),
+        "devices_evicted": count("serve.device_evicted"),
+        "devices_reinstated": count("serve.device_reinstated"),
+        "store_degraded": count("gateway.store_degraded"),
+        "checkpoint_enospc": count("checkpoint.enospc"),
+    }
+
+
 def summarize(evts: list[dict]) -> dict:
     """Aggregate one trace into the report structure (all plain dicts,
     JSON-serializable as-is)."""
@@ -319,6 +344,7 @@ def summarize(evts: list[dict]) -> dict:
             "adjoint": _adjoint_summary(evts),
             "fleet": _fleet_summary(evts),
             "gateway": _gateway_summary(evts),
+            "faults": _faults_summary(evts),
             "engine_selected": [
                 {k: v for k, v in e.items() if k not in ("kind",)}
                 for e in selected],
@@ -647,6 +673,18 @@ def format_text(summary: dict) -> str:
                     f"  {t:<28} {r['jobs']:>6} "
                     f"{_fmt(r['queue_wait_p50_s'], 4):>11} "
                     f"{_fmt(r['queue_wait_p95_s'], 4):>11}")
+        lines.append("")
+    if summary.get("faults"):
+        fa = summary["faults"]
+        lines.append("injected faults (chaos)")
+        lines.append("  " + "  ".join(
+            f"{k}={n}" for k, n in fa["by_point_mode"].items()))
+        lines.append(
+            f"  recovery: retries {fa['retries']}  "
+            f"evicted {fa['devices_evicted']}  "
+            f"reinstated {fa['devices_reinstated']}  "
+            f"store degraded {fa['store_degraded']}  "
+            f"ckpt enospc {fa['checkpoint_enospc']}")
         lines.append("")
     if summary["engine_selected"]:
         lines.append("engine selections")

@@ -500,3 +500,41 @@ def test_precision_skips_f32_only_engines(tmp_path):
                  "    rho = jnp.sum(scrf[0], 0)\n"
                  "    out_ref[0] = rho\n")
     assert scan_unsafe_accum(paths=[str(p)]) == []
+
+
+def test_hygiene_fires_on_unpoliced_retry(tmp_path):
+    bad = tmp_path / "worker.py"
+    bad.write_text(
+        "import time\n"
+        "def fetch(url):\n"
+        "    for attempt in range(3):\n"
+        "        try:\n"
+        "            return download(url)\n"
+        "        except OSError:\n"
+        "            time.sleep(0.5)\n"
+        "    raise RuntimeError\n")
+    found = hygiene.scan_unpoliced_retry([str(bad)])
+    assert [f.check for f in found] == ["hygiene.unpoliced_retry"]
+    assert found[0].severity == "error"
+    assert "RetryPolicy" in found[0].message
+    # the blessed shape: the same loop driven by RetryPolicy.next_delay
+    good = tmp_path / "policed.py"
+    good.write_text(
+        "import time\n"
+        "def fetch(url, retry_policy):\n"
+        "    for attempt in range(retry_policy.max_attempts):\n"
+        "        try:\n"
+        "            return download(url)\n"
+        "        except OSError:\n"
+        "            delay = retry_policy.next_delay(attempt,\n"
+        "                                            deadline=None,\n"
+        "                                            key=url)\n"
+        "            if delay is None:\n"
+        "                raise\n"
+        "            time.sleep(delay)\n")
+    assert hygiene.scan_unpoliced_retry([str(good)]) == []
+    # the shipped serve/ + gateway/ tree is clean, and the repo-wide
+    # sweep chains the scan
+    assert hygiene.scan_unpoliced_retry() == []
+    import inspect
+    assert "scan_unpoliced_retry" in inspect.getsource(hygiene.check_repo)

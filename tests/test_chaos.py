@@ -1,0 +1,389 @@
+"""Seeded chaos suite: the failure-domain contracts of the serving
+stack under injected faults.
+
+Every test installs a deterministic :class:`~tclb_tpu.faults.FaultPlan`
+(the same schedules the CI chaos job drives via ``TCLB_FAULTS``) and
+asserts the blast-radius invariants:
+
+* transient lane faults are absorbed by the retry ladder — zero hung or
+  lost jobs, and surviving results bit-identical to a clean run;
+* ENOSPC during a checkpoint save fails only the *save* (emergency
+  prune, structured :class:`CheckpointSaveError`), never the process —
+  through the gateway, the job lands failed-but-resumable;
+* journal IO faults degrade the job store (in-memory state stays
+  authoritative) instead of failing requests;
+* an injected gateway-request fault 500s that one request; the gateway
+  serves the next one;
+* an evicted lane is probed after its fault clears, reinstated, and
+  serves a subsequent batch;
+* retries never outlive the submitted deadline (asserted from
+  ``serve.batch.retry`` event timestamps);
+* every crash-mode injection leaves a flight-recorder dump.
+"""
+
+import hashlib
+import json
+import os
+import time
+
+import jax
+import numpy as np
+import pytest
+
+from tclb_tpu import faults, telemetry
+from tclb_tpu.faults import FaultPlan, InjectedFault
+from tclb_tpu.checkpoint.manager import CheckpointManager, CheckpointSaveError
+from tclb_tpu.gateway import jobs as J
+from tclb_tpu.gateway.service import GatewayService
+from tclb_tpu.gateway.store import JobStore
+from tclb_tpu.gateway.jobs import JobRecord
+from tclb_tpu.models import get_model
+from tclb_tpu.serve import Case, EnsemblePlan, FleetDispatcher, JobSpec
+from tclb_tpu.serve.retry import RetryPolicy
+from tclb_tpu.serve.scheduler import DONE, FAILED, Scheduler
+from tclb_tpu.telemetry import live
+
+
+@pytest.fixture(autouse=True)
+def _clean():
+    faults.uninstall()
+    telemetry.disable()
+    yield
+    faults.uninstall()
+    telemetry.disable()
+
+
+def _channel_plan(ny=12, nx=24, **kw):
+    m = get_model("d2q9")
+    flags = np.full((ny, nx), m.flag_for("MRT"), dtype=np.uint16)
+    flags[0, :] = flags[-1, :] = m.flag_for("Wall")
+    return EnsemblePlan(m, (ny, nx), flags=flags,
+                        base_settings={"nu": 0.05, "Velocity": 0.02}, **kw)
+
+
+def _specs(plan, nus, niter=4, **kw):
+    return [JobSpec(model=plan.model, shape=plan.shape,
+                    case=Case(settings={"nu": v}, name=f"nu={v}"),
+                    niter=niter, flags=plan.flags,
+                    base_settings={"nu": 0.05, "Velocity": 0.02},
+                    name=f"nu={v}", **kw) for v in nus]
+
+
+def _digest(result):
+    arr = np.ascontiguousarray(np.asarray(result.state.fields))
+    return hashlib.sha256(arr.tobytes()).hexdigest()
+
+
+# --------------------------------------------------------------------------- #
+# Transient faults absorbed: zero lost jobs, bit-identical survivors
+# --------------------------------------------------------------------------- #
+
+
+def test_fleet_absorbs_transient_faults_bit_identical():
+    """A bounded burst of injected dispatch faults is absorbed by the
+    retry ladder: every job completes DONE and its state digest matches
+    a fault-free run of the same specs."""
+    plan = _channel_plan()
+    nus = (0.02, 0.05, 0.08)
+    with FleetDispatcher(devices=jax.devices()[:1]) as fleet:
+        clean = {j.spec.name: _digest(j.result())
+                 for j in fleet.run(_specs(plan, nus))}
+
+    faults.install(FaultPlan.parse("seed=5;serve.lane_dispatch:error:n=2"))
+    policy = RetryPolicy(max_attempts=3, base_delay_s=0.01, max_delay_s=0.02)
+    with FleetDispatcher(devices=jax.devices()[:1],
+                         retry_policy=policy) as fleet:
+        jobs = fleet.run(_specs(plan, nus))
+    assert [j.status for j in jobs] == [DONE] * len(nus)
+    assert not any(j.degraded for j in jobs)  # retries, not the seq path
+    assert {j.spec.name: _digest(j.result()) for j in jobs} == clean
+    st = faults.stats()
+    assert st["injected"][0]["count"] == 2
+
+
+# the CI chaos job drives these same schedules via TCLB_FAULTS over the
+# fleet bench; here they run in-process over injected runners (fast) and
+# pin the zero-hung/zero-lost invariant for each
+CHAOS_SCHEDULES = [
+    "seed=11;serve.lane_dispatch:error:p=0.4:n=6",
+    "seed=23;serve.stage:slow:delay=0.01;serve.lane_dispatch:error:n=2",
+    "seed=37;serve.lane_dispatch:error:n=3;serve.stage:slow:delay=0.005",
+]
+
+
+@pytest.mark.parametrize("schedule", CHAOS_SCHEDULES)
+def test_chaos_schedule_no_hung_or_lost_jobs(schedule):
+    """Under each seeded schedule every submitted job reaches a terminal
+    state within its deadline — nothing hangs, nothing is lost."""
+    def batch_runner(lane, plan, cases, niter, staged):
+        faults.fire("serve.lane_dispatch", lane=lane.index,
+                    batch=len(cases))
+        return ["ok"] * len(cases)
+
+    def seq_runner(lane, plan, case, niter):
+        faults.fire("serve.lane_dispatch", lane=lane.index, seq=True)
+        return "ok"
+
+    faults.install(FaultPlan.parse(schedule))
+    plan = _channel_plan()
+    policy = RetryPolicy(max_attempts=3, base_delay_s=0.005,
+                         max_delay_s=0.01)
+    specs = _specs(plan, (0.01, 0.02, 0.03, 0.04, 0.05, 0.06),
+                   timeout_s=60.0)
+    with FleetDispatcher(devices=jax.devices()[:2],
+                         batch_runner=batch_runner,
+                         sequential_runner=seq_runner,
+                         retry_policy=policy) as fleet:
+        jobs = [fleet.submit(s) for s in specs]
+        for j in jobs:
+            try:
+                j.result(timeout=60)
+            except Exception:  # noqa: BLE001 — verdict read off the handle
+                pass
+    assert all(j.status in (DONE, FAILED) for j in jobs)
+    assert len(jobs) == len(specs)
+    done = [j for j in jobs if j.status == DONE]
+    assert all(j.result() == "ok" for j in done)
+
+
+# --------------------------------------------------------------------------- #
+# Checkpoint ENOSPC: fail the save, prune, keep the process
+# --------------------------------------------------------------------------- #
+
+
+def _lattice(shape=(8, 16)):
+    from tclb_tpu.core.lattice import Lattice
+    lat = Lattice(get_model("d2q9"), shape,
+                  settings={"nu": 0.05, "Velocity": 0.02})
+    lat.init()
+    return lat
+
+
+def test_checkpoint_enospc_prunes_and_fails_only_the_save(tmp_path):
+    evts = []
+    telemetry.subscribe(evts.append)
+    try:
+        lat = _lattice()
+        mgr = CheckpointManager(str(tmp_path / "ck"), keep_last=3,
+                                async_saves=False)
+        mgr.save(lat, step=1)
+        mgr.save(lat, step=2)
+        faults.install(FaultPlan.parse("checkpoint.write:enospc:n=1"))
+        with pytest.raises(CheckpointSaveError) as ei:
+            mgr.save(lat, step=3)
+        assert ei.value.kind == "enospc" and ei.value.step == 3
+        # emergency prune kept ONLY the newest committed step; no torn
+        # temp directory survives; latest() still restores
+        assert [s for s, _ in mgr.steps()] == [2]
+        assert not any(n.endswith(".tmp") for n in os.listdir(mgr.root))
+        assert mgr.latest() is not None
+        kinds = [e.get("kind") for e in evts]
+        assert "checkpoint.enospc" in kinds
+        enospc = next(e for e in evts if e.get("kind") == "checkpoint.enospc")
+        assert len(enospc["pruned"]) == 1
+        # the manager still works once space is back
+        faults.uninstall()
+        mgr.save(lat, step=4)
+        assert [s for s, _ in mgr.steps()] == [2, 4]
+    finally:
+        telemetry.unsubscribe(evts.append)
+
+
+def test_gateway_enospc_fails_job_resumable_process_survives(tmp_path):
+    """An ENOSPC mid-save through the gateway's resumable runner fails
+    that one job with ``error_kind="checkpoint_enospc"`` — the gateway
+    process survives and serves the next submission."""
+    faults.install(FaultPlan.parse("checkpoint.write:enospc:n=1"))
+    svc = GatewayService(str(tmp_path / "store"))
+    svc.start()
+    try:
+        code, doc = svc.submit({"model": "d2q9", "shape": [8, 16],
+                                "niter": 4, "resumable": True,
+                                "checkpoint_every": 2})
+        assert code == 202
+        jid = doc["job"]["id"]
+        code, doc = svc.result(jid, wait=120)
+        assert code == 200
+        assert doc["job"]["status"] == J.FAILED
+        assert doc["job"]["error_kind"] == "checkpoint_enospc"
+        assert "no space" in doc["job"]["error"]
+        # the process (and its worker) lives: the next job runs clean
+        faults.uninstall()
+        code, doc = svc.submit({"model": "d2q9", "shape": [8, 16],
+                                "niter": 4})
+        assert code == 202
+        code, doc = svc.result(doc["job"]["id"], wait=120)
+        assert code == 200 and doc["job"]["status"] == J.DONE
+    finally:
+        svc.close()
+
+
+# --------------------------------------------------------------------------- #
+# Job store: journal faults degrade, never fail the request path
+# --------------------------------------------------------------------------- #
+
+
+def test_store_journal_fault_degrades_not_raises(tmp_path):
+    evts = []
+    telemetry.subscribe(evts.append)
+    try:
+        st = JobStore(str(tmp_path / "store"))
+        faults.install(FaultPlan.parse("store.journal:error:n=1"))
+        rec = JobRecord(id=st.new_id(), tenant="t")
+        st.put(rec)  # journal write fails -> degraded, no raise
+        assert st.degraded
+        assert st.get(rec.id) is rec  # in-memory stays authoritative
+        assert any(e.get("kind") == "gateway.store_degraded" for e in evts)
+        # a successful snapshot restores durability and clears the flag
+        st.snapshot()
+        assert not st.degraded
+        st.close()
+        st2 = JobStore(str(tmp_path / "store"))
+        assert st2.get(rec.id) is not None
+        st2.close()
+    finally:
+        telemetry.unsubscribe(evts.append)
+
+
+def test_store_torn_journal_write_loses_only_the_last_line(tmp_path):
+    root = str(tmp_path / "store")
+    st = JobStore(root)
+    rec = JobRecord(id=st.new_id(), tenant="t", status=J.QUEUED)
+    st.put(rec)
+    # the kill-mid-write model: the FINAL journal line is torn
+    faults.install(FaultPlan.parse("store.journal:torn:n=1"))
+    rec.status = J.RUNNING
+    st.put(rec)
+    faults.uninstall()
+    st._journal.flush()
+    st2 = JobStore(root)  # replay skips the torn line
+    assert st2.get(rec.id).status == J.QUEUED
+    st2.close()
+
+
+def test_gateway_request_fault_500s_one_request_not_the_gateway(tmp_path):
+    faults.install(FaultPlan.parse("gateway.request:error:n=1"))
+    svc = GatewayService(str(tmp_path / "store"))
+    body = {"model": "d2q9", "shape": [8, 16], "niter": 2}
+    code, doc = svc.submit(body)
+    assert code == 500 and doc["error"] == "internal error"
+    code, doc = svc.submit(body)  # budget spent: the next request lands
+    assert code == 202
+    svc.store.close()
+
+
+# --------------------------------------------------------------------------- #
+# Lane probation: fault clears -> probe -> reinstate -> serve
+# --------------------------------------------------------------------------- #
+
+
+def test_evicted_lane_reinstated_after_fault_clears_and_serves():
+    """A lane evicted by an injected fault burst is probed once the
+    fault budget is spent, reinstated, and serves a subsequent batch."""
+    def batch_runner(lane, plan, cases, niter, staged):
+        faults.fire("serve.lane_dispatch", lane=lane.index)
+        return ["ok"] * len(cases)
+
+    def seq_runner(lane, plan, case, niter):
+        faults.fire("serve.lane_dispatch", lane=lane.index, seq=True)
+        return "ok"
+
+    evts = []
+    telemetry.subscribe(evts.append)
+    # exactly two injections: the first job's batched attempt + its
+    # sequential degrade — enough to evict with evict_after=1, after
+    # which the fault has "cleared"
+    faults.install(FaultPlan.parse("serve.lane_dispatch:error:n=2"))
+    plan = _channel_plan()
+    fleet = FleetDispatcher(devices=jax.devices()[:1], retries=0,
+                            evict_after=1, batch_runner=batch_runner,
+                            sequential_runner=seq_runner,
+                            probe_interval_s=0.05)
+    try:
+        first = fleet.submit(_specs(plan, (0.02,))[0])
+        with pytest.raises(InjectedFault):
+            first.result(timeout=60)
+        assert first.status == FAILED
+        deadline = time.monotonic() + 30
+        while not fleet.lanes[0].evicted and time.monotonic() < deadline:
+            time.sleep(0.01)
+        assert fleet.lanes[0].evicted
+        # submitted while evicted: with probation on, the job WAITS for
+        # a reinstatement instead of failing fast
+        second = fleet.submit(_specs(plan, (0.03,))[0])
+        assert second.result(timeout=60) == "ok"
+        assert second.status == DONE
+        assert not fleet.lanes[0].evicted
+        kinds = [e.get("kind") for e in evts]
+        assert "serve.device_evicted" in kinds
+        assert "serve.device_reinstated" in kinds
+    finally:
+        fleet.close()
+        telemetry.unsubscribe(evts.append)
+
+
+# --------------------------------------------------------------------------- #
+# Retries never outlive the caller's deadline
+# --------------------------------------------------------------------------- #
+
+
+def test_retries_respect_submitted_deadline():
+    """With a permanently-failing runner and a generous retry budget,
+    every emitted ``serve.batch.retry`` sleep fits inside the job's
+    remaining deadline, and the job resolves well before the budget's
+    worst-case sleep total."""
+    def runner(plan, cases, niter):
+        raise RuntimeError("injected: permanently down")
+
+    def seq(plan, case, niter):
+        raise RuntimeError("injected: permanently down")
+
+    evts = []
+    telemetry.subscribe(evts.append)
+    policy = RetryPolicy(max_attempts=50, base_delay_s=0.05,
+                         max_delay_s=0.2, jitter=0.0)
+    plan = _channel_plan()
+    t0 = time.monotonic()
+    try:
+        with Scheduler(batch_runner=runner, sequential_runner=seq,
+                       retry_policy=policy, autostart=False) as sched:
+            jobs = sched.run(_specs(plan, (0.02,), timeout_s=0.5))
+    finally:
+        telemetry.unsubscribe(evts.append)
+    elapsed = time.monotonic() - t0
+    assert jobs[0].status == FAILED
+    retries = [e for e in evts if e.get("kind") == "serve.batch.retry"]
+    assert retries, "expected at least one in-deadline retry"
+    for e in retries:
+        # the policy's contract: a retry is scheduled only when its
+        # sleep lands strictly inside the remaining deadline
+        assert e["delay_s"] <= e["deadline_in_s"], e
+    # the 50-attempt budget was cut short by the deadline, not slept out
+    assert len(retries) < policy.max_attempts - 1
+    assert elapsed < 5.0
+
+
+# --------------------------------------------------------------------------- #
+# Forensics: every crash-mode injection leaves a flight dump
+# --------------------------------------------------------------------------- #
+
+
+def test_crash_mode_injection_dumps_flight_recorder(tmp_path, monkeypatch):
+    monkeypatch.setenv("TCLB_FLIGHT_DIR", str(tmp_path))
+    rec = live.flight_recorder()
+    rec.attach()
+    try:
+        faults.install(FaultPlan.parse(
+            "serve.stage:error:n=1;serve.stage:slow:delay=0.001"))
+        with pytest.raises(InjectedFault):
+            faults.fire("serve.stage", lane=0)
+        faults.fire("serve.stage", lane=0)  # slow: latency, not a crash
+    finally:
+        rec.detach()
+    dumps = [n for n in os.listdir(tmp_path) if n.startswith("flight-")]
+    assert len(dumps) == 1
+    lines = [json.loads(s) for s in
+             (tmp_path / dumps[0]).read_text().splitlines()]
+    assert any(d.get("kind") == "fault.injected" for d in lines)
+    assert lines[-1]["kind"] == "flight_dump"
+    assert lines[-1]["reason"] == "fault.injected:serve.stage"

@@ -1,0 +1,154 @@
+"""Fault-injection layer: spec parsing, determinism, the no-op gate,
+per-point firing semantics and env activation."""
+
+import errno
+import os
+import subprocess
+import sys
+
+import pytest
+
+from tclb_tpu import faults, telemetry
+from tclb_tpu.faults import FaultPlan, FaultRule, InjectedFault
+
+
+@pytest.fixture(autouse=True)
+def _clean_plan():
+    faults.uninstall()
+    yield
+    faults.uninstall()
+
+
+# -- spec parsing ------------------------------------------------------------- #
+
+
+def test_parse_full_grammar():
+    plan = FaultPlan.parse(
+        "seed=7; serve.lane_dispatch:error:n=2 ;"
+        "checkpoint.write:enospc:n=1:after=1;"
+        "serve.stage:slow:delay=0.25;store.journal:torn:p=0.5")
+    assert plan.seed == 7
+    assert len(plan.rules) == 4
+    r0, r1, r2, r3 = plan.rules
+    assert (r0.point, r0.mode, r0.times) == ("serve.lane_dispatch",
+                                             "error", 2)
+    assert (r1.mode, r1.times, r1.after) == ("enospc", 1, 1)
+    assert (r2.mode, r2.delay_s) == ("slow", 0.25)
+    assert (r3.mode, r3.prob) == ("torn", 0.5)
+
+
+def test_parse_defaults_to_error_mode():
+    plan = FaultPlan.parse("gateway.request")
+    assert plan.rules[0].mode == "error"
+    assert plan.rules[0].prob == 1.0
+    assert plan.rules[0].times is None
+
+
+def test_parse_rejects_unknown_point_mode_and_knob():
+    with pytest.raises(ValueError, match="unknown injection point"):
+        FaultPlan.parse("serve.lane_dispatc:error")
+    with pytest.raises(ValueError, match="unknown fault mode"):
+        FaultPlan.parse("serve.stage:explode")
+    with pytest.raises(ValueError, match="unknown fault-rule knob"):
+        FaultPlan.parse("serve.stage:error:bogus=1")
+    with pytest.raises(ValueError, match="p must be"):
+        FaultRule("serve.stage", prob=1.5)
+
+
+# -- the no-op gate ----------------------------------------------------------- #
+
+
+def test_fire_is_noop_without_plan():
+    assert not faults.active()
+    assert faults.fire("serve.lane_dispatch") is None
+    assert faults.fire("checkpoint.write", file="x") is None
+
+
+def test_fire_rejects_unregistered_point_when_active():
+    faults.install(FaultPlan.parse("serve.stage:error"))
+    with pytest.raises(ValueError, match="unregistered injection point"):
+        faults.fire("serve.typo")
+
+
+def test_uninstall_restores_noop():
+    faults.install(FaultPlan.parse("serve.stage:error"))
+    assert faults.active()
+    faults.uninstall()
+    assert not faults.active()
+    assert faults.fire("serve.stage") is None
+
+
+# -- firing semantics --------------------------------------------------------- #
+
+
+def test_modes_raise_sleep_and_tear():
+    faults.install(FaultPlan.parse(
+        "serve.stage:error;checkpoint.write:enospc;store.journal:torn"))
+    with pytest.raises(InjectedFault):
+        faults.fire("serve.stage")
+    with pytest.raises(OSError) as ei:
+        faults.fire("checkpoint.write")
+    assert ei.value.errno == errno.ENOSPC
+    assert faults.fire("store.journal") == "torn"
+    # points with no rule stay clean
+    assert faults.fire("gateway.request") is None
+
+
+def test_n_and_after_budgets():
+    faults.install(FaultPlan.parse("serve.compile:error:n=2:after=1"))
+    assert faults.fire("serve.compile") is None          # hit 1: skipped
+    for _ in range(2):                                   # hits 2-3: inject
+        with pytest.raises(InjectedFault):
+            faults.fire("serve.compile")
+    assert faults.fire("serve.compile") is None          # budget spent
+    st = faults.stats()
+    assert st["hits"]["serve.compile"] == 4
+    assert st["injected"][0]["count"] == 2
+
+
+def test_probabilistic_rule_is_deterministic_per_seed():
+    def trace(seed):
+        faults.install(FaultPlan(
+            rules=(FaultRule("serve.stage", prob=0.5),), seed=seed))
+        out = []
+        for _ in range(32):
+            try:
+                faults.fire("serve.stage")
+                out.append(0)
+            except InjectedFault:
+                out.append(1)
+        return out
+
+    a, b = trace(7), trace(7)
+    assert a == b                       # same seed -> same schedule
+    assert 0 < sum(a) < 32              # actually probabilistic
+    assert trace(8) != a                # seed changes the schedule
+
+
+def test_injection_emits_event_and_counter():
+    events = []
+    telemetry.subscribe(events.append)
+    try:
+        faults.install(FaultPlan.parse("serve.stage:error:n=1"))
+        with pytest.raises(InjectedFault):
+            faults.fire("serve.stage", lane=3)
+    finally:
+        telemetry.unsubscribe(events.append)
+    inj = [e for e in events if e.get("kind") == "fault.injected"]
+    assert len(inj) == 1
+    assert inj[0]["point"] == "serve.stage"
+    assert inj[0]["mode"] == "error"
+    assert inj[0]["lane"] == 3
+
+
+# -- env activation ----------------------------------------------------------- #
+
+
+def test_env_var_installs_plan_at_import():
+    code = ("import tclb_tpu.faults as f; "
+            "print(f.active(), len(f._states), f._plan.seed)")
+    env = dict(os.environ, JAX_PLATFORMS="cpu",
+               TCLB_FAULTS="seed=3;serve.stage:slow:delay=0.01")
+    out = subprocess.run([sys.executable, "-c", code], env=env,
+                         capture_output=True, text=True, check=True)
+    assert out.stdout.split() == ["True", "1", "3"]

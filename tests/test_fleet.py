@@ -437,3 +437,62 @@ def test_lane_smoke_api():
     s = fleet.stats()
     assert len(s["devices"]) == 2 and s["jobs"] == 0
     fleet.close()
+
+
+# --------------------------------------------------------------------------- #
+# Lane probation and reinstatement
+# --------------------------------------------------------------------------- #
+
+
+def test_lane_probation_reinstates_after_probe_succeeds():
+    """With probation enabled, an evicted lane is periodically probed;
+    once the canary passes the lane rejoins the fleet and serves jobs
+    that queued while it was out.  (Without ``probe_interval_s`` the
+    fleet keeps its permanent-eviction fast-fail contract — pinned by
+    ``test_all_lanes_evicted_fails_fast``.)"""
+    import threading
+    healed = threading.Event()
+
+    def batch_runner(lane, plan, cases, niter, staged):
+        if not healed.is_set():
+            raise RuntimeError("injected: device lost")
+        return ["ok"] * len(cases)
+
+    def seq_runner(lane, plan, case, niter):
+        if not healed.is_set():
+            raise RuntimeError("injected: device lost")
+        return "ok"
+
+    probes = []
+
+    def probe(lane):
+        probes.append(lane.index)
+        if not healed.is_set():
+            raise RuntimeError("injected: still down")
+
+    plan = _d2q9_plan()
+    fleet = FleetDispatcher(devices=jax.devices()[:1], retries=0,
+                            evict_after=1, batch_runner=batch_runner,
+                            sequential_runner=seq_runner,
+                            probe_interval_s=0.02, probe_runner=probe)
+    try:
+        bad = fleet.submit(_specs(plan, (0.02,))[0])
+        with pytest.raises(RuntimeError, match="device lost"):
+            bad.result(timeout=60)
+        deadline = time.monotonic() + 30
+        while not fleet.lanes[0].evicted and time.monotonic() < deadline:
+            time.sleep(0.005)
+        assert fleet.lanes[0].evicted
+        # queued while the only lane is out: probation means WAIT for a
+        # reinstatement, not fail-fast
+        queued = fleet.submit(_specs(plan, (0.03,))[0])
+        time.sleep(0.08)  # a few probes must fail while still down
+        assert fleet.lanes[0].evicted
+        healed.set()
+        assert queued.result(timeout=60) == "ok"
+        assert queued.status == DONE
+        assert not fleet.lanes[0].evicted
+        assert len(probes) >= 2  # failed probe(s) + the successful one
+        assert fleet.lanes[0].failstreak == 0
+    finally:
+        fleet.close()

@@ -617,3 +617,170 @@ def test_gateway_kill_resume_bit_identical(tmp_path):
     finally:
         proc.kill()
         proc.wait()
+
+
+# --------------------------------------------------------------------------- #
+# Bearer-token authn and per-tenant rate limiting
+# --------------------------------------------------------------------------- #
+
+
+def test_token_auth_parse_and_check():
+    from tclb_tpu.gateway.tenancy import TokenAuth
+    auth = TokenAuth.parse(["acme=s3cret", "beta=hunter2"])
+    assert auth.enabled
+    assert auth.check("acme", "s3cret")
+    assert not auth.check("acme", "hunter2")      # another tenant's token
+    assert not auth.check("acme", None)           # no token presented
+    assert not auth.check("ghost", "s3cret")      # unknown tenant
+    assert TokenAuth().check("anyone", None)      # no tokens -> open door
+    with pytest.raises(ValueError):
+        TokenAuth.parse(["missing-equals"])
+
+
+def test_gateway_auth_401_before_admission(tmp_path):
+    """With tokens configured, a submission without the right bearer
+    token is refused at the door — before validation or admission —
+    and the wrong-token path never creates a record."""
+    from tclb_tpu.gateway.http import GatewayServer
+    from tclb_tpu.gateway.tenancy import TokenAuth
+    svc = GatewayService(str(tmp_path / "store"),
+                         auth=TokenAuth.parse(["acme=s3cret"]))
+    with GatewayServer(svc) as srv:
+        body = {"model": "d2q9", "shape": [8, 16], "niter": 2}
+        tenant = {"X-Tclb-Tenant": "acme"}
+        code, doc, _ = _http(srv.url + "/v1/jobs", "POST", body, tenant)
+        assert code == 401 and doc["error"] == "unauthorized"
+        code, doc, _ = _http(srv.url + "/v1/jobs", "POST", body,
+                             dict(tenant, Authorization="Bearer wrong"))
+        assert code == 401
+        # an unknown tenant cannot sidestep the token check
+        code, doc, _ = _http(srv.url + "/v1/jobs", "POST", body,
+                             {"X-Tclb-Tenant": "ghost",
+                              "Authorization": "Bearer s3cret"})
+        assert code == 401
+        assert len(svc.store.records()) == 0
+        code, doc, _ = _http(srv.url + "/v1/jobs", "POST", body,
+                             dict(tenant, Authorization="Bearer s3cret"))
+        assert code == 202
+        text = live.prometheus_text()
+        assert "tclb_gateway_unauthorized_total" in text
+
+
+def test_rate_limiter_token_bucket_deterministic():
+    from tclb_tpu.gateway.tenancy import (REASON_RATE, RateLimiter,
+                                          RateSpec)
+    t = [0.0]
+    rl = RateLimiter(default=RateSpec.parse("2:2"), clock=lambda: t[0])
+    assert rl.allow("t") is None and rl.allow("t") is None  # burst of 2
+    r = rl.allow("t")
+    assert r["reason"] == REASON_RATE and r["error"] == "rate limited"
+    assert r["retry_after_s"] == pytest.approx(0.5)  # 1 token at 2 rps
+    t[0] += 0.5                                      # refill exactly one
+    assert rl.allow("t") is None
+    assert rl.allow("t")["reason"] == REASON_RATE
+    # per-tenant buckets are independent; unlimited without a spec
+    assert rl.allow("other") is None
+    assert not RateLimiter().enabled
+    assert RateLimiter().allow("t") is None
+    with pytest.raises(ValueError):
+        RateSpec.parse("0")
+
+
+def test_gateway_rate_limit_429_with_retry_after_header(tmp_path):
+    """Rate rejections are a distinct failure domain from quota 429s:
+    ``reason="rate_limited"``, a real Retry-After header, and their own
+    reason label in /metrics."""
+    from tclb_tpu.gateway.http import GatewayServer
+    from tclb_tpu.gateway.tenancy import (REASON_RATE, RateLimiter,
+                                          RateSpec)
+    # burst 1, refill ~one token per 1000s: the second request is
+    # deterministically limited however slow the test host is
+    rate = RateLimiter(default=RateSpec(rps=0.001, burst=1))
+    svc = GatewayService(str(tmp_path / "store"), rate=rate)
+    with GatewayServer(svc) as srv:
+        body = {"model": "d2q9", "shape": [8, 16], "niter": 2}
+        code, doc, _ = _http(srv.url + "/v1/jobs", "POST", body)
+        assert code == 202
+        code, doc, hdrs = _http(srv.url + "/v1/jobs", "POST", body)
+        assert code == 429
+        assert doc["reason"] == REASON_RATE
+        assert doc["error"] == "rate limited"
+        assert doc["retry_after_s"] > 0
+        assert int(hdrs["Retry-After"]) >= 1
+        assert len(svc.store.records()) == 1  # the limited one: no record
+        text = live.prometheus_text()
+        assert 'reason="rate_limited"' in text
+        snap = live.status_snapshot()
+        assert snap["gateway"]["rejected"] == {"rate_limited": 1}
+
+
+# --------------------------------------------------------------------------- #
+# Store retention GC + replay edge cases
+# --------------------------------------------------------------------------- #
+
+
+def test_store_ttl_gc_drops_old_terminal_records(tmp_path):
+    root = str(tmp_path / "store")
+    st = JobStore(root, retain_secs=60.0)
+    old = _rec(st, tenant="t", status=J.DONE, idempotency_key="k-old",
+               finished_ts=time.time() - 3600)
+    fresh = _rec(st, tenant="t", status=J.DONE,
+                 finished_ts=time.time())
+    queued = _rec(st, tenant="t", status=J.QUEUED)  # never GC'd
+    stale_running = _rec(st, tenant="t", status=J.RUNNING)  # non-terminal
+    st.snapshot()
+    ids = [r.id for r in st.records()]
+    assert old.id not in ids
+    assert {fresh.id, queued.id, stale_running.id} <= set(ids)
+    # the expired record's idempotency key is released with it
+    assert st.find_idempotent("t", "k-old") is None
+    st.close()
+    st2 = JobStore(root, retain_secs=60.0)  # GC survives reopen
+    assert old.id not in [r.id for r in st2.records()]
+    st2.close()
+
+
+def test_store_without_ttl_keeps_terminal_records(tmp_path):
+    st = JobStore(str(tmp_path / "store"))
+    old = _rec(st, status=J.DONE, finished_ts=time.time() - 10 ** 7)
+    st.snapshot()
+    assert st.get(old.id) is not None
+    st.close()
+
+
+def test_store_stale_journal_tail_never_regresses_snapshot(tmp_path):
+    """Crash window between the snapshot rename and the journal
+    truncate: replaying the leftover (older) journal tail must not
+    regress a record past the snapshot's newer image."""
+    root = str(tmp_path / "store")
+    st = JobStore(root)
+    rec = _rec(st, tenant="t", status=J.QUEUED)
+    stale_line = json.dumps(
+        {"op": "put", "record": rec.to_dict()}) + "\n"
+    rec.status = J.DONE
+    rec.touch()
+    st.put(rec)
+    st.snapshot()
+    st._journal.write(stale_line)  # the pre-compaction tail reappears
+    st._journal.flush()
+    st2 = JobStore(root)
+    assert st2.get(rec.id).status == J.DONE
+    st2.close()
+    st.close()
+
+
+def test_store_duplicate_idempotency_key_across_snapshot_boundary(tmp_path):
+    """Two records claiming one (tenant, key) — one compacted into the
+    snapshot, one journaled after it — replay deterministically: both
+    records survive, the journal's later write owns the key."""
+    root = str(tmp_path / "store")
+    st = JobStore(root)
+    a = _rec(st, tenant="t", idempotency_key="k")
+    st.snapshot()
+    b = _rec(st, tenant="t", idempotency_key="k")
+    st._journal.flush()
+    st2 = JobStore(root)
+    assert {a.id, b.id} <= {r.id for r in st2.records()}
+    assert st2.find_idempotent("t", "k").id == b.id
+    st2.close()
+    st.close()

@@ -38,7 +38,9 @@ def add_gateway_arguments(p: argparse.ArgumentParser) -> None:
     p.add_argument("--token", action="append", default=[],
                    metavar="TENANT=SECRET",
                    help="per-tenant bearer token (repeatable); with any "
-                   "configured, submissions need Authorization: Bearer")
+                   "configured, every /v1/jobs route needs "
+                   "Authorization: Bearer (reads scoped to the token's "
+                   "tenant)")
     p.add_argument("--rate-default", default=None, metavar="RPS[:BURST]",
                    help="default per-tenant submission rate limit "
                    "(token bucket; 429 + Retry-After on excess)")

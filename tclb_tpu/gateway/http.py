@@ -14,10 +14,13 @@ A stdlib-threaded (``http.server.ThreadingHTTPServer``) API surface over
 * ``GET /healthz``                 — liveness.
 
 The tenant comes from the ``X-Tclb-Tenant`` header (or the body's
-``tenant`` key).  With ``--token TENANT=SECRET`` configured, a
-submission must also carry ``Authorization: Bearer <secret>`` for the
-tenant it claims (401 at the door, before admission control); without
-tokens, multi-tenancy is a scoping mechanism, not a security boundary.
+``tenant`` key).  With ``--token TENANT=SECRET`` configured, *every*
+``/v1/jobs`` route requires ``Authorization: Bearer <secret>``: a
+submission must carry the token of the tenant it claims (401 at the
+door, before admission control), listings are scoped to the
+authenticated tenant, and per-job reads/cancels of another tenant's
+record answer the same 404 a nonexistent id gets.  Without tokens,
+multi-tenancy is a scoping mechanism, not a security boundary.
 
 Hygiene contract (enforced by ``analysis.hygiene.device_work_in_gateway``):
 nothing in this module may touch jax, ``device_put``, or ``Lattice``
@@ -118,7 +121,8 @@ class _Handler(BaseHTTPRequestHandler):
                 self._send_json(code, doc)
             elif parts[:2] == ["v1", "jobs"] and len(parts) == 4 \
                     and parts[3] == "cancel":
-                code, doc = self.service.cancel(parts[2])
+                code, doc = self.service.cancel(
+                    parts[2], auth_token=self._bearer())
                 self._send_json(code, doc)
             else:
                 self._send_json(404, {"error": "no such route"})
@@ -134,7 +138,8 @@ class _Handler(BaseHTTPRequestHandler):
         parts = [p for p in urlparse(self.path).path.split("/") if p]
         try:
             if parts[:2] == ["v1", "jobs"] and len(parts) == 3:
-                code, doc = self.service.cancel(parts[2])
+                code, doc = self.service.cancel(
+                    parts[2], auth_token=self._bearer())
                 self._send_json(code, doc)
             else:
                 self._send_json(404, {"error": "no such route"})
@@ -156,15 +161,18 @@ class _Handler(BaseHTTPRequestHandler):
             elif parts[:2] == ["v1", "jobs"] and len(parts) == 2:
                 code, doc = self.service.jobs(
                     tenant=(qs.get("tenant") or [None])[0],
-                    status=(qs.get("status") or [None])[0])
+                    status=(qs.get("status") or [None])[0],
+                    auth_token=self._bearer())
                 self._send_json(code, doc)
             elif parts[:2] == ["v1", "jobs"] and len(parts) == 3:
-                code, doc = self.service.job(parts[2])
+                code, doc = self.service.job(parts[2],
+                                             auth_token=self._bearer())
                 self._send_json(code, doc)
             elif parts[:2] == ["v1", "jobs"] and len(parts) == 4 \
                     and parts[3] == "result":
                 wait = float((qs.get("wait") or ["0"])[0])
-                code, doc = self.service.result(parts[2], wait=wait)
+                code, doc = self.service.result(parts[2], wait=wait,
+                                                auth_token=self._bearer())
                 self._send_json(code, doc)
             elif not parts:
                 self._send(200, _INDEX, "text/plain; charset=utf-8")

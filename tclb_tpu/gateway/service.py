@@ -239,25 +239,60 @@ class GatewayService:
         self._work.put(rec.id)
         return 202, {"job": rec.public()}
 
-    def job(self, job_id: str) -> tuple[int, dict]:
-        rec = self.store.get(job_id)
-        if rec is None:
+    def _deny(self, rec: Optional[JobRecord], job_id: str,
+              auth_token: Optional[str]) -> Optional[tuple[int, dict]]:
+        """Auth gate for per-record reads/cancel: ``None`` when the
+        caller may see the record, else the ``(code, doc)`` refusal.
+        With tokens configured, no token at all is 401; a token for a
+        *different* tenant gets the same 404 a nonexistent id does, so
+        record existence stays tenant-scoped."""
+        if self.auth.enabled and auth_token is None:
+            telemetry.event("gateway.unauthorized", op="read")
+            telemetry.counter("gateway.unauthorized")
+            return 401, {"error": "unauthorized",
+                         "detail": "missing bearer token"}
+        if rec is None or not self.auth.check(rec.tenant, auth_token):
             return 404, {"error": f"no such job {job_id!r}"}
+        return None
+
+    def job(self, job_id: str,
+            auth_token: Optional[str] = None) -> tuple[int, dict]:
+        rec = self.store.get(job_id)
+        denied = self._deny(rec, job_id, auth_token)
+        if denied is not None:
+            return denied
         return 200, {"job": rec.public()}
 
     def jobs(self, tenant: Optional[str] = None,
-             status: Optional[str] = None) -> tuple[int, dict]:
+             status: Optional[str] = None,
+             auth_token: Optional[str] = None) -> tuple[int, dict]:
+        """List job records.  With tokens configured the listing is
+        scoped to the token's tenant (401 without a valid token, 403
+        when an explicit ``tenant`` filter names somebody else)."""
+        if self.auth.enabled:
+            authed = self.auth.tenant_for(auth_token)
+            if authed is None:
+                telemetry.event("gateway.unauthorized", op="list")
+                telemetry.counter("gateway.unauthorized")
+                return 401, {"error": "unauthorized",
+                             "detail": "missing or wrong bearer token"}
+            if tenant is not None and tenant != authed:
+                return 403, {"error": "forbidden", "tenant": tenant,
+                             "detail": "tenant filter does not match "
+                                       "the presented token"}
+            tenant = authed
         recs = self.store.records(tenant=tenant, status=status)
         return 200, {"jobs": [r.public() for r in recs],
                      "count": len(recs)}
 
-    def result(self, job_id: str,
-               wait: Optional[float] = None) -> tuple[int, dict]:
+    def result(self, job_id: str, wait: Optional[float] = None,
+               auth_token: Optional[str] = None) -> tuple[int, dict]:
         """The job's outcome; ``wait`` long-polls (bounded) on a plain
         event until the job is terminal.  202 while still in flight."""
         rec = self.store.get(job_id)
-        if rec is None:
-            return 404, {"error": f"no such job {job_id!r}"}
+        denied = self._deny(rec, job_id, auth_token)
+        if denied is not None:
+            return denied
         if wait and rec.status not in J.TERMINAL:
             with self._lock:
                 ev = self._done_events.setdefault(job_id,
@@ -268,15 +303,18 @@ class GatewayService:
             return 202, {"job": rec.public()}
         return 200, {"job": rec.public(), "results": rec.results}
 
-    def cancel(self, job_id: str) -> tuple[int, dict]:
+    def cancel(self, job_id: str,
+               auth_token: Optional[str] = None) -> tuple[int, dict]:
         """Cancel a job.  Queued jobs cancel immediately; a running
         resumable job stops at its next segment boundary; a running
         non-resumable job is already inside a device dispatch and cannot
-        be aborted (409)."""
+        be aborted (409).  Same token gate as the reads: with auth on,
+        only the record's tenant can cancel it."""
         with self._lock:
             rec = self.store.get(job_id)
-            if rec is None:
-                return 404, {"error": f"no such job {job_id!r}"}
+            denied = self._deny(rec, job_id, auth_token)
+            if denied is not None:
+                return denied
             if rec.status in J.TERMINAL:
                 return 200, {"job": rec.public()}
             self._cancel.add(job_id)
@@ -340,6 +378,9 @@ class GatewayService:
             try:
                 jid = self._work.get(timeout=0.2)
             except queue.Empty:
+                # put-driven snapshots never fire without traffic; let
+                # an idle gateway still expire TTL'd results (jax-free)
+                self.store.maybe_gc()
                 continue
             rec = self.store.get(jid)
             if rec is None or rec.status != J.QUEUED:

@@ -56,6 +56,13 @@ class JobStore:
         self._idem: dict[tuple[str, str], str] = {}
         self._seq = 0
         self._puts_since_snapshot = 0
+        # True when the last append may have ended mid-line (IO error
+        # or injected torn write): the next append leads with "\n" so
+        # the torn fragment stays its own unparseable line instead of
+        # swallowing the following record
+        self._tail_torn = False
+        self._gc_horizon = 0.0
+        self._last_gc_check = 0.0
         self._journal = None
         os.makedirs(self.root, exist_ok=True)
         self._load()
@@ -69,6 +76,7 @@ class JobStore:
                 with open(self._snap_path) as fh:
                     doc = json.load(fh)
                 self._seq = int(doc.get("seq", 0))
+                self._gc_horizon = float(doc.get("gc_horizon") or 0.0)
                 for rd in doc.get("records", []):
                     self._index(JobRecord.from_dict(rd))
             except (OSError, ValueError, TypeError, KeyError):
@@ -76,6 +84,7 @@ class JobStore:
                 # hand-edited one can; fall back to the journal alone
                 self._records.clear()
                 self._idem.clear()
+                self._gc_horizon = 0.0
         if os.path.exists(self._journal_path):
             with open(self._journal_path) as fh:
                 for line in fh:
@@ -99,6 +108,13 @@ class JobStore:
                             # journal truncate leaves a pre-compaction
                             # tail: never regress a newer snapshot image
                             continue
+                        if cur is None and \
+                                (rec.updated_ts or 0.0) < self._gc_horizon:
+                            # absent from the snapshot yet older than its
+                            # compaction horizon: a TTL-GC'd record in the
+                            # pre-truncate tail — do not resurrect it (or
+                            # its idempotency key)
+                            continue
                         self._index(rec)
                         self._seq = max(self._seq, _seq_of(rec.id))
 
@@ -121,10 +137,13 @@ class JobStore:
         """Journal one record state (insert or overwrite), compacting
         into an atomic snapshot every ``snapshot_every`` puts.
 
-        Journal IO failures (disk full, torn write) *degrade* the store
-        — the in-memory index stays authoritative and serving continues;
-        durability catches up at the next successful snapshot — they
-        never propagate into the request path."""
+        Journal and snapshot IO failures (disk full, torn write, a
+        handle a failed compaction left closed) *degrade* the store —
+        the in-memory index stays authoritative and serving continues —
+        they never propagate into the request path.  Failed puts still
+        count toward the snapshot trigger, so a degraded store keeps
+        re-attempting compaction (which restores durability and clears
+        the flag) instead of staying memory-only until ``close()``."""
         with self._lock:
             self._index(rec)
             if self._journal is None:
@@ -134,19 +153,44 @@ class JobStore:
             line = json.dumps({"op": "put", "record": rec.to_dict()}) + "\n"
             try:
                 mode = faults.fire("store.journal", job=rec.id)
+                if self._tail_torn:
+                    # the previous append may have ended mid-line: lead
+                    # with a newline so replay drops one unparseable
+                    # fragment, not this record concatenated onto it
+                    self._journal.write("\n")
+                    self._tail_torn = False
                 if mode == "torn":
-                    line = line[:max(1, len(line) // 2)]
-                self._journal.write(line)
-            except (OSError, faults.InjectedFault) as e:
-                if not self.degraded:
-                    self.degraded = True
-                    telemetry.event("gateway.store_degraded",
-                                    error=repr(e), job=rec.id)
-                    telemetry.counter("gateway.store_degraded")
-                return
+                    self._journal.write(line[:max(1, len(line) // 2)])
+                    self._tail_torn = True
+                else:
+                    self._journal.write(line)
+            except (OSError, ValueError, faults.InjectedFault) as e:
+                self._tail_torn = True
+                self._degrade(e, job=rec.id)
             self._puts_since_snapshot += 1
             if self._puts_since_snapshot >= self.snapshot_every:
-                self.snapshot()
+                self._try_snapshot(job=rec.id)
+
+    def _degrade(self, exc: BaseException, job: str = "-") -> None:
+        if not self.degraded:
+            self.degraded = True
+            telemetry.event("gateway.store_degraded",
+                            error=repr(exc), job=job)
+            telemetry.counter("gateway.store_degraded")
+
+    def _try_snapshot(self, job: str = "-") -> bool:
+        """``snapshot()`` under the same degrade-never-raise contract
+        as the journal append: a failed compaction (ENOSPC on the
+        atomic write, journal reopen failure) marks the store degraded
+        and resets the put counter, so the next ``snapshot_every`` puts
+        trigger a retry rather than hammering every request."""
+        try:
+            self.snapshot()
+            return True
+        except (OSError, ValueError) as e:
+            self._puts_since_snapshot = 0
+            self._degrade(e, job=job)
+            return False
 
     def _expired(self, now: float) -> list[JobRecord]:
         if self.retain_secs is None:
@@ -159,9 +203,14 @@ class JobStore:
     def snapshot(self) -> str:
         """Compact the whole store into ``store.json`` (fsync + rename)
         and truncate the journal.  Retention GC happens here: terminal
-        records past the TTL are dropped from the compacted image."""
+        records past the TTL are dropped from the compacted image, and
+        the snapshot carries the GC horizon so a pre-truncate journal
+        tail can never resurrect them on replay."""
         with self._lock:
-            expired = self._expired(time.time())
+            if self._journal is None:
+                raise ValueError("store is closed")
+            now = time.time()
+            expired = self._expired(now)
             for rec in expired:
                 self._records.pop(rec.id, None)
                 if rec.idempotency_key:
@@ -173,19 +222,52 @@ class JobStore:
             doc = {"seq": self._seq,
                    "records": [r.to_dict()
                                for r in self._records.values()]}
+            if self.retain_secs is not None:
+                doc["gc_horizon"] = now
+                self._gc_horizon = now
             writer.atomic_write_bytes(
                 self._snap_path,
                 json.dumps(doc, indent=1).encode())
-            self._journal.close()
-            self._journal = open(self._journal_path, "w", buffering=1)
+            # the snapshot is durable; only now truncate the journal.
+            # If the reopen fails the old handle keeps appending — the
+            # stale tail is dropped on replay by the ts/horizon guards.
+            new_journal = open(self._journal_path, "w", buffering=1)
+            old, self._journal = self._journal, new_journal
+            try:
+                old.close()
+            except OSError:
+                pass
+            self._tail_torn = False
             self._puts_since_snapshot = 0
             self.degraded = False
             return self._snap_path
 
+    def maybe_gc(self, now: Optional[float] = None) -> bool:
+        """Opportunistic TTL compaction for an *idle* store.  Put-driven
+        snapshots never fire without traffic, so the service worker
+        ticks this from its idle loop; it is a no-op without a TTL,
+        when nothing has expired, or within the rate-limit interval."""
+        if self.retain_secs is None:
+            return False
+        now = time.time() if now is None else now
+        with self._lock:
+            if self._journal is None:
+                return False
+            interval = max(1.0, min(self.retain_secs, 60.0))
+            if now - self._last_gc_check < interval:
+                return False
+            self._last_gc_check = now
+            if not self._expired(now):
+                return False
+            return self._try_snapshot()
+
     def close(self) -> None:
         with self._lock:
             if self._journal is not None:
-                self.snapshot()
+                # degrade-safe: if the final compaction fails (disk
+                # still full) the journal keeps whatever it has — a
+                # restart replays it instead of losing the shutdown
+                self._try_snapshot()
                 self._journal.close()
                 self._journal = None
 

@@ -172,6 +172,19 @@ class TokenAuth:
             return False
         return hmac.compare_digest(presented, expected)
 
+    def tenant_for(self, presented: Optional[str]) -> Optional[str]:
+        """The tenant whose secret is ``presented`` (None when nothing
+        matches or auth is off).  Compares against *every* configured
+        secret — no early exit — so timing does not reveal which entry
+        matched."""
+        if not self.tokens or not presented:
+            return None
+        match = None
+        for tenant, secret in self.tokens.items():
+            if hmac.compare_digest(presented, secret) and match is None:
+                match = tenant
+        return match
+
 
 @dataclasses.dataclass(frozen=True)
 class RateSpec:

@@ -383,6 +383,9 @@ class FleetDispatcher:
             probe_interval_s = float(env) if env else None
         self.probe_interval_s = probe_interval_s
         self._probe_runner = probe_runner or self._default_probe
+        # how long a reinstatement waits for the evicted lane's old
+        # threads to finish dying before deferring to the next probe
+        self.reinstate_join_s = 10.0
         self._probe_threads: list[threading.Thread] = []
         self._stop_probes = threading.Event()
         self.shard_min_work = shard_min_work
@@ -806,20 +809,36 @@ class FleetDispatcher:
                                 lane=lane.index, device=lane.device_str,
                                 error=repr(e))
                 continue
-            self._reinstate(lane)
-            return
+            if self._reinstate(lane):
+                return
+            # old threads still alive: keep the lane on probation and
+            # retry the whole probe/reinstate cycle next interval
 
-    def _reinstate(self, lane: Lane) -> None:
+    def _reinstate(self, lane: Lane) -> bool:
         """Rejoin a probed-healthy lane: restart its stage/exec threads
         (both exited on eviction) and let it pull from the shared queue
-        again — redistribution back happens by construction."""
+        again — redistribution back happens by construction.  Returns
+        False (lane stays evicted) when an old thread outlives the join
+        timeout: starting duplicates would let the fresh exec thread
+        consume the old stager's trailing None sentinel and exit
+        immediately, leaving staged batches nobody executes."""
         # the old threads exited on eviction (stage loop breaks, its
         # final None sentinel makes exec return); join them and drain
         # the sentinel so the fresh exec thread doesn't eat it
         me = threading.current_thread()
         for t in (lane._stager, lane._exec):
             if t is not None and t is not me:
-                t.join(timeout=10.0)
+                t.join(timeout=self.reinstate_join_s)
+                if t.is_alive():
+                    telemetry.event("serve.device_reinstate_deferred",
+                                    lane=lane.index,
+                                    device=lane.device_str,
+                                    thread=t.name)
+                    log.warning(f"fleet: lane {lane.index} thread "
+                                f"{t.name} still alive after "
+                                f"{self.reinstate_join_s}s; deferring "
+                                "reinstatement to the next probe cycle")
+                    return False
         while True:
             try:
                 item = lane._staged.get_nowait()
@@ -835,6 +854,7 @@ class FleetDispatcher:
         telemetry.counter("serve.device_reinstated")
         log.warning(f"fleet: lane {lane.index} ({lane.device_str}) "
                     "probed healthy; reinstated")
+        return True
 
     def _stream(self, job: Job) -> None:
         self._inflight.pop(job.id, None)

@@ -496,3 +496,32 @@ def test_lane_probation_reinstates_after_probe_succeeds():
         assert fleet.lanes[0].failstreak == 0
     finally:
         fleet.close()
+
+
+def test_reinstate_defers_while_old_lane_threads_alive():
+    """``_reinstate`` must never start duplicate lane threads: while an
+    old stager/exec thread outlives the join timeout, the lane stays
+    evicted (the probe cycle retries later) — otherwise the fresh exec
+    thread could eat the old stager's trailing None sentinel and exit,
+    leaving staged batches nobody executes."""
+    import threading
+    fleet = FleetDispatcher(devices=jax.devices()[:1], autostart=False,
+                            probe_interval_s=1000.0,
+                            probe_runner=lambda lane: None)
+    try:
+        fleet.reinstate_join_s = 0.05
+        lane = fleet.lanes[0]
+        lane.evicted = True
+        release = threading.Event()
+        stuck = threading.Thread(target=release.wait, daemon=True)
+        stuck.start()
+        lane._exec = stuck
+        assert fleet._reinstate(lane) is False
+        assert lane.evicted            # still on probation, no restart
+        assert lane._exec is stuck     # no duplicate threads spawned
+        release.set()
+        stuck.join(timeout=5)
+        assert fleet._reinstate(lane) is True
+        assert not lane.evicted
+    finally:
+        fleet.close()

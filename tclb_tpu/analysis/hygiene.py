@@ -844,6 +844,74 @@ def scan_unpoliced_retry(paths=None) -> list:
     return findings
 
 
+#: subprocess-spawning calls the serving stack may only make inside the
+#: supervised pool (attribute name -> how we describe it)
+_SPAWN_CALLS = frozenset({"Popen", "run", "call", "check_call",
+                          "check_output", "fork", "forkpty", "spawnv",
+                          "spawnve", "posix_spawn"})
+
+
+def scan_unsupervised_subprocess(paths=None) -> list:
+    """Process-spawning discipline for the serving stack: the ONLY
+    module in ``tclb_tpu/serve`` or ``tclb_tpu/gateway`` allowed to
+    start a child process is ``serve/pool.py`` — the supervisor that
+    owns heartbeat watchdogs, SIGTERM→SIGKILL escalation, crash-loop
+    backoff, and job requeue.
+
+    A ``subprocess.Popen``/``os.fork`` anywhere else is an orphan
+    factory: nobody watches its heartbeat, nobody reaps it on hang, and
+    a crash loses whatever job it carried.  The structural signature is
+    any call to a spawning API (``subprocess.Popen/run/call/check_*``,
+    ``os.fork``/``forkpty``/``posix_spawn``) or a ``from subprocess
+    import Popen``-style alias, outside the pool module."""
+    if paths is None:
+        paths = (_py_files(os.path.join(_PKG_ROOT, "serve"))
+                 + _py_files(os.path.join(_PKG_ROOT, "gateway")))
+    findings = []
+    for path in paths:
+        if os.path.basename(path) == "pool.py" \
+                and os.path.basename(os.path.dirname(path)) == "serve":
+            continue  # the one sanctioned spawner
+        try:
+            with open(path) as fh:
+                tree = ast.parse(fh.read())
+        except (OSError, SyntaxError) as e:
+            findings.append(Finding(
+                "hygiene.unparseable", "error", "",
+                f"cannot parse {path}: {e}", path))
+            continue
+        rel = os.path.relpath(path, _REPO_ROOT)
+
+        def flag(lineno: int, what: str) -> None:
+            findings.append(Finding(
+                "hygiene.unsupervised_subprocess", "error", "",
+                f"{rel}:{lineno} {what} outside serve/pool.py — an "
+                "unsupervised child has no heartbeat watchdog, no "
+                "kill escalation, and no crash-loop backoff, and a "
+                "crash silently loses its job; route process spawning "
+                "through serve.pool.WorkerPool",
+                f"{rel}:{lineno}"))
+
+        for node in ast.walk(tree):
+            if isinstance(node, ast.ImportFrom):
+                if (node.module or "").split(".")[0] == "subprocess":
+                    for a in node.names:
+                        if a.name in _SPAWN_CALLS:
+                            flag(node.lineno,
+                                 f"imports subprocess.{a.name}")
+            elif isinstance(node, ast.Call):
+                f = node.func
+                if isinstance(f, ast.Attribute) \
+                        and isinstance(f.value, ast.Name) \
+                        and f.value.id in ("subprocess", "os") \
+                        and f.attr in _SPAWN_CALLS:
+                    flag(node.lineno,
+                         f"calls {f.value.id}.{f.attr}(...)")
+                elif isinstance(f, ast.Name) and f.id == "Popen":
+                    flag(node.lineno, "calls Popen(...)")
+    return findings
+
+
 def check_repo(engine_dir=None, sources=None) -> list:
     from tclb_tpu.analysis.precision import scan_unsafe_accum
     return (scan_dead_entry_points(engine_dir, sources)
@@ -856,6 +924,7 @@ def check_repo(engine_dir=None, sources=None) -> list:
             + scan_device_work_in_monitor()
             + scan_device_work_in_gateway()
             + scan_unpoliced_retry()
+            + scan_unsupervised_subprocess()
             + scan_unsafe_accum())
 
 

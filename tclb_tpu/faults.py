@@ -20,6 +20,22 @@ unknown names so a typo cannot silently disable a chaos schedule):
                           ``enospc`` / ``torn`` / ``slow`` fsync
 ``store.journal``         JobStore journal append (``store.JobStore.put``)
 ``gateway.request``       gateway request handling (``GatewayService.submit``)
+``pool.spawn``            worker subprocess spawn (``WorkerPool._spawn``):
+                          ``error`` fails the attempt, retried under the
+                          pool's crash-loop ``RetryPolicy``
+``pool.heartbeat``        worker heartbeat emission (``worker._run_job``,
+                          fired *inside* the worker): ``error`` wedges the
+                          worker mid-solve — the missed heartbeat the
+                          supervisor watchdog must catch; ``slow`` delays
+                          the beat
+``pool.ipc``              supervisor frame send / result receive
+                          (``WorkerPool._serve`` / ``_await_result``):
+                          ``error`` = torn pipe — worker killed and
+                          restarted, job re-queued
+``pool.worker_exit``      fired *inside* the worker at job start and each
+                          checkpoint segment boundary: ``error`` hard-exits
+                          the process (nonzero) — the crash the supervisor
+                          must absorb without losing the job
 ========================  ===================================================
 
 Modes: ``error`` raises :class:`InjectedFault`; ``enospc`` raises
@@ -68,6 +84,10 @@ POINTS = frozenset({
     "checkpoint.write",
     "store.journal",
     "gateway.request",
+    "pool.spawn",
+    "pool.heartbeat",
+    "pool.ipc",
+    "pool.worker_exit",
 })
 
 MODES = frozenset({"error", "enospc", "torn", "slow"})
@@ -142,6 +162,24 @@ class FaultPlan:
             rules.append(FaultRule(point, mode, **kw))
         return cls(rules=tuple(rules), seed=seed)
 
+    def to_spec(self) -> str:
+        """Re-serialize to the ``TCLB_FAULTS`` grammar — the round-trip
+        that carries an installed plan across a worker process boundary
+        (``FaultPlan.parse(plan.to_spec())`` is equivalent)."""
+        clauses = [f"seed={self.seed}"]
+        for r in self.rules:
+            c = f"{r.point}:{r.mode}"
+            if r.prob < 1.0:
+                c += f":p={r.prob}"
+            if r.times is not None:
+                c += f":n={r.times}"
+            if r.after:
+                c += f":after={r.after}"
+            if r.mode == "slow" and r.delay_s != 0.05:
+                c += f":delay={r.delay_s}"
+            clauses.append(c)
+        return ";".join(clauses)
+
 
 class _RuleState:
     """Mutable per-rule bookkeeping behind one installed plan."""
@@ -184,6 +222,13 @@ def uninstall() -> None:
         _states.clear()
         _hits.clear()
         _active = False
+
+
+def current_spec() -> Optional[str]:
+    """The installed plan as a ``TCLB_FAULTS`` spec string, or None when
+    no plan is active — how the pool hands the schedule to workers."""
+    with _lock:
+        return _plan.to_spec() if _active and _plan is not None else None
 
 
 def stats() -> dict:

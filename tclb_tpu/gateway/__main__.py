@@ -1,16 +1,23 @@
 """Gateway CLI: ``python -m tclb_tpu gateway --port 8080 --store /var/jobs``.
 
 Stands up the full serving front door — persistent job store, admission
-control, scheduler, HTTP listener — and blocks until interrupted.  On
-restart with the same ``--store``, every non-terminal job is recovered:
-queued jobs re-run, resumable jobs continue from their newest
-checkpoint.
+control, scheduler (or, with ``--workers N``, a process-isolated
+:class:`~tclb_tpu.serve.pool.WorkerPool`), HTTP listener — and blocks
+until interrupted.  On restart with the same ``--store``, every
+non-terminal job is recovered: queued jobs re-run, resumable jobs
+continue from their newest checkpoint.
+
+SIGTERM drains instead of dying: admission stops (503 + Retry-After,
+``/healthz/ready`` goes 503), in-flight resumable jobs park at their
+next checkpointed segment boundary, the store snapshot flushes, and the
+process exits 0 — the zero-downtime half of a rolling restart.
 """
 
 from __future__ import annotations
 
 import argparse
 import sys
+import threading
 import time
 
 
@@ -54,6 +61,19 @@ def add_gateway_arguments(p: argparse.ArgumentParser) -> None:
     p.add_argument("--monitor", default=None, metavar="[HOST]:PORT",
                    help="also serve live /metrics + /status (the "
                    "gateway registers its own status provider there)")
+    p.add_argument("--workers", type=int, default=0,
+                   help="run solves in N supervised worker subprocesses "
+                   "(process isolation: a hung or crashed solve kills "
+                   "one worker, never the gateway; 0 = in-process "
+                   "scheduler)")
+    p.add_argument("--heartbeat-timeout", type=float, default=60.0,
+                   help="seconds without a worker heartbeat before the "
+                   "supervisor declares it hung and restarts it "
+                   "(with --workers)")
+    p.add_argument("--drain-grace", type=float, default=30.0,
+                   help="seconds SIGTERM drain waits for in-flight jobs "
+                   "to finish or park at a checkpoint before killing "
+                   "workers")
 
 
 def run_gateway(args) -> int:
@@ -61,6 +81,7 @@ def run_gateway(args) -> int:
     from tclb_tpu.gateway.service import GatewayService
     from tclb_tpu.gateway.tenancy import (RateLimiter, TenancyConfig,
                                           TokenAuth)
+    from tclb_tpu.telemetry import live as tlive
 
     tenancy = TenancyConfig.parse(args.quota_default, args.quota)
     auth = TokenAuth.parse(args.token)
@@ -70,22 +91,48 @@ def run_gateway(args) -> int:
         from tclb_tpu.telemetry.http import MonitorServer
         monitor = MonitorServer.from_spec(args.monitor).start()
         print(f"monitor: {monitor.url}/status")
+    pool = None
+    workers = int(getattr(args, "workers", 0) or 0)
+    if workers > 0:
+        from tclb_tpu.serve.pool import WorkerPool
+        pool = WorkerPool(workers=workers,
+                          heartbeat_timeout_s=args.heartbeat_timeout,
+                          autostart=False)
     svc = GatewayService(args.store, tenancy=tenancy,
                          queue_limit=args.queue_limit,
                          max_batch=args.max_batch,
                          auth=auth, rate=rate,
-                         retain_secs=args.retain_secs)
+                         retain_secs=args.retain_secs,
+                         pool=pool)
+    # attach on the MAIN thread before serving: this is what installs
+    # the SIGTERM handler that runs the drain hook below
+    tlive.flight_recorder().attach()
     srv = GatewayServer(svc, host=args.host, port=args.port).start()
-    print(f"gateway: {srv.url}/v1/jobs  (store: {svc.store.root})")
+    stop = threading.Event()
+
+    def _drain(reason: str) -> bool:
+        print(f"gateway: draining ({reason})", flush=True)
+        svc.drain(grace_s=args.drain_grace)
+        stop.set()
+        return True  # claim the shutdown: exit 0, not SIGTERM death
+
+    tlive.register_drain_hook("gateway", _drain)
+    print(f"gateway: {srv.url}/v1/jobs  (store: {svc.store.root}"
+          + (f", workers: {workers}" if pool is not None else "")
+          + ")", flush=True)
     try:
-        while True:
-            time.sleep(3600)
+        while not stop.is_set():
+            # wait() (not a bare sleep) so the drain hook's stop.set()
+            # turns the loop promptly once the signal handler returns
+            stop.wait(timeout=3600)
     except KeyboardInterrupt:
         print("gateway: shutting down")
     finally:
+        tlive.unregister_drain_hook("gateway", _drain)
         srv.stop()
         if monitor is not None:
             monitor.stop()
+        tlive.flight_recorder().detach()
     return 0
 
 

@@ -11,7 +11,12 @@ A stdlib-threaded (``http.server.ThreadingHTTPServer``) API surface over
 * ``GET /v1/jobs/<id>/result?wait=N`` — outcome; ``wait`` long-polls on
   a plain event until the job is terminal (202 while in flight);
 * ``DELETE /v1/jobs/<id>`` (or ``POST /v1/jobs/<id>/cancel``) — cancel;
-* ``GET /healthz``                 — liveness.
+* ``GET /healthz``                 — liveness (200 while the process
+  answers at all);
+* ``GET /healthz/ready`` (alias ``/readyz``) — readiness: 503 +
+  ``Retry-After`` while the gateway is draining/closing or a worker
+  pool has zero live workers — the signal a load balancer uses to stop
+  routing before a rolling restart.
 
 The tenant comes from the ``X-Tclb-Tenant`` header (or the body's
 ``tenant`` key).  With ``--token TENANT=SECRET`` configured, *every*
@@ -44,7 +49,8 @@ _INDEX = (b"tclb_tpu gateway\n"
           b"  GET    /v1/jobs/<id>              job record\n"
           b"  GET    /v1/jobs/<id>/result?wait=N  outcome (long-poll)\n"
           b"  DELETE /v1/jobs/<id>              cancel\n"
-          b"  GET    /healthz                   liveness\n")
+          b"  GET    /healthz                   liveness\n"
+          b"  GET    /healthz/ready             readiness (503 draining)\n")
 
 _MAX_BODY = 4 * 1024 * 1024  # a submission body is metadata, not data
 
@@ -71,9 +77,9 @@ class _Handler(BaseHTTPRequestHandler):
 
     def _send_json(self, code: int, doc: dict) -> None:
         body = json.dumps(doc, indent=2, default=str).encode()
-        if code == 429 and "retry_after_s" in doc:
+        if code in (429, 503) and "retry_after_s" in doc:
             # surfaced as a real header too, for naive clients
-            self.send_response(429)
+            self.send_response(code)
             self.send_header("Retry-After",
                              str(int(float(doc["retry_after_s"]) + 0.5)
                                  or 1))
@@ -157,7 +163,17 @@ class _Handler(BaseHTTPRequestHandler):
         qs = parse_qs(url.query)
         try:
             if parts == ["healthz"]:
-                self._send_json(200, {"ok": True})
+                # liveness: a process that answers is live, full stop —
+                # a draining gateway must keep serving reads/results
+                h = self.service.health()
+                self._send_json(200, {"ok": True, **h})
+            elif parts in (["healthz", "ready"], ["readyz"]):
+                h = self.service.health()
+                if h.get("ready"):
+                    self._send_json(200, {"ok": True, **h})
+                else:
+                    self._send_json(503, {"ok": False,
+                                          "retry_after_s": 5, **h})
             elif parts[:2] == ["v1", "jobs"] and len(parts) == 2:
                 code, doc = self.service.jobs(
                     tenant=(qs.get("tenant") or [None])[0],

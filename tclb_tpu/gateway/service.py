@@ -66,7 +66,8 @@ class GatewayService:
                  max_resumable: int = 4,
                  auth: Optional[TokenAuth] = None,
                  rate: Optional[RateLimiter] = None,
-                 retain_secs: Optional[float] = None) -> None:
+                 retain_secs: Optional[float] = None,
+                 pool: Optional[Any] = None) -> None:
         self.store = JobStore(store_root, retain_secs=retain_secs)
         self.admission = AdmissionController(tenancy,
                                              queue_limit=queue_limit)
@@ -85,6 +86,13 @@ class GatewayService:
         self._case_slots: dict[str, list] = {}
         self._lock = threading.RLock()
         self._closing = False
+        self._draining = False
+        # process isolation: with a WorkerPool attached, solve jobs run
+        # in supervised worker SUBPROCESSES (serve/pool.py) and this
+        # process never touches jax — a wedged device or native crash
+        # kills one worker, never the front door
+        self._pool = pool
+        self._pool_threads = 0
         self._worker: Optional[threading.Thread] = None
         self._status_fn = None  # the exact callable given to register_status
         self._resume_sem = threading.Semaphore(max(1, int(max_resumable)))
@@ -108,7 +116,9 @@ class GatewayService:
         # object it was given (attribute access rebinds each time)
         self._status_fn = self._status
         tlive.register_status("gateway", self._status_fn)
-        if self._sched is None:
+        if self._pool is not None:
+            self._pool.start()
+        elif self._sched is None:
             from tclb_tpu.serve.cache import CompiledCache
             from tclb_tpu.serve.scheduler import Scheduler
             if self._cache is None:
@@ -148,6 +158,8 @@ class GatewayService:
         started = self._worker is not None
         if wait and started:
             self._worker.join(timeout=30)
+        if self._pool is not None:
+            self._pool.close(wait=wait)
         if self._owns_sched and self._sched is not None:
             self._sched.close(wait=wait)
         if self._status_fn is not None:
@@ -174,8 +186,11 @@ class GatewayService:
 
         Door order: auth (401) -> rate limit (429, ``rate_limited``) ->
         validation (400) -> admission control (429, quota reasons)."""
-        if self._closing:
-            return 503, {"error": "gateway is shutting down"}
+        if self._closing or self._draining:
+            return 503, {"error": "gateway is draining"
+                                  if self._draining and not self._closing
+                                  else "gateway is shutting down",
+                         "retry_after_s": 5}
         try:
             faults.fire("gateway.request", op="submit")
         except (OSError, faults.InjectedFault) as e:
@@ -330,6 +345,64 @@ class GatewayService:
                               "non-resumable jobs cannot be aborted "
                               "mid-flight"}
 
+    def health(self) -> dict:
+        """Liveness/readiness fragment for ``/healthz`` (handler-thread
+        safe, zero device work).  Liveness is unconditional: a process
+        that answers is live.  Readiness goes false while draining /
+        closing, or when a worker pool is attached and zero workers are
+        live."""
+        workers = (None if self._pool is None
+                   else self._pool.live_workers())
+        ready = not (self._closing or self._draining) \
+            and (workers is None or workers > 0)
+        doc: dict[str, Any] = {"live": True, "ready": ready,
+                               "draining": self._draining,
+                               "closing": self._closing}
+        if workers is not None:
+            doc["workers_live"] = workers
+        return doc
+
+    def drain(self, grace_s: float = 30.0) -> None:
+        """Graceful shutdown, phase one: stop admission (submits answer
+        503 + Retry-After, readiness goes false), let in-flight
+        resumable jobs reach a segment boundary — each boundary is
+        already checkpointed, so their records park back to QUEUED and
+        the next incarnation resumes from ``latest()`` — then flush a
+        store snapshot.  The caller (SIGTERM drain hook, ``close``)
+        decides when the process actually exits."""
+        with self._lock:
+            if self._draining:
+                return
+            self._draining = True
+        telemetry.event("gateway.draining", store=self.store.root)
+        telemetry.counter("gateway.drained")
+        # phase two: give in-flight work a bounded chance to finish (or,
+        # for resumable jobs, to park at an already-checkpointed segment
+        # boundary) ...
+        deadline = time.monotonic() + max(0.0, float(grace_s))
+        while time.monotonic() < deadline:
+            if not any(r.status == J.RUNNING
+                       for r in self.store.records()):
+                break
+            time.sleep(0.05)
+        # ... then kill what's left: pool workers die, their PoolJob
+        # handles fail, and _run_pooled parks those records back to
+        # QUEUED (anything that slips through is flipped RUNNING->QUEUED
+        # by _recover on the next start — no job lost either way)
+        if self._pool is not None:
+            self._pool.close(wait=False)
+            park_by = time.monotonic() + 5.0
+            while time.monotonic() < park_by:
+                with self._lock:
+                    if self._pool_threads == 0:
+                        break
+                time.sleep(0.05)
+        try:
+            self.store.snapshot()
+        except Exception as e:  # noqa: BLE001 — drain must not crash;
+            log.warning(f"gateway: drain snapshot failed: {e!r}")
+            # the journal already holds every record
+
     def _status(self) -> dict:
         """Plain-python /status provider fragment."""
         by_status: dict[str, int] = {}
@@ -348,6 +421,7 @@ class GatewayService:
             "rejected": rejected,
             "resumed": resumed,
             "cache": cache.stats() if cache is not None else None,
+            "draining": self._draining,
             "closing": self._closing,
         }
 
@@ -386,7 +460,14 @@ class GatewayService:
             if rec is None or rec.status != J.QUEUED:
                 continue
             try:
-                if rec.resumable:
+                if self._pool is not None:
+                    with self._lock:
+                        self._pool_threads += 1
+                    threading.Thread(target=self._run_pooled,
+                                     args=(rec,), daemon=True,
+                                     name=f"tclb-gateway-{rec.id}"
+                                     ).start()
+                elif rec.resumable:
                     threading.Thread(target=self._run_resumable,
                                      args=(rec,), daemon=True,
                                      name=f"tclb-gateway-{rec.id}"
@@ -486,6 +567,110 @@ class GatewayService:
     def _ckpt_root(self, job_id: str) -> str:
         return os.path.join(self.store.root, "ckpt", job_id)
 
+    def _run_pooled(self, rec: JobRecord) -> None:
+        """Drive one record through the process-isolated worker pool.
+        This thread never touches jax: it builds plain-JSON pool docs,
+        waits on :class:`~tclb_tpu.serve.pool.PoolJob` handles, and
+        collects plain-python results — the solve lives in supervised
+        worker subprocesses.  A failure while draining/closing parks the
+        record back to QUEUED (resumable jobs re-enter from their newest
+        checkpoint, non-resumable ones rerun from scratch) instead of
+        failing it — the no-lost-jobs half of graceful drain."""
+        try:
+            self._run_pooled_inner(rec)
+        except BaseException as e:  # noqa: BLE001 — per-job verdict
+            if self._draining or self._closing:
+                rec.status = J.QUEUED
+                rec.touch()
+                self.store.put(rec)
+                telemetry.event("gateway.parked", job_id=rec.id,
+                                tenant=rec.tenant, reason=repr(e))
+            else:
+                log.warning(f"gateway: pooled job {rec.id} "
+                            f"failed: {e!r}")
+                rec.error = repr(e)
+                with self._lock:
+                    self._finish_locked(rec, J.FAILED)
+        finally:
+            with self._lock:
+                self._pool_threads -= 1
+
+    def _run_pooled_inner(self, rec: JobRecord) -> None:
+        from tclb_tpu.control.sweep import expand_grid
+        body = rec.body
+        params = dict(body.get("params") or {})
+        base = {"model": body["model"],
+                "shape": [int(s) for s in body["shape"]],
+                "niter": rec.niter,
+                "dtype": ("f64" if body.get("precision") == "f64"
+                          else "f32"),
+                "storage_dtype": body.get("storage_dtype"),
+                "params": params,
+                "timeout_s": body.get("timeout_s"),
+                "digest": bool(body.get("digest"))}
+        if rec.resumable:
+            # validate_body guarantees resumable => exactly one case
+            docs = [dict(base,
+                         case={"name": rec.id, "settings": {}},
+                         ckpt_root=self._ckpt_root(rec.id),
+                         checkpoint_every=(rec.checkpoint_every
+                                           or max(1, rec.niter // 10)),
+                         checkpoint_keep=self.checkpoint_keep)]
+            cases = [None]
+        else:
+            cases = expand_grid(body.get("sweep") or {})
+            docs = [dict(base,
+                         case={"name": c.name or str(i),
+                               "settings": dict(c.settings)})
+                    for i, c in enumerate(cases)]
+        rec.status = J.RUNNING
+        rec.started_ts = _now()
+        rec.touch()
+        self.store.put(rec)
+        handles = [self._pool.submit(d) for d in docs]
+        results, errors = [], []
+        for i, (pj, doc) in enumerate(zip(handles, docs)):
+            name = doc["case"]["name"]
+            try:
+                res = pj.result()
+            except BaseException as e:  # noqa: BLE001 — per-case verdict
+                if self._draining or self._closing:
+                    raise  # park the whole record for the next run
+                results.append({"name": name, "error": repr(e)})
+                errors.append(repr(e))
+                continue
+            row = {"name": name,
+                   "settings": doc["case"]["settings"],
+                   "globals": res.get("globals") or {}}
+            if res.get("state_sha256"):
+                row["state_sha256"] = res["state_sha256"]
+            results.append(row)
+            resumed = res.get("resumed_from")
+            if rec.resumable:
+                rec.progress_iter = int(res.get("iteration")
+                                        or rec.niter)
+                if resumed is not None:
+                    rec.resumed_from = int(resumed)
+                    with self._lock:
+                        self._resumed += 1
+                    telemetry.event("gateway.resumed", job_id=rec.id,
+                                    tenant=rec.tenant, step=resumed,
+                                    lane=res.get("lane"))
+                    telemetry.counter("gateway.jobs.resumed")
+        rec.results = results
+        if errors:
+            rec.error = "; ".join(errors[:4])
+        else:
+            rec.progress_iter = rec.niter
+        if rec.id in self._cancel:
+            # the work already ran to completion in a worker; honor the
+            # intent on the record without discarding the results
+            with self._lock:
+                self._finish_locked(rec, J.CANCELLED)
+            return
+        with self._lock:
+            self._finish_locked(rec, J.FAILED if errors else J.DONE)
+
     def _run_resumable(self, rec: JobRecord) -> None:
         with self._resume_sem:
             try:
@@ -555,6 +740,16 @@ class GatewayService:
                             base=lat, init_on_run=False)
         done = start
         while done < niter:
+            if self._draining and done > start:
+                # graceful drain: the segment just finished is already
+                # checkpointed — park the record so the next incarnation
+                # resumes from latest() bit-identically
+                rec.status = J.QUEUED
+                rec.touch()
+                self.store.put(rec)
+                telemetry.event("gateway.parked", job_id=rec.id,
+                                tenant=rec.tenant, step=done)
+                return
             if rec.id in self._cancel or self._closing:
                 with self._lock:
                     self._finish_locked(rec, J.CANCELLED)

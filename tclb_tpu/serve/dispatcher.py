@@ -365,9 +365,23 @@ class FleetDispatcher:
                  monitor: Optional[str] = None,
                  retry_policy: Optional[RetryPolicy] = None,
                  probe_interval_s: Optional[float] = None,
-                 probe_runner: Optional[Callable] = None):
+                 probe_runner: Optional[Callable] = None,
+                 process_isolation: bool = False,
+                 pool: Optional[Any] = None):
         self.devices = list(devices) if devices is not None \
             else list(jax.devices())
+        # process isolation: one supervised worker SUBPROCESS per lane
+        # instead of in-process device lanes — a wedged device or a
+        # native crash kills one child, not the dispatcher.  Jobs cross
+        # as plain JSON (pool_doc_from_spec); results come back as
+        # host-side dicts (globals + sha256 digest), not live device
+        # arrays, so plan/grad specs must use the in-process lanes.
+        self._pool = None
+        if process_isolation or pool is not None:
+            from tclb_tpu.serve.pool import WorkerPool
+            self._pool = pool if pool is not None else WorkerPool(
+                workers=max(1, len(self.devices)),
+                retry_policy=retry_policy, autostart=False)
         self.max_batch = max_batch
         self.retry_policy = retry_policy if retry_policy is not None \
             else RetryPolicy.from_retries(retries)
@@ -430,6 +444,11 @@ class FleetDispatcher:
             self._monitor = MonitorServer.from_spec(
                 self._monitor_spec).start()
             log.notice(f"fleet: monitor at {self._monitor.url}/status")
+        if self._pool is not None:
+            # process isolation: worker subprocesses ARE the lanes; the
+            # parent never starts in-process device threads
+            self._pool.start()
+            return
         for lane in self.lanes:
             lane.start()
         self._shard_worker = threading.Thread(
@@ -475,6 +494,8 @@ class FleetDispatcher:
         (parity tests / targeted draining)."""
         if self._closing:
             raise RuntimeError("dispatcher is closed")
+        if self._pool is not None:
+            return self._submit_pooled(spec)
         with self._lock:
             self._jobs += 1
             job = Job(spec, self._jobs)
@@ -511,6 +532,35 @@ class FleetDispatcher:
             self.start()
         return job
 
+    def _submit_pooled(self, spec: JobSpec) -> Job:
+        """Route one job through the process-isolated worker pool: the
+        spec crosses as plain JSON, the result comes back as a host-side
+        :class:`~tclb_tpu.serve.pool.PoolResult`."""
+        from tclb_tpu.serve.pool import PoolResult, pool_doc_from_spec
+        doc = pool_doc_from_spec(spec)   # rejects plan/grad specs early
+        with self._lock:
+            self._jobs += 1
+            job = Job(spec, self._jobs)
+            self._inflight[job.id] = job
+        telemetry.counter("serve.jobs.submitted")
+        telemetry.event("serve.job_queued", job_id=job.id,
+                        name=spec.name, model=spec.model.name,
+                        shape=list(spec.shape), niter=int(spec.niter),
+                        route="pool", reason="process_isolation")
+
+        def _done(pj) -> None:
+            job.attempts = pj.attempts
+            if pj.error is None:
+                job._finish(PoolResult(spec.case, pj._result), None)
+            else:
+                job._finish(None, pj.error)
+            self._stream(job)
+
+        self._pool.submit(doc, on_done=_done)
+        if self.autostart:
+            self.start()
+        return job
+
     def run(self, specs: Sequence[JobSpec]) -> list[Job]:
         """Submit all, wait for all; failed jobs keep their error on the
         handle instead of raising."""
@@ -526,6 +576,10 @@ class FleetDispatcher:
     def close(self, wait: bool = True, join_timeout: float = 60.0) -> None:
         self._closing = True
         self._stop_probes.set()
+        if self._pool is not None:
+            # finishes or fails every pool job first, so the pending
+            # sweep below only sees what the pool could not deliver
+            self._pool.close(wait=wait)
         if wait and self._started:
             deadline = time.monotonic() + join_timeout
             for t in self._probe_threads:

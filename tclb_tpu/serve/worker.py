@@ -1,0 +1,278 @@
+"""Solver worker subprocess: one process per pool lane.
+
+``python -m tclb_tpu.serve.worker --lane N`` is the child half of
+:class:`~tclb_tpu.serve.pool.WorkerPool` — the process-isolation unit
+that mirrors the reference TCLB's MPI rank: a wedged device, a hung XLA
+compile, or a native crash kills *this* process, and the supervisor in
+the parent restarts it without taking down sibling lanes or the serving
+front door.
+
+IPC protocol (length-prefixed pipes, stdin/stdout):
+
+* every frame is an 8-byte ``!II`` header (JSON length, payload length)
+  followed by a UTF-8 JSON document and an optional raw binary payload
+  (``.npy`` bytes for array data) — **never** pickled device arrays, so
+  a malicious or corrupt peer can at worst feed bad numbers, not code;
+* parent -> worker: ``{"t": "job", "id": ..., "spec": {...}}`` and
+  ``{"t": "shutdown"}``;
+* worker -> parent: ``{"t": "ready"}`` once importable, ``{"t": "hb"}``
+  heartbeats *during* execution (progress-based: one per solve chunk, so
+  a wedged device stops the beat), and ``{"t": "result"}`` with globals,
+  an optional ``state_sha256`` digest, and an optional ``.npy`` payload
+  of the final fields.
+
+Resumable jobs (``spec["ckpt_root"]``) save through
+:class:`~tclb_tpu.checkpoint.manager.CheckpointManager` at deterministic
+absolute segment boundaries and re-enter via ``latest()`` on restart, so
+a SIGKILLed worker's job finishes bit-identical to an uninterrupted run.
+
+Fault points fired *inside* the worker (the plan crosses the process
+boundary via ``TCLB_FAULTS``, re-serialized by the pool at spawn):
+``pool.heartbeat`` (``error`` wedges the worker mid-solve — the missed
+heartbeat the supervisor must catch; ``slow`` delays the beat) and
+``pool.worker_exit`` (``error`` hard-exits the process at a job start or
+segment boundary — the crash the supervisor must absorb).
+
+The worker claims the real stdout fd for frames at startup and rebinds
+``sys.stdout``/fd 1 to stderr, so a stray ``print`` (or a chatty
+library) can never corrupt the frame stream.
+"""
+
+from __future__ import annotations
+
+import argparse
+import io
+import json
+import os
+import struct
+import sys
+import time
+from typing import Any, BinaryIO, Optional
+
+_HEADER = struct.Struct("!II")
+
+#: refuse absurd frames instead of allocating unbounded buffers
+MAX_FRAME = 1 << 30
+
+
+class IpcError(RuntimeError):
+    """A torn or malformed frame on the worker pipe."""
+
+
+def write_frame(fh: BinaryIO, doc: dict, payload: bytes = b"") -> None:
+    """Write one length-prefixed frame: JSON doc + raw payload bytes."""
+    from tclb_tpu.telemetry import events
+    body = json.dumps(doc, default=events._json_default).encode()
+    fh.write(_HEADER.pack(len(body), len(payload)))
+    fh.write(body)
+    if payload:
+        fh.write(payload)
+    fh.flush()
+
+
+def _read_exact(fh: BinaryIO, n: int) -> bytes:
+    chunks = []
+    while n > 0:
+        chunk = fh.read(n)
+        if not chunk:
+            raise IpcError(f"pipe closed mid-frame ({n} bytes short)")
+        chunks.append(chunk)
+        n -= len(chunk)
+    return b"".join(chunks)
+
+
+def read_frame(fh: BinaryIO) -> tuple[dict, bytes]:
+    """Read one frame; EOFError on a clean close at a frame boundary,
+    :class:`IpcError` on a torn or malformed one."""
+    header = fh.read(_HEADER.size)
+    if not header:
+        raise EOFError("pipe closed")
+    if len(header) < _HEADER.size:
+        header += _read_exact(fh, _HEADER.size - len(header))
+    body_len, payload_len = _HEADER.unpack(header)
+    if body_len > MAX_FRAME or payload_len > MAX_FRAME:
+        raise IpcError(f"oversized frame ({body_len}+{payload_len} bytes)")
+    try:
+        doc = json.loads(_read_exact(fh, body_len).decode())
+    except (ValueError, UnicodeDecodeError) as e:
+        raise IpcError(f"malformed frame body: {e}") from e
+    payload = _read_exact(fh, payload_len) if payload_len else b""
+    if not isinstance(doc, dict):
+        raise IpcError("frame body must be a JSON object")
+    return doc, payload
+
+
+def npy_bytes(arr) -> bytes:
+    """Serialize a host array as ``.npy`` bytes (the only array wire
+    format — plain data, never pickles)."""
+    import numpy as np
+    buf = io.BytesIO()
+    np.save(buf, np.ascontiguousarray(np.asarray(arr)),
+            allow_pickle=False)
+    return buf.getvalue()
+
+
+def npy_load(payload: bytes):
+    import numpy as np
+    return np.load(io.BytesIO(payload), allow_pickle=False)
+
+
+# --------------------------------------------------------------------------- #
+# Solve execution (the only jax-touching half; imports stay lazy so the
+# protocol helpers above are importable from the device-free supervisor)
+# --------------------------------------------------------------------------- #
+
+
+def _solve(spec: dict, jid: str, lane: int, beat) -> tuple[dict, bytes]:
+    """Run one solve job from a plain-JSON spec; returns the result doc
+    + optional ``.npy`` payload of the final fields."""
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    from tclb_tpu import faults
+    from tclb_tpu.core.lattice import Lattice
+    from tclb_tpu.models import get_model
+
+    model = get_model(spec["model"])
+    shape = tuple(int(s) for s in spec["shape"])
+    precision = spec.get("dtype", "f32")
+    if precision == "f64":
+        jax.config.update("jax_enable_x64", True)
+    dtype = jnp.float64 if precision == "f64" else jnp.float32
+    sdt = {"bf16": jnp.bfloat16, "f32": jnp.float32,
+           "f64": jnp.float64}.get(spec.get("storage_dtype"))
+    settings = dict(spec.get("params") or {})
+    settings.update((spec.get("case") or {}).get("settings") or {})
+    niter = int(spec["niter"])
+
+    lat = Lattice(model, shape, dtype=dtype, storage_dtype=sdt,
+                  settings=settings or None)
+    mgr = None
+    resumed_from: Optional[int] = None
+    start = 0
+    ckpt_root = spec.get("ckpt_root")
+    if ckpt_root:
+        from tclb_tpu.checkpoint.manager import CheckpointManager
+        mgr = CheckpointManager(ckpt_root,
+                                keep_last=int(spec.get("checkpoint_keep")
+                                              or 2))
+        newest = mgr.latest()
+        if newest is not None:
+            mgr.restore(lat, newest)
+            start = int(np.asarray(lat.state.iteration))
+            resumed_from = start
+        else:
+            lat.init()
+    else:
+        lat.init()
+    beat(phase="built", iter=start)
+
+    every = int(spec.get("checkpoint_every") or 0) if mgr else 0
+    hb_every = int(spec.get("hb_iters") or 0) or every \
+        or max(1, niter // 8)
+    done = start
+    while done < niter:
+        # chunk boundaries are ABSOLUTE multiples of the cadence, so a
+        # resumed run (which starts at a checkpoint step) replays the
+        # exact boundary sequence of an uninterrupted one — the
+        # bit-identity contract
+        nxt = min(niter, (done // hb_every + 1) * hb_every)
+        if every:
+            nxt = min(nxt, (done // every + 1) * every)
+        lat.iterate(nxt - done)
+        done = nxt
+        if mgr and every and (done % every == 0 or done == niter):
+            mgr.save(lat, step=done)
+            try:
+                faults.fire("pool.worker_exit", lane=lane, job=jid,
+                            at="segment", step=done)
+            except faults.InjectedFault:
+                mgr.wait()
+                os._exit(17)
+        beat(iter=done)
+    if mgr:
+        mgr.wait()
+
+    doc: dict[str, Any] = {"globals": lat.get_globals(),
+                           "iteration": done,
+                           "resumed_from": resumed_from,
+                           "lane": lane, "pid": os.getpid()}
+    if spec.get("digest"):
+        import hashlib
+        arr = np.ascontiguousarray(np.asarray(lat.state.fields))
+        doc["state_sha256"] = hashlib.sha256(arr.tobytes()).hexdigest()
+    payload = b""
+    if spec.get("return_state"):
+        payload = npy_bytes(lat.state.fields)
+    return doc, payload
+
+
+def _run_job(out: BinaryIO, lane: int, doc: dict) -> None:
+    from tclb_tpu import faults
+    jid = str(doc.get("id"))
+    spec = doc.get("spec") or {}
+
+    def beat(**kw) -> None:
+        try:
+            faults.fire("pool.heartbeat", lane=lane, job=jid)
+        except faults.InjectedFault:
+            # a wedged worker: stop beating without exiting — the
+            # supervisor's missed-heartbeat watchdog must catch this
+            time.sleep(3600.0)
+        write_frame(out, {"t": "hb", "id": jid, **kw})
+
+    try:
+        try:
+            faults.fire("pool.worker_exit", lane=lane, job=jid,
+                        at="start")
+        except faults.InjectedFault:
+            out.flush()
+            os._exit(17)
+        beat(phase="accepted")
+        result, payload = _solve(spec, jid, lane, beat)
+        write_frame(out, dict({"t": "result", "id": jid, "ok": True},
+                              **result), payload)
+    except BaseException as e:  # noqa: BLE001 — per-job verdict: a bad
+        # spec fails the job, not the worker
+        write_frame(out, {"t": "result", "id": jid, "ok": False,
+                          "error": repr(e),
+                          "error_kind": type(e).__name__})
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(
+        prog="tclb-worker",
+        description="pool solver worker (speaks the WorkerPool frame "
+                    "protocol on stdin/stdout; not for interactive use)")
+    ap.add_argument("--lane", type=int, default=0,
+                    help="pool lane index this worker serves")
+    args = ap.parse_args(argv)
+
+    # claim the frame channel, then point fd 1 (and sys.stdout) at
+    # stderr so no library print can corrupt the protocol stream
+    out = os.fdopen(os.dup(1), "wb")
+    os.dup2(2, 1)
+    sys.stdout = sys.stderr
+    inp = os.fdopen(os.dup(0), "rb")
+
+    from tclb_tpu.telemetry import live as tlive
+
+    # a crashing worker leaves its own flight-<pid>.jsonl post-mortem
+    tlive.flight_recorder().attach()
+    write_frame(out, {"t": "ready", "pid": os.getpid(),
+                      "lane": args.lane})
+    while True:
+        try:
+            doc, _payload = read_frame(inp)
+        except (EOFError, IpcError):
+            return 0
+        t = doc.get("t")
+        if t == "shutdown":
+            return 0
+        if t == "job":
+            _run_job(out, args.lane, doc)
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -107,6 +107,19 @@ _META = {
                                         "Gateway submissions refused for a "
                                         "missing/wrong bearer token, by "
                                         "tenant"),
+    "tclb_pool_workers_spawned_total": ("counter",
+                                        "Pool worker subprocesses spawned, "
+                                        "by lane"),
+    "tclb_pool_workers_hung_total": ("counter",
+                                     "Pool workers declared hung (missed "
+                                     "heartbeat), by lane"),
+    "tclb_pool_workers_killed_total": ("counter",
+                                       "Pool workers killed by the "
+                                       "supervisor (SIGTERM/SIGKILL "
+                                       "escalation), by lane"),
+    "tclb_pool_workers_restarted_total": ("counter",
+                                          "Pool workers respawned after a "
+                                          "crash or hang, by lane"),
 }
 
 CONTENT_TYPE = "text/plain; version=0.0.4; charset=utf-8"
@@ -368,6 +381,18 @@ def _observe(doc: dict) -> None:
                   tenant=str(doc.get("tenant", "?")))
     elif kind == "gateway.resumed":
         reg.count("tclb_gateway_resumed_total", 1.0)
+    elif kind == "serve.worker_spawned":
+        reg.count("tclb_pool_workers_spawned_total", 1.0,
+                  lane=str(doc.get("lane", "?")))
+    elif kind == "serve.worker_hung":
+        reg.count("tclb_pool_workers_hung_total", 1.0,
+                  lane=str(doc.get("lane", "?")))
+    elif kind == "serve.worker_killed":
+        reg.count("tclb_pool_workers_killed_total", 1.0,
+                  lane=str(doc.get("lane", "?")))
+    elif kind == "serve.worker_restarted":
+        reg.count("tclb_pool_workers_restarted_total", 1.0,
+                  lane=str(doc.get("lane", "?")))
     elif kind == "gateway.job_done":
         reg.count("tclb_gateway_jobs_total", 1.0,
                   status=str(doc.get("status", "?")))
@@ -507,8 +532,61 @@ def flight_recorder() -> FlightRecorder:
     return _recorder
 
 
+# -- drain hooks: shutdown work that must run before SIGTERM kills us -------- #
+
+_drain_hooks: dict[str, Callable[[str], Any]] = {}
+_drain_lock = threading.Lock()
+
+
+def register_drain_hook(name: str, fn: Callable[[str], Any]) -> None:
+    """Register shutdown work to run on SIGTERM *before* the process
+    dies (stop admission, checkpoint in-flight jobs, snapshot the
+    store).  ``fn(reason)`` runs on the signal-handling main thread; a
+    truthy return claims the shutdown — the handler then returns instead
+    of re-raising, letting the registrant drive a clean ``exit 0``.
+    Last registration per name wins; hooks run in registration order."""
+    with _drain_lock:
+        _drain_hooks[name] = fn
+
+
+def unregister_drain_hook(name: str,
+                          fn: Optional[Callable] = None) -> None:
+    """Remove a drain hook; with ``fn`` given, only if it is the current
+    one (a closing component can't evict its replacement)."""
+    with _drain_lock:
+        cur = _drain_hooks.get(name)
+        if cur is not None and (fn is None or cur is fn):
+            del _drain_hooks[name]
+
+
+def run_drain_hooks(reason: str) -> bool:
+    """Run every registered drain hook (exceptions contained — the
+    shutdown path must not crash); True when any hook claimed the
+    shutdown."""
+    with _drain_lock:
+        hooks = list(_drain_hooks.items())
+    claimed = False
+    for name, fn in hooks:
+        try:
+            if fn(reason):
+                claimed = True
+        except Exception as e:  # noqa: BLE001 — dying cleanly beats
+            try:                # dying loudly
+                _recorder.dump(reason=f"drain_hook_error:{name}",
+                               error=repr(e))
+            except Exception:  # noqa: BLE001
+                pass
+    return claimed
+
+
 def _on_sigterm(signum, frame):  # pragma: no cover — exercised in CI smoke
+    # drain first (stop admission, checkpoint, snapshot) while the
+    # process is still healthy, then dump the forensic ring; only
+    # re-raise when no hook claimed the shutdown
+    claimed = run_drain_hooks("sigterm")
     _recorder.dump(reason="sigterm")
+    if claimed:
+        return
     prev = _prev_sigterm
     if callable(prev):
         prev(signum, frame)

@@ -538,3 +538,45 @@ def test_hygiene_fires_on_unpoliced_retry(tmp_path):
     assert hygiene.scan_unpoliced_retry() == []
     import inspect
     assert "scan_unpoliced_retry" in inspect.getsource(hygiene.check_repo)
+
+def test_hygiene_fires_on_unsupervised_subprocess(tmp_path):
+    """subprocess.Popen / os.fork in the serving stack outside
+    serve/pool.py is an orphan factory — no watchdog, no escalation, no
+    requeue — and must be flagged; the pool module itself is the one
+    sanctioned spawner."""
+    bad = tmp_path / "dispatcher.py"
+    bad.write_text(
+        "import os\n"
+        "import subprocess\n"
+        "from subprocess import Popen\n"
+        "def launch(cmd):\n"
+        "    subprocess.Popen(cmd)\n"
+        "    subprocess.run(cmd)\n"
+        "    Popen(cmd)\n"
+        "    if os.fork() == 0:\n"
+        "        pass\n")
+    found = hygiene.scan_unsupervised_subprocess([str(bad)])
+    assert {f.check for f in found} == {"hygiene.unsupervised_subprocess"}
+    assert all(f.severity == "error" for f in found)
+    assert len(found) >= 4                      # import alias + 4 calls
+    assert "WorkerPool" in found[0].message
+    # the sanctioned spawner is exempt by location, not content
+    pooldir = tmp_path / "serve"
+    pooldir.mkdir()
+    pool = pooldir / "pool.py"
+    pool.write_text("import subprocess\n"
+                    "def spawn(cmd):\n"
+                    "    return subprocess.Popen(cmd)\n")
+    assert hygiene.scan_unsupervised_subprocess([str(pool)]) == []
+    # non-spawning subprocess names stay legal
+    ok = tmp_path / "types.py"
+    ok.write_text("import subprocess\n"
+                  "def is_timeout(e):\n"
+                  "    return isinstance(e, subprocess.TimeoutExpired)\n")
+    assert hygiene.scan_unsupervised_subprocess([str(ok)]) == []
+    # the shipped serve/ + gateway/ tree is clean, and the repo-wide
+    # sweep chains the scan
+    assert hygiene.scan_unsupervised_subprocess() == []
+    import inspect
+    assert "scan_unsupervised_subprocess" \
+        in inspect.getsource(hygiene.check_repo)

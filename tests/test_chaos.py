@@ -387,3 +387,168 @@ def test_crash_mode_injection_dumps_flight_recorder(tmp_path, monkeypatch):
     assert any(d.get("kind") == "fault.injected" for d in lines)
     assert lines[-1]["kind"] == "flight_dump"
     assert lines[-1]["reason"] == "fault.injected:serve.stage"
+
+
+# --------------------------------------------------------------------------- #
+# Worker-pool schedules: the four pool.* injection points
+# --------------------------------------------------------------------------- #
+
+_STUB = """
+import json, os, struct, sys, time
+H = struct.Struct("!II")
+out = os.fdopen(os.dup(1), "wb"); os.dup2(2, 1)
+inp = os.fdopen(os.dup(0), "rb")
+lane = int(sys.argv[sys.argv.index("--lane") + 1])
+def send(doc):
+    body = json.dumps(doc).encode()
+    out.write(H.pack(len(body), 0)); out.write(body); out.flush()
+def recv():
+    h = inp.read(H.size)
+    if len(h) < H.size: raise EOFError
+    bl, pl = H.unpack(h)
+    doc = json.loads(inp.read(bl).decode()); inp.read(pl)
+    return doc
+send({"t": "ready", "pid": os.getpid(), "lane": lane})
+while True:
+    try: doc = recv()
+    except EOFError: sys.exit(0)
+    if doc.get("t") == "shutdown": sys.exit(0)
+    if doc.get("t") != "job": continue
+    jid = doc["id"]
+    send({"t": "hb", "id": jid})
+    send({"t": "result", "id": jid, "ok": True, "lane": lane,
+          "globals": {"n": (doc.get("spec") or {}).get("n")}})
+"""
+
+
+def test_pool_points_registered_and_spec_roundtrips():
+    """All four pool.* injection points are in the authoritative
+    registry (a typo cannot silently disable a schedule) and a combined
+    schedule round-trips through to_spec — the serialization that
+    carries a plan into worker subprocesses."""
+    for point in ("pool.spawn", "pool.heartbeat", "pool.ipc",
+                  "pool.worker_exit"):
+        assert point in faults.POINTS
+    plan = FaultPlan.parse(
+        "seed=42;pool.spawn:error:n=1;pool.heartbeat:error:n=1:after=3;"
+        "pool.ipc:error:n=1:after=1;pool.worker_exit:error:n=1:after=2")
+    assert FaultPlan.parse(plan.to_spec()) == plan
+    with pytest.raises(ValueError, match="unknown injection point"):
+        FaultPlan.parse("pool.nonsense:error")
+
+
+def test_pool_supervisor_schedule_no_lost_jobs(tmp_path):
+    """Seeded supervisor-side schedule (pool.spawn + pool.ipc errors)
+    against a two-lane pool under a small backlog: every job completes
+    (retried spawn, re-queued send — zero lost), both lanes end live,
+    and every crash-mode injection left a flight dump trigger."""
+    from tclb_tpu.serve.pool import WorkerPool
+    script = tmp_path / "stub.py"
+    script.write_text(_STUB)
+    import sys as _sys
+    faults.install(FaultPlan.parse(
+        "seed=13;pool.spawn:error:n=1;pool.ipc:error:n=1:after=2"))
+    pool = WorkerPool(workers=2, worker_cmd=[_sys.executable,
+                                             str(script)],
+                      heartbeat_timeout_s=5.0, term_grace_s=0.5,
+                      retry_policy=RetryPolicy(max_attempts=4,
+                                               base_delay_s=0.02,
+                                               max_delay_s=0.1),
+                      autostart=False)
+    try:
+        jobs = pool.run([{"n": i} for i in range(8)], timeout=120)
+        assert [j.status for j in jobs] == ["done"] * 8
+        assert sorted(j._result["globals"]["n"] for j in jobs) \
+            == list(range(8))
+        st = faults.stats()
+        assert sum(r["count"] for r in st["injected"]) == 2
+        assert pool.stats()["requeued"] == 1      # the ipc casualty
+        deadline = time.time() + 30
+        while pool.live_workers() < 2 and time.time() < deadline:
+            time.sleep(0.05)
+        assert pool.live_workers() == 2
+    finally:
+        pool.close()
+        faults.uninstall()
+
+
+@pytest.mark.slow
+def test_pool_worker_exit_schedule_blast_radius(tmp_path):
+    """Seeded worker-side schedule (pool.worker_exit crash at a
+    checkpointed segment boundary) with two REAL solver lanes and a
+    mixed backlog: the crashed resumable job resumes bit-identical,
+    sibling non-resumable jobs are untouched, and nothing is lost.  The
+    plan crosses into the workers via TCLB_FAULTS re-serialization."""
+    from tclb_tpu.serve.pool import WorkerPool
+    base = {"model": "d2q9", "shape": [8, 16], "niter": 30,
+            "params": {"nu": 0.05}, "digest": True,
+            "case": {"name": "c", "settings": {}}}
+    with WorkerPool(workers=1, autostart=False) as pool:
+        ref = pool.submit(dict(base, ckpt_root=str(tmp_path / "ref"),
+                               checkpoint_every=10))
+        ref_sha = ref.result(timeout=600)["state_sha256"]
+
+    # worker_exit hits per incarnation: job-start, then one per saved
+    # segment.  after=2 -> lane 0's first job crashes at step 20 (post
+    # save); the respawn fires only 2 hits and completes from 20.
+    # Sibling lane 1 serves plain jobs whose specs also fire job-start
+    # hits in THEIR OWN process (counters are per-incarnation).
+    faults.install(FaultPlan.parse(
+        "seed=404;pool.worker_exit:error:n=1:after=2"))
+    pool = WorkerPool(workers=2, job_attempts=3,
+                      retry_policy=RetryPolicy(max_attempts=4,
+                                               base_delay_s=0.05,
+                                               max_delay_s=0.2),
+                      autostart=False)
+    try:
+        resumable = pool.submit(dict(base,
+                                     ckpt_root=str(tmp_path / "x"),
+                                     checkpoint_every=10))
+        plain = [pool.submit(dict(base, niter=10,
+                                  case={"name": f"s{i}",
+                                        "settings": {}}))
+                 for i in range(3)]
+        res = resumable.result(timeout=600)
+        for p in plain:
+            assert p.result(timeout=600)["iteration"] == 10
+        assert res["resumed_from"] == 20
+        assert res["state_sha256"] == ref_sha
+        assert pool.stats()["restarts"] >= 1
+    finally:
+        pool.close()
+        faults.uninstall()
+
+
+@pytest.mark.slow
+def test_pool_heartbeat_schedule_hang_detected(tmp_path):
+    """Seeded worker-side schedule (pool.heartbeat wedge): the beat
+    stops mid-solve, the supervisor watchdog kills the worker within the
+    heartbeat timeout, and the re-queued job resumes from the checkpoint
+    that landed before the wedge — bit-identical."""
+    from tclb_tpu.serve.pool import WorkerPool
+    base = {"model": "d2q9", "shape": [8, 16], "niter": 30,
+            "params": {"nu": 0.05}, "digest": True,
+            "case": {"name": "h", "settings": {}}}
+    with WorkerPool(workers=1, autostart=False) as pool:
+        ref = pool.submit(dict(base, ckpt_root=str(tmp_path / "ref"),
+                               checkpoint_every=10))
+        ref_sha = ref.result(timeout=600)["state_sha256"]
+
+    faults.install(FaultPlan.parse(
+        "seed=606;pool.heartbeat:error:n=1:after=3"))
+    pool = WorkerPool(workers=1, heartbeat_timeout_s=20.0,
+                      job_attempts=3, term_grace_s=1.0,
+                      retry_policy=RetryPolicy(max_attempts=4,
+                                               base_delay_s=0.05,
+                                               max_delay_s=0.2),
+                      autostart=False)
+    try:
+        job = pool.submit(dict(base, ckpt_root=str(tmp_path / "w"),
+                               checkpoint_every=10))
+        res = job.result(timeout=600)
+        assert res["resumed_from"] == 20
+        assert res["state_sha256"] == ref_sha
+        assert pool.stats()["requeued"] == 1
+    finally:
+        pool.close()
+        faults.uninstall()

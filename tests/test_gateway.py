@@ -954,3 +954,61 @@ def test_store_idle_gc_expires_without_puts(tmp_path):
     nottl = JobStore(str(tmp_path / "nottl"))
     assert nottl.maybe_gc() is False  # no TTL -> never compacts idly
     nottl.close()
+
+
+# --------------------------------------------------------------------------- #
+# Liveness vs readiness, and the draining front door
+# --------------------------------------------------------------------------- #
+
+
+def test_healthz_liveness_vs_readiness(tmp_path):
+    """/healthz answers 200 for any live process; /healthz/ready (and
+    /readyz) flips to 503 + Retry-After while draining — the signal a
+    load balancer needs to stop routing before a rolling restart."""
+    from tclb_tpu.gateway.http import GatewayServer
+    svc = GatewayService(str(tmp_path / "store"))
+    with GatewayServer(svc) as srv:
+        code, doc, _ = _http(srv.url + "/healthz")
+        assert code == 200 and doc["live"] and doc["ready"]
+        for route in ("/healthz/ready", "/readyz"):
+            code, doc, _ = _http(srv.url + route)
+            assert code == 200 and doc["ok"], route
+
+        svc._draining = True
+        code, doc, _ = _http(srv.url + "/healthz")
+        assert code == 200 and doc["live"]       # draining != dead
+        assert doc["draining"] and not doc["ready"]
+        code, doc, hdrs = _http(srv.url + "/healthz/ready")
+        assert code == 503 and doc["draining"]
+        assert int(hdrs["Retry-After"]) >= 1
+
+        # admission is closed: structured 503 with a real Retry-After
+        code, doc, hdrs = _http(
+            srv.url + "/v1/jobs", "POST",
+            {"model": "d2q9", "shape": [8, 16], "niter": 2})
+        assert code == 503 and "draining" in doc["error"]
+        assert int(hdrs["Retry-After"]) >= 1
+        svc._draining = False
+
+
+def test_drain_stops_admission_and_snapshots_store(tmp_path):
+    """service.drain(): admission stops, a store snapshot lands, and
+    queued-but-unstarted records survive for the next incarnation."""
+    svc = GatewayService(str(tmp_path / "store"))
+    svc.start()
+    try:
+        code, doc = svc.submit({"model": "d2q9", "shape": [8, 16],
+                                "niter": 4})
+        assert code == 202
+        svc.result(doc["job"]["id"], wait=60)
+        svc.drain(grace_s=5.0)
+        assert svc.health() == {"live": True, "ready": False,
+                                "draining": True, "closing": False}
+        code, doc = svc.submit({"model": "d2q9", "shape": [8, 16],
+                                "niter": 4})
+        assert code == 503 and doc["retry_after_s"] >= 1
+        # the drain flushed a durable snapshot of the store
+        assert os.path.exists(os.path.join(svc.store.root,
+                                           "store.json"))
+    finally:
+        svc.close()

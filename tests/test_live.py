@@ -486,3 +486,65 @@ def test_job_timeline_includes_degrades(tmp_path, capsys):
     out = capsys.readouterr().out
     assert rc == 0
     assert "degraded" in out and "done" in out
+
+
+# --------------------------------------------------------------------------- #
+# Drain hooks: shutdown work chained ahead of SIGTERM death
+# --------------------------------------------------------------------------- #
+
+
+def test_drain_hooks_run_and_claim_sigterm(tmp_path, monkeypatch):
+    """A registered drain hook runs on SIGTERM before the flight dump;
+    a truthy return claims the shutdown so _on_sigterm returns (clean
+    exit path) instead of re-raising the signal."""
+    monkeypatch.setenv("TCLB_FLIGHT_DIR", str(tmp_path))
+    ran = []
+    live.register_drain_hook("svc", lambda reason: ran.append(reason)
+                             or True)
+    try:
+        # call the handler directly: with the hook claiming, it must
+        # NOT fall through to the re-raise (which would kill pytest)
+        live._on_sigterm(15, None)
+    finally:
+        live.unregister_drain_hook("svc")
+    assert ran == ["sigterm"]
+
+
+def test_drain_hooks_unclaimed_and_errors_contained(tmp_path,
+                                                    monkeypatch):
+    """run_drain_hooks returns False when no hook claims; a raising hook
+    is contained (the shutdown path must not crash) and later hooks
+    still run, in registration order."""
+    monkeypatch.setenv("TCLB_FLIGHT_DIR", str(tmp_path))
+    order = []
+
+    def boom(reason):
+        order.append("boom")
+        raise RuntimeError("drain hook exploded")
+
+    live.register_drain_hook("a", boom)
+    live.register_drain_hook("b", lambda r: order.append("b"))  # falsy
+    try:
+        assert live.run_drain_hooks("test") is False
+        assert order == ["boom", "b"]
+        live.register_drain_hook("c", lambda r: True)
+        assert live.run_drain_hooks("test") is True
+    finally:
+        live.unregister_drain_hook("a")
+        live.unregister_drain_hook("b")
+        live.unregister_drain_hook("c")
+
+
+def test_drain_hook_unregister_is_exact():
+    """unregister(name, fn) only evicts that exact fn — a closing
+    component cannot evict its replacement — and last registration per
+    name wins."""
+    first, second = (lambda r: "one"), (lambda r: "two")
+    live.register_drain_hook("gw", first)
+    live.register_drain_hook("gw", second)        # replaces first
+    live.unregister_drain_hook("gw", first)       # stale: no-op
+    try:
+        assert live.run_drain_hooks("x") is True  # second still wired
+    finally:
+        live.unregister_drain_hook("gw", second)
+    assert live.run_drain_hooks("x") is False

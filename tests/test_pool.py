@@ -1,0 +1,666 @@
+"""Process-isolated worker pool tests: the frame protocol, supervisor
+watchdog (hang detection, SIGTERM->SIGKILL escalation, crash-loop
+backoff, job requeue), blast-radius containment with sibling lanes,
+seeded ``pool.*`` fault schedules, the FleetDispatcher
+``process_isolation`` route, and (slow) kill-resume bit-identity through
+real solver workers and the ``--workers`` gateway CLI with SIGTERM
+drain.
+
+The fast supervisor tests drive a STUB worker — a plain-python script
+speaking the frame protocol with none of the solver imports — so hang /
+crash / requeue logic runs in milliseconds; real-worker coverage
+(which pays the jax import at spawn) is the slow half.
+"""
+
+import io
+import json
+import os
+import signal
+import struct
+import subprocess
+import sys
+import time
+import urllib.error
+import urllib.request
+
+import numpy as np
+import pytest
+
+from tclb_tpu import faults, telemetry
+from tclb_tpu.serve.pool import PoolJobError, WorkerPool
+from tclb_tpu.serve.retry import RetryPolicy
+from tclb_tpu.serve.worker import (IpcError, npy_bytes, npy_load,
+                                   read_frame, write_frame)
+from tclb_tpu.telemetry import live
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+@pytest.fixture(autouse=True)
+def _clean():
+    telemetry.disable()
+    live.registry().reset()
+    faults.uninstall()
+    yield
+    faults.uninstall()
+    telemetry.disable()
+    live.registry().reset()
+
+
+# --------------------------------------------------------------------------- #
+# Frame protocol
+# --------------------------------------------------------------------------- #
+
+
+def test_frame_roundtrip_with_payload():
+    buf = io.BytesIO()
+    arr = np.arange(12, dtype=np.float64).reshape(3, 4)
+    doc = {"t": "result", "id": "pj-1", "ok": True,
+           "globals": {"drag": np.float64(1.5)}}
+    write_frame(buf, doc, npy_bytes(arr))
+    buf.seek(0)
+    got, payload = read_frame(buf)
+    assert got["t"] == "result" and got["globals"]["drag"] == 1.5
+    np.testing.assert_array_equal(npy_load(payload), arr)
+    with pytest.raises(EOFError):          # clean close at a boundary
+        read_frame(buf)
+
+
+def test_frame_rejects_torn_and_malformed():
+    buf = io.BytesIO()
+    write_frame(buf, {"t": "hb"})
+    torn = io.BytesIO(buf.getvalue()[:-2])  # truncated mid-body
+    with pytest.raises(IpcError):
+        read_frame(torn)
+    with pytest.raises(IpcError):           # oversized header
+        read_frame(io.BytesIO(struct.pack("!II", 1 << 31, 0)))
+    body = json.dumps([1, 2]).encode()      # non-object body
+    with pytest.raises(IpcError):
+        read_frame(io.BytesIO(struct.pack("!II", len(body), 0) + body))
+
+
+def test_npy_payload_never_pickles():
+    with pytest.raises(ValueError):
+        npy_bytes(np.array([object()], dtype=object))
+
+
+# --------------------------------------------------------------------------- #
+# Supervisor logic against a stub worker (no solver imports: fast spawns)
+# --------------------------------------------------------------------------- #
+
+STUB_WORKER = """
+import json, os, struct, sys, time
+H = struct.Struct("!II")
+out = os.fdopen(os.dup(1), "wb")
+os.dup2(2, 1)
+inp = os.fdopen(os.dup(0), "rb")
+lane = int(sys.argv[sys.argv.index("--lane") + 1])
+
+def send(doc):
+    body = json.dumps(doc).encode()
+    out.write(H.pack(len(body), 0)); out.write(body); out.flush()
+
+def recv():
+    h = inp.read(H.size)
+    if len(h) < H.size:
+        raise EOFError
+    bl, pl = H.unpack(h)
+    doc = json.loads(inp.read(bl).decode())
+    inp.read(pl)
+    return doc
+
+send({"t": "ready", "pid": os.getpid(), "lane": lane})
+while True:
+    try:
+        doc = recv()
+    except EOFError:
+        sys.exit(0)
+    if doc.get("t") == "shutdown":
+        sys.exit(0)
+    if doc.get("t") != "job":
+        continue
+    jid, spec = doc["id"], doc.get("spec") or {}
+    mode = spec.get("behave", "ok")
+    flag = spec.get("once_flag")
+    if flag:                       # misbehave only on the FIRST attempt
+        if os.path.exists(flag):
+            mode = "ok"
+        else:
+            open(flag, "w").close()
+    send({"t": "hb", "id": jid})
+    if mode == "crash":
+        os._exit(3)
+    if mode == "wedge":
+        time.sleep(3600)
+    if mode == "error":
+        send({"t": "result", "id": jid, "ok": False, "error": "boom"})
+        continue
+    send({"t": "result", "id": jid, "ok": True, "lane": lane,
+          "pid": os.getpid(), "globals": {"x": 1.0},
+          "iteration": spec.get("niter", 0)})
+"""
+
+
+@pytest.fixture()
+def stub_cmd(tmp_path):
+    script = tmp_path / "stub_worker.py"
+    script.write_text(STUB_WORKER)
+    return [sys.executable, str(script)]
+
+
+def _fast_pool(stub_cmd, **kw):
+    kw.setdefault("workers", 1)
+    kw.setdefault("heartbeat_timeout_s", 3.0)
+    kw.setdefault("spawn_timeout_s", 30.0)
+    kw.setdefault("term_grace_s", 0.5)
+    kw.setdefault("stable_after_s", 0.2)
+    kw.setdefault("retry_policy",
+                  RetryPolicy(max_attempts=4, base_delay_s=0.02,
+                              max_delay_s=0.1))
+    return WorkerPool(worker_cmd=stub_cmd, autostart=False, **kw)
+
+
+def test_pool_serves_jobs_across_lanes(stub_cmd):
+    with _fast_pool(stub_cmd, workers=2) as pool:
+        jobs = pool.run([{"behave": "ok", "niter": i} for i in range(6)],
+                        timeout=60)
+        assert all(j.status == "done" for j in jobs)
+        assert {j._result["iteration"] for j in jobs} == set(range(6))
+        # which lane serves a given job is a queue race (under load one
+        # lane can drain everything), but both lanes must be up and every
+        # job must have been served by a real lane
+        assert {j._result["lane"] for j in jobs} <= {0, 1}
+        deadline = time.monotonic() + 30
+        while pool.live_workers() < 2 and time.monotonic() < deadline:
+            time.sleep(0.05)
+        assert pool.live_workers() == 2
+        st = pool.stats()
+        assert st["done"] == 6 and st["failed"] == 0
+    assert pool.live_workers() == 0     # closed pool has no live lanes
+
+
+def test_hung_worker_detected_killed_and_job_retried(stub_cmd, tmp_path):
+    """A worker that stops beating mid-job is declared hung within the
+    heartbeat timeout, killed (SIGTERM->SIGKILL escalation), and the job
+    re-queued onto the respawned worker, where it completes."""
+    flag = tmp_path / "wedged-once"
+    with _fast_pool(stub_cmd, heartbeat_timeout_s=0.6) as pool:
+        job = pool.submit({"behave": "wedge", "once_flag": str(flag)})
+        res = job.result(timeout=60)
+        assert res["globals"] == {"x": 1.0}
+        assert job.attempts == 2
+        st = pool.stats()
+        assert st["requeued"] == 1 and st["restarts"] >= 1
+        snap = pool._status()
+        assert snap["workers"][0]["restarts"] >= 1
+
+
+def test_crashed_worker_requeues_then_fails_permanently(stub_cmd):
+    """A worker that dies mid-job re-queues the job up to job_attempts;
+    a job that kills every worker it touches fails terminally with the
+    attempt count in the error — never silently dropped."""
+    with _fast_pool(stub_cmd, job_attempts=2) as pool:
+        job = pool.submit({"behave": "crash"})
+        with pytest.raises(PoolJobError, match="after 2 attempts"):
+            job.result(timeout=60)
+        assert pool.stats()["requeued"] == 1
+
+
+def test_worker_reported_error_fails_job_without_respawn(stub_cmd):
+    """An ok=False result is a *job* verdict (bad spec, solver raise) —
+    the job fails once, the worker lives on, nothing is re-queued."""
+    with _fast_pool(stub_cmd) as pool:
+        job = pool.submit({"behave": "error"})
+        with pytest.raises(PoolJobError, match="boom"):
+            job.result(timeout=60)
+        assert job.attempts == 1
+        ok = pool.submit({"behave": "ok"})
+        assert ok.result(timeout=60)["globals"] == {"x": 1.0}
+        st = pool.stats()
+        assert st["requeued"] == 0 and st["restarts"] == 0
+
+
+def test_wedged_lane_never_stalls_siblings(stub_cmd, tmp_path):
+    """Blast radius: with two lanes and one permanently wedging job, the
+    sibling lane keeps serving the backlog; the wedged job fails after
+    its attempts and both lanes end up live again."""
+    with _fast_pool(stub_cmd, workers=2, heartbeat_timeout_s=0.6,
+                    job_attempts=2) as pool:
+        wedge = pool.submit({"behave": "wedge"})
+        oks = [pool.submit({"behave": "ok", "niter": i})
+               for i in range(8)]
+        for j in oks:
+            assert j.result(timeout=60)["globals"] == {"x": 1.0}
+        with pytest.raises(PoolJobError):
+            wedge.result(timeout=60)
+        deadline = time.time() + 30
+        while pool.live_workers() < 2 and time.time() < deadline:
+            time.sleep(0.05)
+        assert pool.live_workers() == 2
+
+
+def test_crash_loop_backoff_marks_lane_dead(tmp_path):
+    """A worker that can never start exhausts the RetryPolicy spawn
+    ladder; the lane is marked dead and queued + later submissions fail
+    fast instead of stranding their waiters."""
+    script = tmp_path / "dud.py"
+    script.write_text("import sys; sys.exit(13)\n")
+    pool = WorkerPool(workers=1, worker_cmd=[sys.executable, str(script)],
+                      spawn_timeout_s=5.0,
+                      retry_policy=RetryPolicy(max_attempts=2,
+                                               base_delay_s=0.02,
+                                               max_delay_s=0.05),
+                      autostart=False)
+    try:
+        job = pool.submit({"behave": "ok"})
+        with pytest.raises(PoolJobError, match="dead"):
+            job.result(timeout=60)
+        late = pool.submit({"behave": "ok"})
+        with pytest.raises(PoolJobError, match="dead"):
+            late.result(timeout=10)
+    finally:
+        pool.close()
+
+
+def test_pool_registers_status_provider(stub_cmd):
+    with _fast_pool(stub_cmd) as pool:
+        pool.run([{"behave": "ok"}], timeout=60)
+        snap = live.status_snapshot()
+        assert "pool" in snap
+        w = snap["pool"]["workers"][0]
+        assert w["state"] in ("idle", "busy") and w["pid"] is not None
+        assert snap["pool"]["jobs"]["done"] == 1
+    assert "pool" not in live.status_snapshot()
+
+
+# --------------------------------------------------------------------------- #
+# Seeded fault schedules, supervisor side (pool.spawn / pool.ipc)
+# --------------------------------------------------------------------------- #
+
+
+def test_fault_plan_to_spec_roundtrip():
+    spec = ("seed=7;pool.spawn:error:n=1;pool.ipc:error:p=0.5:after=2;"
+            "checkpoint.write:slow:delay=0.2")
+    plan = faults.FaultPlan.parse(spec)
+    assert faults.FaultPlan.parse(plan.to_spec()) == plan
+    faults.install(plan)
+    try:
+        assert faults.current_spec() == plan.to_spec()
+    finally:
+        faults.uninstall()
+    assert faults.current_spec() is None
+
+
+def test_pool_spawn_fault_retried_under_policy(stub_cmd):
+    """An injected spawn failure rides the crash-loop RetryPolicy: the
+    first attempt fails, the retry succeeds, the pool serves."""
+    faults.install(faults.FaultPlan.parse("seed=3;pool.spawn:error:n=1"))
+    with _fast_pool(stub_cmd) as pool:
+        job = pool.submit({"behave": "ok"})
+        assert job.result(timeout=60)["globals"] == {"x": 1.0}
+    assert faults.stats()["injected"][0]["count"] == 1
+
+
+def test_pool_ipc_fault_requeues_job(stub_cmd):
+    """A torn pipe on the job send (injected at pool.ipc) reaps the
+    worker and re-queues the job; the respawn completes it."""
+    faults.install(faults.FaultPlan.parse("seed=5;pool.ipc:error:n=1"))
+    with _fast_pool(stub_cmd) as pool:
+        job = pool.submit({"behave": "ok"})
+        assert job.result(timeout=60)["globals"] == {"x": 1.0}
+        assert job.attempts == 2
+        assert pool.stats()["requeued"] == 1
+
+
+# --------------------------------------------------------------------------- #
+# Real solver workers (pay the jax import per spawn)
+# --------------------------------------------------------------------------- #
+
+_SOLVE_DOC = {"model": "d2q9", "shape": [8, 16], "niter": 20,
+              "params": {"nu": 0.05}, "digest": True,
+              "case": {"name": "t", "settings": {}}}
+
+
+@pytest.mark.slow
+def test_real_worker_solve_roundtrip():
+    """A real solver worker subprocess runs a small solve from a plain
+    JSON spec and hands back globals + digest, bit-identical to the
+    in-process Lattice run of the same case."""
+    with WorkerPool(workers=1, autostart=False) as pool:
+        job = pool.submit(dict(_SOLVE_DOC, return_state=True))
+        res = job.result(timeout=300)
+    import jax.numpy as jnp
+
+    from tclb_tpu.core.lattice import Lattice
+    from tclb_tpu.models import get_model
+    lat = Lattice(get_model("d2q9"), (8, 16), dtype=jnp.float32,
+                  settings={"nu": 0.05})
+    lat.init()
+    lat.iterate(20)
+    import hashlib
+    want = hashlib.sha256(np.ascontiguousarray(
+        np.asarray(lat.state.fields)).tobytes()).hexdigest()
+    assert res["state_sha256"] == want
+    assert res["iteration"] == 20 and res["resumed_from"] is None
+    np.testing.assert_array_equal(res["fields"],
+                                  np.asarray(lat.state.fields))
+
+
+def test_dispatcher_process_isolation_rejects_plan_specs():
+    """Plan-carrying specs are rejected at submit, before any worker is
+    spawned — a live EnsemblePlan cannot cross a process boundary."""
+    import jax.numpy as jnp
+
+    from tclb_tpu.models import get_model
+    from tclb_tpu.serve import Case, EnsemblePlan, JobSpec
+    from tclb_tpu.serve.dispatcher import FleetDispatcher
+    model = get_model("d2q9")
+    disp = FleetDispatcher(process_isolation=True, autostart=False)
+    try:
+        planned = JobSpec(model=model, shape=(8, 16), case=Case(name="x"),
+                          niter=4, dtype=jnp.float32,
+                          plan=EnsemblePlan(model, (8, 16),
+                                            dtype=jnp.float32))
+        with pytest.raises(ValueError, match="cannot cross"):
+            disp.submit(planned)
+    finally:
+        disp.close()
+
+
+@pytest.mark.slow
+def test_dispatcher_process_isolation_route():
+    """FleetDispatcher(process_isolation=True) routes submits through
+    the pool: results come back as host-side PoolResults with digests,
+    and plan/grad specs are rejected before anything is queued."""
+    import jax.numpy as jnp
+
+    from tclb_tpu.models import get_model
+    from tclb_tpu.serve import Case, EnsemblePlan, JobSpec
+    from tclb_tpu.serve.dispatcher import FleetDispatcher
+    model = get_model("d2q9")
+    spec = JobSpec(model=model, shape=(8, 16), case=Case(name="p"),
+                   niter=10, dtype=jnp.float32,
+                   base_settings={"nu": 0.05})
+    disp = FleetDispatcher(process_isolation=True, autostart=False)
+    try:
+        job = disp.submit(spec)
+        res = job.result()
+        assert res.globals and res.state_sha256
+        assert res.iteration == 10 and res.pid is not None
+        planned = JobSpec(model=model, shape=(8, 16), case=Case(name="x"),
+                          niter=4, dtype=jnp.float32,
+                          plan=EnsemblePlan(model, (8, 16),
+                                            dtype=jnp.float32))
+        with pytest.raises(ValueError, match="cannot cross"):
+            disp.submit(planned)
+    finally:
+        disp.close()
+
+
+@pytest.mark.slow
+def test_worker_exit_fault_resumes_bit_identical(tmp_path):
+    """Seeded pool.worker_exit schedule: the worker hard-exits at a
+    checkpointed segment boundary; the supervisor respawns it, the job
+    re-enters via CheckpointManager.latest(), and the final digest is
+    bit-identical to an uninterrupted run.  (Worker-side fault counters
+    are per-incarnation, so after=2 makes the respawn survive: it fires
+    only 2 hits — job start + final segment — before finishing.)"""
+    base = dict(_SOLVE_DOC, niter=30, checkpoint_every=10)
+    with WorkerPool(workers=1, autostart=False) as pool:
+        ref = pool.submit(dict(base, ckpt_root=str(tmp_path / "ref")))
+        ref_sha = ref.result(timeout=600)["state_sha256"]
+
+    faults.install(faults.FaultPlan.parse(
+        "seed=7;pool.worker_exit:error:n=1:after=2"))
+    pool = WorkerPool(workers=1, job_attempts=3,
+                      retry_policy=RetryPolicy(max_attempts=4,
+                                               base_delay_s=0.05,
+                                               max_delay_s=0.2),
+                      autostart=False)
+    try:
+        job = pool.submit(dict(base, ckpt_root=str(tmp_path / "x")))
+        res = job.result(timeout=600)
+    finally:
+        pool.close()
+        faults.uninstall()
+    assert job.attempts == 2
+    assert res["resumed_from"] == 20
+    assert res["state_sha256"] == ref_sha
+    assert pool.stats()["restarts"] >= 1
+
+
+@pytest.mark.slow
+def test_heartbeat_fault_wedges_worker_then_resumes(tmp_path):
+    """Seeded pool.heartbeat schedule: an injected wedge stops the beat
+    mid-solve; the watchdog declares the worker hung, kills it, and the
+    requeued job resumes from the checkpoint that landed before the
+    wedge — still bit-identical."""
+    base = dict(_SOLVE_DOC, niter=30, checkpoint_every=10)
+    with WorkerPool(workers=1, autostart=False) as pool:
+        ref = pool.submit(dict(base, ckpt_root=str(tmp_path / "ref")))
+        ref_sha = ref.result(timeout=600)["state_sha256"]
+
+    # worker beats: accepted(1), built(2), iter10(3), iter20(4) -- the
+    # checkpoint at 20 saves BEFORE beat 4 wedges, so the respawn (3
+    # beats: accepted, built, iter30) resumes from 20 and completes
+    faults.install(faults.FaultPlan.parse(
+        "seed=11;pool.heartbeat:error:n=1:after=3"))
+    pool = WorkerPool(workers=1, heartbeat_timeout_s=20.0,
+                      job_attempts=3, term_grace_s=1.0,
+                      retry_policy=RetryPolicy(max_attempts=4,
+                                               base_delay_s=0.05,
+                                               max_delay_s=0.2),
+                      autostart=False)
+    try:
+        job = pool.submit(dict(base, ckpt_root=str(tmp_path / "w")))
+        res = job.result(timeout=600)
+    finally:
+        pool.close()
+        faults.uninstall()
+    assert res["resumed_from"] == 20
+    assert res["state_sha256"] == ref_sha
+    assert pool.stats()["requeued"] == 1
+
+
+# --------------------------------------------------------------------------- #
+# Gateway CLI with --workers: supervisor smoke + SIGTERM drain (slow)
+# --------------------------------------------------------------------------- #
+
+
+def _http(url, method="GET", body=None, timeout=300):
+    req = urllib.request.Request(
+        url, method=method,
+        data=json.dumps(body).encode() if body is not None else None,
+        headers={"Content-Type": "application/json"})
+    try:
+        with urllib.request.urlopen(req, timeout=timeout) as resp:
+            return resp.status, json.loads(resp.read()), resp.headers
+    except urllib.error.HTTPError as e:
+        return e.code, json.loads(e.read()), e.headers
+
+
+def _spawn_cli_gateway(tmp_path, store, tag, workers=2, extra=()):
+    """Start ``python -m tclb_tpu gateway --workers N`` and parse the
+    gateway + monitor URLs it prints."""
+    env = dict(os.environ, JAX_PLATFORMS="cpu", PYTHONPATH=REPO,
+               TCLB_FLIGHT_DIR=str(tmp_path / f"flight-{tag}"))
+    logf = open(tmp_path / f"gateway-{tag}.log", "w+")
+    proc = subprocess.Popen(
+        [sys.executable, "-m", "tclb_tpu", "gateway",
+         "--port", "0", "--store", str(store),
+         "--workers", str(workers),
+         "--monitor", "127.0.0.1:0", *extra],
+        env=env, cwd=REPO, stdout=logf, stderr=subprocess.STDOUT,
+        text=True)
+    deadline = time.time() + 120
+    urls = {}
+    while time.time() < deadline:
+        logf.flush()
+        text = open(logf.name).read()
+        for line in text.splitlines():
+            if line.startswith("monitor: "):
+                urls["monitor"] = line.split()[1].rsplit("/", 1)[0]
+            if line.startswith("gateway: http"):
+                urls["gateway"] = line.split()[1].rsplit("/v1", 1)[0]
+        if "gateway" in urls and "monitor" in urls:
+            return proc, urls
+        if proc.poll() is not None:
+            raise AssertionError(f"gateway CLI died:\n{text}")
+        time.sleep(0.1)
+    proc.kill()
+    raise AssertionError("gateway CLI never printed its URLs")
+
+
+_RESUMABLE = {"model": "d2q9", "shape": [16, 32], "niter": 60,
+              "params": {"nu": 0.05}, "resumable": True,
+              "checkpoint_every": 10, "digest": True}
+
+
+@pytest.mark.slow
+def test_gateway_pool_worker_sigkill_resume_bit_identical(tmp_path):
+    """Supervisor smoke through the serving path: SIGKILL the busy pool
+    worker mid-solve of an HTTP-submitted resumable job.  The GATEWAY
+    PROCESS never dies: the supervisor restarts the worker in place, the
+    job resumes from its newest checkpoint (resumed_from > 0), /metrics
+    stays scrapeable throughout, and the digest matches an uninterrupted
+    run."""
+    proc, urls = _spawn_cli_gateway(tmp_path, tmp_path / "ref-store",
+                                    "ref", workers=1)
+    try:
+        code, doc, _ = _http(urls["gateway"] + "/v1/jobs", "POST",
+                             _RESUMABLE)
+        assert code == 202, doc
+        code, doc, _ = _http(urls["gateway"]
+                             + f"/v1/jobs/{doc['job']['id']}"
+                             + "/result?wait=300")
+        assert code == 200 and doc["job"]["resumed_from"] is None
+        ref = doc["results"][0]
+    finally:
+        proc.kill()
+        proc.wait()
+
+    proc, urls = _spawn_cli_gateway(tmp_path, tmp_path / "store", "a",
+                                    workers=2)
+    try:
+        # readiness flips 503 -> 200 once at least one worker is live
+        deadline = time.time() + 120
+        while True:
+            code, doc, _ = _http(urls["gateway"] + "/healthz/ready")
+            if code == 200:
+                break
+            assert doc["workers_live"] == 0     # why it wasn't ready
+            assert proc.poll() is None and time.time() < deadline
+            time.sleep(0.2)
+        assert doc["workers_live"] >= 1
+        code, doc, _ = _http(urls["gateway"] + "/v1/jobs", "POST",
+                             _RESUMABLE)
+        assert code == 202, doc
+        jid = doc["job"]["id"]
+        # wait for a busy worker AND a landed checkpoint, then SIGKILL
+        # the worker out from under the job
+        ckroot = tmp_path / "store" / "ckpt" / jid
+        victim = None
+        deadline = time.time() + 240
+        while time.time() < deadline:
+            _, snap, _ = _http(urls["monitor"] + "/status")
+            busy = [w for w in snap.get("pool", {}).get("workers", [])
+                    if w["state"] == "busy"]
+            steps = os.listdir(ckroot) if ckroot.exists() else []
+            if busy and steps:
+                victim = busy[0]["pid"]
+                break
+            assert proc.poll() is None
+            time.sleep(0.1)
+        assert victim, "no busy pool worker with a checkpoint appeared"
+        os.kill(victim, signal.SIGKILL)
+        # the front door and the monitor stay responsive while the
+        # supervisor respawns the lane
+        code, _, _ = _http(urls["gateway"] + "/healthz")
+        assert code == 200
+        with urllib.request.urlopen(urls["monitor"] + "/metrics",
+                                    timeout=30) as resp:   # raw text
+            assert resp.status == 200
+            assert b"tclb_pool_workers" in resp.read()
+        code, doc, _ = _http(urls["gateway"]
+                             + f"/v1/jobs/{jid}/result?wait=300")
+        assert code == 200, doc
+        assert proc.poll() is None          # the gateway never died
+        job = doc["job"]
+        assert job["status"] == "done"
+        assert job["resumed_from"] is not None and job["resumed_from"] > 0
+        got = doc["results"][0]
+        assert got["state_sha256"] == ref["state_sha256"]
+        assert got["globals"] == ref["globals"]
+        _, snap, _ = _http(urls["monitor"] + "/status")
+        assert sum(w["restarts"]
+                   for w in snap["pool"]["workers"]) >= 1
+    finally:
+        proc.kill()
+        proc.wait()
+
+
+@pytest.mark.slow
+def test_gateway_cli_sigterm_drains_and_exits_zero(tmp_path):
+    """Zero-downtime drain: SIGTERM to the --workers gateway stops
+    admission (503 + Retry-After, readiness 503 while liveness stays
+    200), parks the in-flight resumable job at a checkpointed boundary,
+    flushes a store snapshot, and exits 0.  A restart on the same store
+    resumes the parked job from latest()."""
+    store = tmp_path / "store"
+    # big enough that the solve cannot finish inside the drain grace:
+    # the drain MUST kill the worker and park the record mid-run
+    body = dict(_RESUMABLE, shape=[64, 128], niter=20000,
+                checkpoint_every=500)
+    proc, urls = _spawn_cli_gateway(tmp_path, store, "d", workers=1,
+                                    extra=("--drain-grace", "8"))
+    try:
+        code, doc, _ = _http(urls["gateway"] + "/v1/jobs", "POST", body)
+        assert code == 202, doc
+        jid = doc["job"]["id"]
+        ckroot = store / "ckpt" / jid
+        deadline = time.time() + 240
+        while not (ckroot.exists() and os.listdir(ckroot)):
+            assert proc.poll() is None and time.time() < deadline
+            time.sleep(0.1)
+        proc.send_signal(signal.SIGTERM)
+        # while draining, liveness holds and readiness/submission 503
+        saw_draining = False
+        for _ in range(50):
+            if proc.poll() is not None:
+                break
+            try:
+                code, doc, hdrs = _http(urls["gateway"]
+                                        + "/healthz/ready", timeout=5)
+            except (urllib.error.URLError, ConnectionError, OSError):
+                break                        # already exited
+            if code == 503 and doc.get("draining"):
+                assert hdrs["Retry-After"] is not None
+                c2, d2, h2 = _http(urls["gateway"] + "/v1/jobs",
+                                   "POST", body, timeout=5)
+                assert c2 == 503 and h2["Retry-After"] is not None
+                c3, _, _ = _http(urls["gateway"] + "/healthz",
+                                 timeout=5)
+                assert c3 == 200
+                saw_draining = True
+                break
+            time.sleep(0.1)
+        assert proc.wait(timeout=120) == 0   # claimed shutdown: exit 0
+        assert saw_draining
+    finally:
+        if proc.poll() is None:
+            proc.kill()
+        proc.wait()
+
+    # the job was parked, not lost; a restart resumes it from latest()
+    proc, urls = _spawn_cli_gateway(tmp_path, store, "e", workers=1)
+    try:
+        code, doc, _ = _http(urls["gateway"]
+                             + f"/v1/jobs/{jid}/result?wait=600")
+        assert code == 200, doc
+        job = doc["job"]
+        assert job["status"] == "done"
+        assert job["resumed_from"] is not None and job["resumed_from"] > 0
+        assert job["progress_iter"] == 20000
+    finally:
+        proc.kill()
+        proc.wait()

@@ -913,7 +913,8 @@ def scan_unsupervised_subprocess(paths=None) -> list:
 
 
 def check_repo(engine_dir=None, sources=None) -> list:
-    from tclb_tpu.analysis.precision import scan_unsafe_accum
+    from tclb_tpu.analysis.precision import (scan_unsafe_accum,
+                                             scan_unshifted_cast)
     return (scan_dead_entry_points(engine_dir, sources)
             + scan_id_keyed_caches()
             + scan_unbounded_adjoint()
@@ -925,7 +926,8 @@ def check_repo(engine_dir=None, sources=None) -> list:
             + scan_device_work_in_gateway()
             + scan_unpoliced_retry()
             + scan_unsupervised_subprocess()
-            + scan_unsafe_accum())
+            + scan_unsafe_accum()
+            + scan_unshifted_cast())
 
 
 def check_model_hygiene(model: Model, shape=None) -> list:

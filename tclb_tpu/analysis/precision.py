@@ -39,6 +39,18 @@ _REDUCTIONS = frozenset({
     "sum", "mean", "prod", "cumsum", "dot", "matmul", "tensordot",
 })
 
+#: the sanctioned widen/narrow seams (core/shift.py helpers): routing a
+#: storage-dtype read through one of these yields a compute-dtype value
+#: (clean for the taint pass) AND applies the representation's DDF
+#: shift; a bare ``.astype`` also widens the dtype but silently drops
+#: the shift — which is what ``precision.unshifted_cast`` flags
+_SHIFT_HELPERS = frozenset({
+    "widen_plane", "narrow_plane", "widen_group",
+    "widen_stack", "narrow_stack",
+})
+
+_CLEANERS = frozenset({"astype"}) | _SHIFT_HELPERS
+
 
 def _declares_narrow_storage(tree) -> bool:
     """Module-level ``STORAGE_DTYPES = (..., jnp.bfloat16, ...)``."""
@@ -68,9 +80,10 @@ def _base_name(expr):
 def _expr_tainted(expr, tainted: set) -> bool:
     """Whether evaluating ``expr`` reads a storage-dtype value: a raw
     subscript of a field ref, or a name taint already flowed into.
-    ``.astype(...)`` widens — its whole subtree is clean."""
+    ``.astype(...)`` and the shared shift helpers widen — their whole
+    subtree is clean."""
     if isinstance(expr, ast.Call) and isinstance(expr.func, ast.Attribute) \
-            and expr.func.attr == "astype":
+            and expr.func.attr in _CLEANERS:
         return False
     if isinstance(expr, ast.Subscript) \
             and isinstance(expr.ctx, ast.Load) \
@@ -204,4 +217,124 @@ def _scan_kernel(fn, rel: str, seen: set) -> list:
         elif isinstance(stmt, (ast.Expr, ast.Return)):
             if stmt.value is not None:
                 check_expr(stmt.value)
+    return findings
+
+
+# --------------------------------------------------------------------------- #
+# unshifted_cast: every narrow/widen cast of distribution fields must go
+# through the shared shift helpers (core/shift.py)
+# --------------------------------------------------------------------------- #
+
+#: write targets that hold STORAGE-dtype field planes (the narrow seam);
+#: superset of :data:`_FIELD_REFS` — the resident engine's ping-pong
+#: passes its output stack as a ``dst`` parameter
+_SEAM_REFS = _FIELD_REFS | frozenset({"dst"})
+
+
+def scan_unshifted_cast(paths=None) -> list:
+    """Field-plane casts bypassing the shared DDF-shift helpers.
+
+    The shifted storage representation (``storage_repr="shifted"``,
+    ``core/shift.py``) lives entirely in the widen/narrow seams: a
+    kernel that casts a distribution plane with a bare ``.astype``
+    instead of ``widen_plane``/``narrow_plane`` (or the stack variants)
+    silently reads the *deviation* ``f_i - w_i`` as if it were ``f_i``
+    — wrong physics with no crash.  In every ``STORAGE_DTYPES``-
+    declaring ops module, each ``kernel*`` function is checked for:
+
+    * a ``.astype(...)`` whose receiver derives from a raw field-buffer
+      read (widen seam bypass), and
+    * a ``.astype(...)`` anywhere in a value stored into a field-buffer
+      subscript (narrow seam bypass — the cast target is the storage
+      stack even when the value itself is a clean compute-dtype name).
+    """
+    if paths is None:
+        paths = sorted(
+            os.path.join(_PKG_ROOT, "ops", f)
+            for f in os.listdir(os.path.join(_PKG_ROOT, "ops"))
+            if f.endswith(".py"))
+    findings = []
+    for path in paths:
+        try:
+            with open(path) as fh:
+                tree = ast.parse(fh.read())
+        except (OSError, SyntaxError):
+            continue   # unsafe_accum already reports unparseable files
+        if not _declares_narrow_storage(tree):
+            continue
+        rel = os.path.relpath(path, _REPO_ROOT)
+        seen: set = set()
+        for fn in ast.walk(tree):
+            if isinstance(fn, ast.FunctionDef) and "kernel" in fn.name:
+                findings += _scan_casts(fn, rel, seen)
+    return findings
+
+
+def _scan_casts(fn, rel: str, seen: set) -> list:
+    findings = []
+    tainted: set = set()
+
+    def flag(lineno: int, what: str) -> None:
+        key = (rel, lineno)
+        if key in seen:
+            return
+        seen.add(key)
+        findings.append(Finding(
+            "precision.unshifted_cast", "error", "",
+            f"{rel}:{lineno} {fn.name}: {what} bypasses the shared "
+            "shift helpers — route field-plane casts through "
+            "core.shift.widen_plane/narrow_plane (or the stack "
+            "variants) so the shifted storage representation is "
+            "restored/removed at every seam", f"{rel}:{lineno}"))
+
+    def has_astype(expr) -> bool:
+        return any(isinstance(n, ast.Call)
+                   and isinstance(n.func, ast.Attribute)
+                   and n.func.attr == "astype"
+                   for n in ast.walk(expr))
+
+    def check_widen(expr) -> None:
+        """``<field-derived>.astype(...)`` anywhere inside ``expr``."""
+        for n in ast.walk(expr):
+            if isinstance(n, ast.Call) \
+                    and isinstance(n.func, ast.Attribute) \
+                    and n.func.attr == "astype" \
+                    and _expr_tainted(n.func.value, tainted):
+                flag(n.lineno, "a bare .astype over a field-buffer read")
+
+    def ordered_stmts(node):
+        for child in ast.iter_child_nodes(node):
+            if isinstance(child, ast.stmt):
+                yield child
+            yield from ordered_stmts(child)
+
+    for stmt in ordered_stmts(fn):
+        targets = []
+        if isinstance(stmt, ast.Assign):
+            targets = stmt.targets
+        elif isinstance(stmt, (ast.AnnAssign, ast.AugAssign)):
+            targets = [stmt.target]
+        value = getattr(stmt, "value", None)
+        if value is None or not isinstance(
+                stmt, (ast.Assign, ast.AnnAssign, ast.AugAssign,
+                       ast.Expr, ast.Return)):
+            continue
+        check_widen(value)
+        for t in targets:
+            if isinstance(t, ast.Subscript) \
+                    and _base_name(t) in _SEAM_REFS \
+                    and has_astype(value):
+                flag(stmt.lineno,
+                     "a bare .astype in a field-buffer store")
+        # the same forward taint flow as the accumulation scan, so a
+        # name bound from a raw field read stays flagged downstream
+        if isinstance(stmt, (ast.Assign, ast.AnnAssign, ast.AugAssign)):
+            hot = _expr_tainted(value, tainted)
+            for t in targets:
+                strong = isinstance(t, (ast.Name, ast.Tuple, ast.List))
+                for name in _target_names(t):
+                    if hot:
+                        tainted.add(name)
+                    elif strong:
+                        tainted.discard(name)
     return findings

@@ -80,10 +80,17 @@ class CheckpointManager:
 
     def latest(self) -> Optional[str]:
         """Path of the newest checkpoint that passes verification; skips
-        (with a warning + telemetry event) any that don't."""
+        (with a warning + telemetry event) any that don't.
+
+        An unknown ``storage_repr`` stamp is NOT a skip: the checkpoint
+        is intact, this build just cannot decode its at-rest layout —
+        falling back to an older one would silently resume from stale
+        state, so the structured ``kind="storage_repr"`` error
+        propagates to the caller."""
         for step, path in reversed(self.steps()):
             problems = mf.verify_checkpoint(path)
             if not problems:
+                rst.storage_layout(mf.read_manifest(path))
                 return path
             log.warning(f"checkpoint {path} failed verification "
                         f"({problems[0]}) — falling back")

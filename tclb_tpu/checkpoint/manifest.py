@@ -32,7 +32,16 @@ MANIFEST_NAME = "manifest.json"
 
 
 class CheckpointError(RuntimeError):
-    """A checkpoint is missing, malformed, or fails verification."""
+    """A checkpoint is missing, malformed, or fails verification.
+
+    ``kind`` is the machine-readable failure class (``"storage_repr"``
+    for an unknown at-rest representation, ``"verify"``, ... ``None``
+    for unclassified legacy raises) — the structured half callers
+    branch on without parsing the message."""
+
+    def __init__(self, message: str, kind: Optional[str] = None):
+        super().__init__(message)
+        self.kind = kind
 
 
 def _json_sanitize(obj: Any):
@@ -48,7 +57,8 @@ def _json_sanitize(obj: Any):
 
 def build_manifest(*, fingerprint: str, model_name: str, iteration: int,
                    shape: tuple, dtype: str, mesh_layout: Optional[dict],
-                   arrays: dict, extra: Optional[dict] = None) -> dict:
+                   arrays: dict, extra: Optional[dict] = None,
+                   storage: Optional[dict] = None) -> dict:
     return {
         "schema": SCHEMA_VERSION,
         "kind": "tclb_checkpoint",
@@ -56,6 +66,11 @@ def build_manifest(*, fingerprint: str, model_name: str, iteration: int,
         "iteration": int(iteration),
         "shape": [int(s) for s in shape],
         "dtype": str(dtype),
+        # at-rest layout of the fields array: {"dtype": ..., "repr":
+        # "raw"|"shifted"}.  Manifests older than the storage_repr stamp
+        # omit the key — readers treat that as raw at the compute dtype
+        # (exactly what those checkpoints hold)
+        "storage": storage,
         "mesh": mesh_layout,          # {"axes": {"y": 2, "x": 1}} or None
         "arrays": arrays,
         "extra": extra or {},

@@ -57,6 +57,25 @@ def _shard_host_copies(arr, mesh) -> ShardedCapture:
     return ShardedCapture(str(arr.dtype), dims, shards)
 
 
+def npy_safe(arr: np.ndarray) -> np.ndarray:
+    """bfloat16 has no ``.npy`` representation (numpy writes an opaque
+    ``V2`` descr that loses the type) — store the raw bits as uint16;
+    the manifest's storage stamp carries the real dtype for
+    :func:`npy_restore` to reinterpret.  Everything else passes
+    through untouched."""
+    if arr.dtype.name == "bfloat16":
+        return arr.view(np.uint16)
+    return arr
+
+
+def npy_restore(arr: np.ndarray, dtype_name: str) -> np.ndarray:
+    """Undo :func:`npy_safe` given the stamped at-rest dtype name."""
+    if dtype_name == "bfloat16" and arr.dtype.name != "bfloat16":
+        import ml_dtypes
+        return arr.view(ml_dtypes.bfloat16)
+    return arr
+
+
 def capture_lattice(lattice, extra: Optional[dict] = None) -> dict:
     """Fence + host-copy everything a checkpoint needs (runs on the
     calling thread; the result is plain numpy, safe to serialize on a
@@ -70,7 +89,7 @@ def capture_lattice(lattice, extra: Optional[dict] = None) -> dict:
         arrays["fields"] = _shard_host_copies(state.fields, mesh)
         arrays["flags"] = _shard_host_copies(state.flags, mesh)
     else:
-        arrays["fields"] = np.asarray(state.fields)
+        arrays["fields"] = npy_safe(np.asarray(state.fields))
         arrays["flags"] = np.asarray(state.flags)
     arrays["globals"] = np.asarray(state.globals_)
     arrays["settings"] = np.asarray(params.settings)
@@ -91,6 +110,11 @@ def capture_lattice(lattice, extra: Optional[dict] = None) -> dict:
         "iteration": int(np.asarray(state.iteration)),
         "shape": lattice.shape,
         "dtype": str(np.dtype(lattice.dtype)),
+        # the fields array is captured AT REST — stamp its layout so a
+        # restore can convert across storage representations instead of
+        # misreading a shifted deviation stack as raw distributions
+        "storage": {"dtype": str(np.dtype(lattice.storage_dtype)),
+                    "repr": lattice.storage_repr},
         "mesh": mesh_layout,
         "extra": full_extra,
     }
@@ -184,7 +208,8 @@ def write_checkpoint_files(dirpath: str, captured: dict,
         dtype=captured["dtype"],
         mesh_layout=captured["mesh"],
         arrays=records,
-        extra=captured["extra"])
+        extra=captured["extra"],
+        storage=captured.get("storage"))
     mf.write_manifest(dirpath, man)
     return total
 
@@ -210,6 +235,27 @@ def save_checkpoint(dirpath: str, lattice, extra: Optional[dict] = None,
         telemetry.counter("checkpoint.bytes_written", nbytes)
         telemetry.counter("checkpoint.saves")
     return dirpath
+
+
+def storage_layout(man: dict) -> tuple[str, str]:
+    """``(dtype, repr)`` of a manifest's at-rest fields array.
+
+    Manifests older than the ``storage`` stamp hold raw distributions
+    at the compute dtype (what every pre-stamp save wrote).  An unknown
+    representation raises a structured
+    :class:`~tclb_tpu.checkpoint.manifest.CheckpointError` with
+    ``kind="storage_repr"`` — refusing is mandatory, a shifted stack
+    read as raw (or the reverse) is silent wrong physics."""
+    from tclb_tpu.core import shift as ddf
+    st = man.get("storage") or {}
+    repr_ = str(st.get("repr", "raw"))
+    if repr_ not in ddf.STORAGE_REPRS:
+        raise mf.CheckpointError(
+            f"checkpoint stores fields in unknown storage_repr "
+            f"{repr_!r} (known: {ddf.STORAGE_REPRS}) — refusing to "
+            "load a representation this build cannot convert",
+            kind="storage_repr")
+    return str(st.get("dtype", man.get("dtype", "float32"))), repr_
 
 
 def _load_array(dirpath: str, rec: dict) -> np.ndarray:
@@ -254,15 +300,28 @@ def restore_lattice(lattice, dirpath: str, verify: bool = True) -> dict:
             raise mf.CheckpointError(
                 f"checkpoint shape {tuple(man['shape'])} != lattice shape "
                 f"{tuple(lattice.shape)}")
+        from tclb_tpu.core import shift as ddf
+        src_dtype, src_repr = storage_layout(man)
         recs = man["arrays"]
-        fields = _load_array(dirpath, recs["fields"])
+        fields = npy_restore(_load_array(dirpath, recs["fields"]),
+                             src_dtype)
         flags = _load_array(dirpath, recs["flags"])
         nbytes = fields.nbytes + flags.nbytes
+        # restore into the LIVE lattice's at-rest layout: same
+        # representation is a plain (possibly narrowing/widening) cast;
+        # across representations the shift moves in f64 on the host, so
+        # a shifted-bf16 <-> raw-f32 round trip is bit-faithful
+        if src_repr == lattice.storage_repr:
+            fields = jnp.asarray(fields, dtype=lattice.storage_dtype)
+        else:
+            fields = jnp.asarray(ddf.convert_fields_host(
+                fields, src_repr, lattice.storage_repr,
+                ddf.storage_shift(lattice.model), lattice.storage_dtype))
         lattice._fast_tried = False   # restored flags may paint new types
         lattice._iterate_cached = None
         lattice._host_flags = np.asarray(flags, dtype=np.uint16)
         lattice.state = LatticeState(
-            fields=jnp.asarray(fields, dtype=lattice.dtype),
+            fields=fields,
             flags=jnp.asarray(flags, dtype=FLAG_DTYPE),
             globals_=jnp.asarray(_load_array(dirpath, recs["globals"]),
                                  dtype=lattice.dtype),

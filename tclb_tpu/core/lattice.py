@@ -33,6 +33,7 @@ import jax.numpy as jnp
 import numpy as np
 from flax import struct
 
+from tclb_tpu.core import shift as ddf
 from tclb_tpu.core.registry import Model
 from tclb_tpu import telemetry
 
@@ -480,7 +481,8 @@ def make_iterate(model: Model, action: str = "Iteration",
                  unroll: int = 1,
                  streaming: Optional[Streaming] = None,
                  present: Optional[set] = None,
-                 storage_dtype: Any = None) -> Callable:
+                 storage_dtype: Any = None,
+                 storage_shift: Optional[np.ndarray] = None) -> Callable:
     """niter-step loop as a ``lax.scan`` (reference Lattice::Iterate,
     src/Lattice.cu.Rt:780-869).  Differentiable; wrap with ``jax.checkpoint``
     policies for long-horizon adjoints (reference SnapLevel tape,
@@ -499,12 +501,20 @@ def make_iterate(model: Model, action: str = "Iteration",
     ``storage_dtype`` — the same round-trip truncation the Pallas
     engines apply per DMA, which is what the error-vs-f32 harness
     (tclb_tpu/precision.py) must measure.  ``None`` keeps today's exact
-    path (the casts never enter the trace)."""
+    path (the casts never enter the trace).
+
+    ``storage_shift`` (DDF shifting, ``storage_repr="shifted"``) is the
+    broadcastable per-plane weight block from
+    :func:`tclb_tpu.core.shift.stack_shift`: the narrow carry then
+    stores ``f_i - w_i`` and every widen seam restores the shift before
+    the physics (f32 accumulation unchanged).  ``None`` = raw
+    representation (the seam helpers reduce to pure ``astype``)."""
     step_ng = make_action_step(model, action, streaming, present=present,
                                compute_globals=False)
     step_full = make_action_step(model, action, streaming, present=present,
                                  compute_globals=True)
     sdt = None if storage_dtype is None else jnp.dtype(storage_dtype)
+    sb = storage_shift if sdt is not None else None
 
     def iterate(state: LatticeState, params: SimParams, niter: int
                 ) -> LatticeState:
@@ -520,14 +530,18 @@ def make_iterate(model: Model, action: str = "Iteration",
         cdt = params.settings.dtype
 
         def body(s, _):
-            out = step_ng(s.replace(fields=s.fields.astype(cdt)), params)
-            return out.replace(fields=out.fields.astype(sdt)), None
+            out = step_ng(
+                s.replace(fields=ddf.widen_stack(s.fields, cdt, sb)),
+                params)
+            return out.replace(
+                fields=ddf.narrow_stack(out.fields, sdt, sb)), None
         state, _ = jax.lax.scan(
             body, state.replace(fields=state.fields.astype(sdt)),
             None, length=niter - 1, unroll=unroll)
-        out = step_full(state.replace(fields=state.fields.astype(cdt)),
-                        params)
-        return out.replace(fields=out.fields.astype(sdt))
+        out = step_full(
+            state.replace(fields=ddf.widen_stack(state.fields, cdt, sb)),
+            params)
+        return out.replace(fields=ddf.narrow_stack(out.fields, sdt, sb))
 
     return iterate
 
@@ -556,7 +570,9 @@ def make_ensemble_iterate(model: Model, action: str = "Iteration",
                           unroll: int = 1,
                           present: Optional[set] = None,
                           mode: str = "map",
-                          storage_dtype: Any = None) -> Callable:
+                          storage_dtype: Any = None,
+                          storage_shift: Optional[np.ndarray] = None
+                          ) -> Callable:
     """Batched counterpart of :func:`make_iterate`: advance N independent
     cases (stacked ``LatticeState``s + per-case ``SimParams``) in ONE
     device dispatch.
@@ -583,7 +599,9 @@ def make_ensemble_iterate(model: Model, action: str = "Iteration",
     like :func:`make_iterate`'s precision ladder — the serving tier's
     doubled batch caps come from genuinely bf16-resident ensemble
     state, so the per-step round trip must match the single-case
-    engines' truncation."""
+    engines' truncation.  ``storage_shift`` selects the shifted (DDF)
+    representation for that carry, exactly as in :func:`make_iterate`
+    (the shift block broadcasts under the leading case axis)."""
     if mode not in ("map", "vmap"):
         raise ValueError(f"ensemble mode must be 'map' or 'vmap', "
                          f"got {mode!r}")
@@ -592,6 +610,7 @@ def make_ensemble_iterate(model: Model, action: str = "Iteration",
     step_full = make_action_step(model, action, present=present,
                                  compute_globals=True)
     sdt = None if storage_dtype is None else jnp.dtype(storage_dtype)
+    sb = storage_shift if sdt is not None else None
 
     def _wrap(step, params):
         if sdt is None:
@@ -599,8 +618,9 @@ def make_ensemble_iterate(model: Model, action: str = "Iteration",
 
         def stepped(st, p=params):
             cdt = p.settings.dtype
-            out = step(st.replace(fields=st.fields.astype(cdt)), p)
-            return out.replace(fields=out.fields.astype(sdt))
+            out = step(
+                st.replace(fields=ddf.widen_stack(st.fields, cdt, sb)), p)
+            return out.replace(fields=ddf.narrow_stack(out.fields, sdt, sb))
         return stepped
 
     def iterate_map(states: LatticeState, params: SimParams, niter: int
@@ -631,9 +651,10 @@ def make_ensemble_iterate(model: Model, action: str = "Iteration",
         else:
             def narrow_step(st, p):
                 out = step_ng(
-                    st.replace(fields=st.fields.astype(p.settings.dtype)),
-                    p)
-                return out.replace(fields=out.fields.astype(sdt))
+                    st.replace(fields=ddf.widen_stack(
+                        st.fields, p.settings.dtype, sb)), p)
+                return out.replace(
+                    fields=ddf.narrow_stack(out.fields, sdt, sb))
 
             def body(s, _):
                 return jax.vmap(narrow_step)(s, params), None
@@ -645,8 +666,9 @@ def make_ensemble_iterate(model: Model, action: str = "Iteration",
             if sdt is None:
                 return step_full(s, p)
             out = step_full(
-                s.replace(fields=s.fields.astype(p.settings.dtype)), p)
-            return out.replace(fields=out.fields.astype(sdt))
+                s.replace(fields=ddf.widen_stack(
+                    s.fields, p.settings.dtype, sb)), p)
+            return out.replace(fields=ddf.narrow_stack(out.fields, sdt, sb))
         return jax.lax.map(final, (states, params))
 
     return iterate_map if mode == "map" else iterate_vmap
@@ -710,6 +732,7 @@ class Lattice:
                  settings: Optional[dict[str, float]] = None,
                  mesh: Any = None,
                  storage_dtype: Any = None,
+                 storage_repr: Optional[str] = None,
                  device: Any = None):
         if len(shape) != model.ndim:
             raise ValueError(f"model {model.name} is {model.ndim}D; "
@@ -737,6 +760,17 @@ class Lattice:
                                  "on sharded (mesh) lattices: the halo "
                                  "building block is f32-only")
         self.storage_dtype = sdt
+        # at-rest representation (DDF shifting): narrowed lattices with
+        # a recognized velocity set default to "shifted" (store
+        # f_i - w_i, Mach-independent bf16 accuracy); full-width storage
+        # is always "raw" so the f32 path stays bit-identical.  The
+        # repr is stamped into checkpoint manifests, serve/cache keys
+        # and telemetry spans — raw and shifted layouts never mix
+        # silently (core/shift.py).
+        narrowed = sdt != jnp.dtype(dtype)
+        self.storage_repr = ddf.resolve_repr(model, narrowed, storage_repr)
+        self._shift_vec = ddf.shift_of(model, self.storage_repr)
+        self._shift_block = ddf.stack_shift(model, self.storage_repr)
         self.mesh = mesh
         vec = model.settings_vector(settings)
         self._series: dict[tuple[int, int], np.ndarray] = {}
@@ -777,12 +811,15 @@ class Lattice:
         self._iterate_cached = None
         self._host_flags: Optional[np.ndarray] = None
         step_init = make_action_step(model, "Init")
-        if sdt != jnp.dtype(dtype):
+        if narrowed:
             def _init_narrow(state, params, _step=step_init,
-                             _cdt=jnp.dtype(dtype), _sdt=sdt):
-                out = _step(state.replace(fields=state.fields.astype(_cdt)),
-                            params)
-                return out.replace(fields=out.fields.astype(_sdt))
+                             _cdt=jnp.dtype(dtype), _sdt=sdt,
+                             _sb=self._shift_block):
+                out = _step(state.replace(
+                    fields=ddf.widen_stack(state.fields, _cdt, _sb)),
+                    params)
+                return out.replace(
+                    fields=ddf.narrow_stack(out.fields, _sdt, _sb))
             step_init = _init_narrow
         self._init = jax.jit(step_init, donate_argnums=0)
         self.sampler = None
@@ -888,7 +925,8 @@ class Lattice:
                 self._iterate_cached = jax.jit(
                     make_iterate(self.model, present=present,
                                  storage_dtype=(self.storage_dtype
-                                                if narrowed else None)),
+                                                if narrowed else None),
+                                 storage_shift=self._shift_block),
                     static_argnames=("niter",), donate_argnums=0)
         return self._iterate_cached
 
@@ -975,7 +1013,8 @@ class Lattice:
                     model=self.model.name, shape=list(self.shape),
                     reason=why or "unknown")
             return (pallas_d3q.make_pallas_iterate(
-                self.model, self.shape, sdt, present=present),
+                self.model, self.shape, sdt, present=present,
+                shift=self._shift_vec),
                 f"pallas_d3q[{self.model.name},fuse={k3}]")
         from tclb_tpu.ops import pallas_generic
         # the static analyzer's kernel-safety verdict gates EVERY
@@ -998,7 +1037,8 @@ class Lattice:
             present = present_types(self.model, self._flags_host())
             self._fast_probing = True
             return (pallas_generic.make_resident_iterate(
-                self.model, self.shape, sdt, present=present),
+                self.model, self.shape, sdt, present=present,
+                shift=self._shift_vec),
                 f"pallas_resident_generic[{self.model.name},fuse=8]")
         if (pallas_generic.supports(self.model, self.shape, sdt)
                 and pallas_generic.mosaic_ok(self.model, self.shape)):
@@ -1026,7 +1066,8 @@ class Lattice:
             self._fast_cfg = (fz, cap)
             return (pallas_generic.make_pallas_iterate(  # lowering gap
                 self.model, self.shape, sdt, fuse=fz,
-                present=present, by_cap=cap),
+                present=present, by_cap=cap,
+                shift=self._shift_vec),
                 f"pallas_generic[{self.model.name},fuse={fz}]")
         return None, None
 
@@ -1067,6 +1108,7 @@ class Lattice:
                                 * np.dtype(self.state.fields.dtype).itemsize
                                 + 2),
                 storage_dtype=np.dtype(self.state.fields.dtype).name,
+                storage_repr=self.storage_repr,
                 model=self.model.name,
                 iteration=int(self.state.iteration)) as sp:
             self._iterate_impl(niter)
@@ -1137,7 +1179,8 @@ class Lattice:
                         self._fast = fast = \
                             pallas_d3q.make_pallas_iterate(
                                 self.model, self.shape, self.storage_dtype,
-                                present=present, fuse=1)
+                                present=present, fuse=1,
+                                shift=self._shift_vec)
                         self._fast_name = (
                             f"pallas_d3q[{self.model.name},fuse=1]")
                         telemetry.engine_fallback(
@@ -1176,7 +1219,8 @@ class Lattice:
                                 pallas_generic.make_pallas_iterate(
                                     self.model, self.shape,
                                     self.storage_dtype,
-                                    fuse=fz, present=present)
+                                    fuse=fz, present=present,
+                                    shift=self._shift_vec)
                             self._fast_cfg = (fz, None)
                             self._fast_name = (
                                 f"pallas_generic"
@@ -1226,7 +1270,8 @@ class Lattice:
                         try:
                             it2 = pallas_generic.make_pallas_iterate(
                                 self.model, self.shape, self.storage_dtype,
-                                fuse=fz, present=present, by_cap=cap)
+                                fuse=fz, present=present, by_cap=cap,
+                                shift=self._shift_vec)
                             self.state = attempt(it2)
                         except Exception:  # noqa: BLE001
                             continue
@@ -1290,8 +1335,11 @@ class Lattice:
         """Evaluate a registered Quantity over the lattice (reference
         Lattice::GetQuantity, src/Lattice.cu.Rt:1012-1036)."""
         fn = self.model.quantity_fns[name]
-        # quantities evaluate in the compute dtype (no-op cast at f32)
-        fields = self.state.fields.astype(self.dtype)
+        # quantities evaluate in the compute dtype over RAW distributions
+        # (no-op cast at f32; the shifted rung restores f_i = dev + w_i
+        # at this widen seam, so extraction never sees the deviation)
+        fields = ddf.widen_stack(self.state.fields, self.dtype,
+                                 self._shift_block)
         ctx = NodeCtx(self.model, fields, fields,
                       self.state.flags, self.params,
                       iteration=self.state.iteration,
@@ -1315,27 +1363,56 @@ class Lattice:
                 self.state, self.params = self._place()
         self.avg_start = int(self.state.iteration)
 
+    def _plane_w(self, idx: int):
+        """Per-plane shift for the density accessors: the lattice weight
+        under the shifted representation, falsy (``None``) otherwise."""
+        if self._shift_vec is None:
+            return None
+        w = float(self._shift_vec[idx])
+        return w or None
+
     def get_density(self, name: str) -> jnp.ndarray:
-        return self.state.fields[self.model.storage_index[name]]
+        """One storage plane in RAW distribution values (the shifted
+        rung widens + restores ``w_i``; raw storage returns the plane
+        untouched, exactly the pre-shift behavior)."""
+        idx = self.model.storage_index[name]
+        w = self._plane_w(idx)
+        if w is None:
+            return self.state.fields[idx]
+        return ddf.widen_plane(self.state.fields[idx], self.dtype, w)
 
     def set_density_planes(self, values: dict) -> None:
         """Write several storage planes with ONE device placement (a
-        per-plane set_density would re-shard the whole state each time)."""
+        per-plane set_density would re-shard the whole state each time).
+        Values are RAW distributions; the shifted rung removes ``w_i``
+        in the compute dtype before narrowing."""
         fields = self.state.fields
         for name, value in values.items():
-            fields = fields.at[self.model.storage_index[name]].set(
-                jnp.asarray(value, dtype=self.storage_dtype))
+            idx = self.model.storage_index[name]
+            w = self._plane_w(idx)
+            if w is None:
+                plane = jnp.asarray(value, dtype=self.storage_dtype)
+            else:
+                plane = ddf.narrow_plane(
+                    jnp.asarray(value, dtype=self.dtype),
+                    self.storage_dtype, w)
+            fields = fields.at[idx].set(plane)
         self.state = dataclasses.replace(self.state, fields=fields)
         if self._place is not None:
             self.state, self.params = self._place()
 
     def set_density(self, name: str, value: np.ndarray) -> None:
-        self.state = dataclasses.replace(
-            self.state, fields=self.state.fields.at[
-                self.model.storage_index[name]].set(
-                    jnp.asarray(value, dtype=self.storage_dtype)))
-        if self._place is not None:
-            self.state, self.params = self._place()
+        self.set_density_planes({name: value})
+
+    def fields_raw(self) -> np.ndarray:
+        """At-rest field stack as a host float64 array in the RAW
+        representation — the representation-independent view the
+        precision harness and state digests compare against (the
+        arithmetic runs in f64, so it is exact for either storage
+        layout)."""
+        return ddf.convert_fields_host(
+            np.asarray(self.state.fields), self.storage_repr, "raw",
+            ddf.storage_shift(self.model), np.float64)
 
     def get_globals(self) -> dict[str, float]:
         """reference Lattice::getGlobals (src/Lattice.cu.Rt:1093-1106)."""
@@ -1362,6 +1439,7 @@ class Lattice:
         subsystem's writer so a kill mid-save never corrupts an existing
         copy; the manifest-verified directory format lives in
         :mod:`tclb_tpu.checkpoint`."""
+        from tclb_tpu.checkpoint.restore import npy_safe
         from tclb_tpu.checkpoint.writer import atomic_path, with_suffix
         extra = {}
         if self.params.time_series is not None:
@@ -1375,21 +1453,39 @@ class Lattice:
             with atomic_path(target) as tmp:
                 with open(tmp, "wb") as f:
                     np.savez(f,
-                             fields=np.asarray(self.state.fields),
+                             fields=npy_safe(np.asarray(self.state.fields)),
                              flags=np.asarray(self.state.flags),
                              iteration=int(self.state.iteration),
                              settings=np.asarray(self.params.settings),
                              zone_table=np.asarray(self.params.zone_table),
+                             storage_dtype=str(
+                                 np.dtype(self.storage_dtype)),
+                             storage_repr=self.storage_repr,
                              **extra)
 
     def load(self, path: str) -> None:
+        from tclb_tpu.checkpoint.restore import npy_restore
         from tclb_tpu.checkpoint.writer import resolve_npz
         d = np.load(resolve_npz(path))
         self._fast_tried = False   # restored flags may paint new types
         self._iterate_cached = None
         self._host_flags = np.asarray(d["flags"], dtype=np.uint16)
+        # files older than the storage_repr stamp are raw by definition;
+        # a cross-representation load converts on the host in f64 (an
+        # unknown stamp raises rather than loading garbage)
+        src_repr = (str(d["storage_repr"]) if "storage_repr" in d
+                    else "raw")
+        src_sdt = (str(d["storage_dtype"]) if "storage_dtype" in d
+                   else str(np.dtype(self.dtype)))
+        raw_fields = npy_restore(d["fields"], src_sdt)
+        if src_repr == self.storage_repr:
+            fields = jnp.asarray(raw_fields, dtype=self.storage_dtype)
+        else:
+            fields = jnp.asarray(ddf.convert_fields_host(
+                raw_fields, src_repr, self.storage_repr,
+                ddf.storage_shift(self.model), self.storage_dtype))
         self.state = LatticeState(
-            fields=jnp.asarray(d["fields"], dtype=self.storage_dtype),
+            fields=fields,
             flags=jnp.asarray(d["flags"], dtype=FLAG_DTYPE),
             globals_=self.state.globals_,
             iteration=jnp.asarray(d["iteration"], dtype=jnp.int32),

@@ -1,0 +1,229 @@
+"""DDF shifting: the storage representation of the precision ladder.
+
+Raw distributions carry an O(1) rest-equilibrium background (the lattice
+weights ``w_i``), so narrowing storage to bf16 spends the 8-bit mantissa
+on a constant and leaves ~``2**-8 * w_i`` of quantization noise per
+round trip — at low Mach that noise rivals the velocity signal itself.
+DDF shifting (Lehmann et al. 2022, "Accuracy and performance of the LBM
+with 64-bit, 32-bit, and customized 16-bit number formats") stores the
+*deviation* ``f_i - w_i`` instead: the mantissa goes to the signal and
+the low-Mach velocity error drops by roughly the background/signal
+ratio.  The shift is a per-plane compile-time constant, so it commutes
+with pull streaming (a per-plane roll) and costs one add per
+widen/narrow seam — seams that already exist for the cast.
+
+This module is the single source of truth for that representation:
+
+* :data:`STORAGE_REPRS` — the representation vocabulary (``"raw"``
+  stores ``f_i``; ``"shifted"`` stores ``f_i - w_i``), stamped into
+  checkpoint manifests, serve/cache keys and telemetry spans;
+* :func:`storage_shift` — the per-plane shift vector, derived from the
+  model's velocity sets (standard D2Q9/D3Q19/D3Q27 weight recognition;
+  unrecognized groups and non-streamed planes shift by 0);
+* the **shared seam helpers** (:func:`widen_plane`/:func:`narrow_plane`
+  for Pallas kernels, :func:`widen_stack`/:func:`narrow_stack` for the
+  XLA cast wrappers, :func:`widen_group` for stacked kernel planes) —
+  every narrow/widen cast of distribution fields MUST go through these
+  (the static ``precision.unshifted_cast`` check enforces it).  With
+  ``shift=None`` (the raw representation) every helper reduces to a
+  pure ``astype``: no ``+ 0.0`` is ever traced, so the default f32
+  path stays BIT-identical (``-0.0 + 0.0 == +0.0`` would break it).
+
+Host-side representation conversion (checkpoint restore across
+representations) runs in float64 (:func:`convert_fields_host`), so a
+shifted-bf16 -> raw-f32 -> shifted-bf16 round trip is bit-faithful.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Optional
+
+import numpy as np
+
+#: at-rest layouts of the distribution-field stack: ``raw`` stores
+#: ``f_i``, ``shifted`` stores ``f_i - w_i`` (w_i = lattice weights)
+STORAGE_REPRS = ("raw", "shifted")
+
+# |e|^2 -> weight for the standard velocity sets, with the member count
+# per ring that identifies the set (recognition must be exact — a group
+# that merely has 9 members is NOT a D2Q9 set)
+_WEIGHT_TABLES = {
+    9: ({0: 4.0 / 9.0, 1: 1.0 / 9.0, 2: 1.0 / 36.0},
+        {0: 1, 1: 4, 2: 4}),
+    19: ({0: 1.0 / 3.0, 1: 1.0 / 18.0, 2: 1.0 / 36.0},
+         {0: 1, 1: 6, 2: 12}),
+    27: ({0: 8.0 / 27.0, 1: 2.0 / 27.0, 2: 1.0 / 54.0, 3: 1.0 / 216.0},
+         {0: 1, 1: 6, 2: 12, 3: 8}),
+}
+
+_shift_cache: dict[str, np.ndarray] = {}
+
+
+def group_weights(ei: np.ndarray) -> Optional[np.ndarray]:
+    """Lattice weights for one density group's velocity vectors, or
+    ``None`` when the group is not a standard D2Q9/D3Q19/D3Q27 set.
+
+    ``ei`` is the (q, 3) integer offset block of the group's members
+    (fields are zero-padded in ``Model.ei``, so a field group can never
+    masquerade as a velocity set — all-zero rows fail the ring count).
+    """
+    ei = np.asarray(ei)
+    q = len(ei)
+    table = _WEIGHT_TABLES.get(q)
+    if table is None or np.any(np.abs(ei) > 1):
+        return None
+    weights, counts = table
+    e2 = (ei * ei).sum(axis=1)
+    have = {int(v): int(n) for v, n in
+            zip(*np.unique(e2, return_counts=True))}
+    if have != counts:
+        return None
+    return np.array([weights[int(v)] for v in e2], dtype=np.float64)
+
+
+def storage_shift(model) -> np.ndarray:
+    """Per-plane shift vector ``(n_storage,)`` in float64: the lattice
+    weight for every plane of a recognized velocity-set group, 0 for
+    everything else (fields, averaged planes, unrecognized groups).
+    Cached on ``Model.fingerprint`` (never ``id()``)."""
+    key = model.fingerprint
+    out = _shift_cache.get(key)
+    if out is None:
+        out = np.zeros((model.n_storage,), dtype=np.float64)
+        n_dens = len(model.densities)
+        for _name, idx in model.groups.items():
+            idx = [i for i in idx if i < n_dens]   # streamed planes only
+            if not idx:
+                continue
+            w = group_weights(model.ei[idx])
+            if w is not None:
+                out[idx] = w
+        _shift_cache[key] = out
+    return out
+
+
+def has_shift(model) -> bool:
+    """Whether the model has any recognized velocity set to shift."""
+    return bool(np.any(storage_shift(model)))
+
+
+def default_repr(model, narrowed: bool) -> str:
+    """The representation a :class:`Lattice` picks when none is asked
+    for: ``shifted`` on a narrowed rung with a recognized velocity set
+    (the Mach-independent default), ``raw`` otherwise (including every
+    full-width lattice — the f32 path never changes representation)."""
+    return "shifted" if (narrowed and has_shift(model)) else "raw"
+
+
+def resolve_repr(model, narrowed: bool, storage_repr: Optional[str]) -> str:
+    """Validate/resolve a requested representation for one lattice."""
+    if storage_repr is None:
+        return default_repr(model, narrowed)
+    if storage_repr not in STORAGE_REPRS:
+        raise ValueError(f"storage_repr {storage_repr!r} must be one of "
+                         f"{STORAGE_REPRS}")
+    if storage_repr == "shifted":
+        if not narrowed:
+            raise ValueError(
+                "storage_repr='shifted' requires a narrowed storage_dtype "
+                "(the full-width path keeps the raw representation so it "
+                "stays bit-identical)")
+        if not has_shift(model):
+            raise ValueError(
+                f"model {model.name} has no recognized standard velocity "
+                "set to derive DDF shifts from; use storage_repr='raw'")
+    return storage_repr
+
+
+def shift_of(model, storage_repr: str) -> Optional[np.ndarray]:
+    """The shift vector the seam helpers take: the per-plane weights for
+    ``"shifted"``, ``None`` for ``"raw"`` (pure-``astype`` seams)."""
+    return storage_shift(model) if storage_repr == "shifted" else None
+
+
+def plane_shifts(model, storage_repr: str) -> list:
+    """Per-plane helper arguments for kernel factories: python floats
+    (0.0 entries become ``None`` so the helper stays a pure cast)."""
+    vec = shift_of(model, storage_repr)
+    if vec is None:
+        return [None] * model.n_storage
+    return [float(w) if w else None for w in vec]
+
+
+# --------------------------------------------------------------------------- #
+# Seam helpers.  These are the ONLY sanctioned narrow/widen casts of
+# distribution fields (analysis/precision.py's unshifted_cast check
+# flags any bypass); with a falsy shift they are pure astype, so the
+# raw/f32 contract is untouched.
+# --------------------------------------------------------------------------- #
+
+
+def widen_plane(x, cdtype, w: Optional[float] = None):
+    """Storage plane -> compute dtype (+ per-plane shift restore)."""
+    y = x.astype(cdtype)
+    return y + y.dtype.type(w) if w else y
+
+
+def narrow_plane(x, sdtype, w: Optional[float] = None):
+    """Compute plane -> storage dtype (shift removed before the cast,
+    in the compute dtype, so the narrow rounds the deviation)."""
+    return (x - x.dtype.type(w)).astype(sdtype) if w else x.astype(sdtype)
+
+
+def _bshape(shift: np.ndarray, ndim: int) -> np.ndarray:
+    return np.asarray(shift).reshape((len(shift),) + (1,) * (ndim - 1))
+
+
+def widen_group(stack, cdtype, shift: Optional[np.ndarray] = None):
+    """Stacked kernel planes (leading plane axis) -> compute dtype."""
+    y = stack.astype(cdtype)
+    if shift is None or not np.any(shift):
+        return y
+    return y + _bshape(shift, stack.ndim).astype(np.dtype(cdtype))
+
+
+def widen_stack(fields, cdtype, shift_b: Optional[np.ndarray] = None):
+    """Whole field stack -> compute dtype.  ``shift_b`` is the
+    pre-broadcast shift block from :func:`stack_shift` (``None`` = raw:
+    pure astype)."""
+    y = fields.astype(cdtype)
+    return y if shift_b is None else y + shift_b.astype(np.dtype(cdtype))
+
+
+def narrow_stack(fields, sdtype, shift_b: Optional[np.ndarray] = None):
+    """Whole compute-dtype field stack -> storage dtype."""
+    if shift_b is None:
+        return fields.astype(sdtype)
+    return (fields - shift_b.astype(np.dtype(fields.dtype))).astype(sdtype)
+
+
+def stack_shift(model, storage_repr: str) -> Optional[np.ndarray]:
+    """The broadcastable ``(n_storage, 1[, 1[, 1]])`` float32 shift
+    block for :func:`widen_stack`/:func:`narrow_stack` — shaped by the
+    model's space rank so it broadcasts under a leading batch axis too
+    (ensemble carries).  ``None`` for the raw representation."""
+    vec = shift_of(model, storage_repr)
+    if vec is None:
+        return None
+    return _bshape(vec, model.ndim + 1).astype(np.float32)
+
+
+def convert_fields_host(arr: np.ndarray, from_repr: str, to_repr: str,
+                        shift: np.ndarray, dtype: Any) -> np.ndarray:
+    """Host-side representation conversion for checkpoint restore /
+    legacy loads: ``arr`` (at-rest, any storage dtype/repr) -> the
+    target ``(dtype, to_repr)`` at-rest layout.  The arithmetic runs in
+    float64 so a shifted-bf16 -> raw-f32 -> shifted-bf16 round trip is
+    bit-faithful (f64 holds the sum ``f_dev + w`` exactly for every
+    representable deviation)."""
+    for r in (from_repr, to_repr):
+        if r not in STORAGE_REPRS:
+            raise ValueError(f"unknown storage_repr {r!r}; "
+                             f"known: {STORAGE_REPRS}")
+    wide = np.asarray(arr).astype(np.float64)
+    sb = _bshape(np.asarray(shift, dtype=np.float64), wide.ndim)
+    if from_repr == "shifted":
+        wide = wide + sb
+    if to_repr == "shifted":
+        wide = wide - sb
+    return wide.astype(np.dtype(dtype))

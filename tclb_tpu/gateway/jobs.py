@@ -22,12 +22,13 @@ TERMINAL = frozenset({DONE, FAILED, CANCELLED})
 #: (catching typos like "iterations" for "niter" at the door)
 _KNOWN_KEYS = frozenset({
     "model", "shape", "niter", "params", "sweep", "precision",
-    "storage_dtype", "resumable", "checkpoint_every", "timeout_s",
-    "tenant", "idempotency_key", "name", "digest",
+    "storage_dtype", "storage_repr", "resumable", "checkpoint_every",
+    "timeout_s", "tenant", "idempotency_key", "name", "digest",
 })
 
 _PRECISIONS = ("f32", "f64")
 _STORAGE_DTYPES = ("f32", "f64", "bf16")
+_STORAGE_REPRS = ("raw", "shifted")
 
 
 class ValidationError(ValueError):
@@ -144,6 +145,15 @@ def validate_body(body: Any, known_models: Optional[list] = None) -> dict:
     sdt = body.get("storage_dtype")
     _require(sdt is None or sdt in _STORAGE_DTYPES,
              f"'storage_dtype' must be one of {_STORAGE_DTYPES}")
+    srepr = body.get("storage_repr")
+    _require(srepr is None or srepr in _STORAGE_REPRS,
+             f"'storage_repr' must be one of {_STORAGE_REPRS}")
+    if srepr == "shifted":
+        # shifted is an encoding of *narrowed* storage; on a full-width
+        # lattice it would change the f32 bit-exact contract
+        _require(sdt is not None and sdt != precision,
+                 "'storage_repr': 'shifted' requires a narrowed "
+                 "'storage_dtype' (e.g. 'bf16')")
 
     resumable = bool(body.get("resumable", False))
     every = body.get("checkpoint_every", 0)

@@ -495,19 +495,21 @@ class GatewayService:
         dtype = jnp.float64 if precision == "f64" else jnp.float32
         sdt = {"bf16": jnp.bfloat16, "f32": jnp.float32,
                "f64": jnp.float64}.get(rec.body.get("storage_dtype"))
+        srepr = rec.body.get("storage_repr")
         cases = expand_grid(rec.body.get("sweep") or {})
-        return model, dtype, sdt, cases
+        return model, dtype, sdt, srepr, cases
 
     def _dispatch(self, rec: JobRecord) -> None:
         """Submit one record's cases as an atomic burst — same-class
         cases (across records AND tenants) bin into batched dispatches
         on the shared scheduler."""
         from tclb_tpu.serve.scheduler import JobSpec
-        model, dtype, sdt, cases = self._job_pieces(rec)
+        model, dtype, sdt, srepr, cases = self._job_pieces(rec)
         shape = tuple(int(s) for s in rec.body["shape"])
         params = dict(rec.body.get("params") or {})
         specs = [JobSpec(model=model, shape=shape, case=c,
                          niter=rec.niter, dtype=dtype, storage_dtype=sdt,
+                         storage_repr=srepr,
                          base_settings=params or None,
                          timeout_s=rec.body.get("timeout_s"),
                          tenant=rec.tenant,
@@ -605,6 +607,7 @@ class GatewayService:
                 "dtype": ("f64" if body.get("precision") == "f64"
                           else "f32"),
                 "storage_dtype": body.get("storage_dtype"),
+                "storage_repr": body.get("storage_repr"),
                 "params": params,
                 "timeout_s": body.get("timeout_s"),
                 "digest": bool(body.get("digest"))}
@@ -709,12 +712,12 @@ class GatewayService:
         from tclb_tpu.core.lattice import Lattice
         from tclb_tpu.serve.ensemble import Case, EnsemblePlan
         from tclb_tpu.serve.scheduler import JobSpec
-        model, dtype, sdt, _ = self._job_pieces(rec)
+        model, dtype, sdt, srepr, _ = self._job_pieces(rec)
         shape = tuple(int(s) for s in rec.body["shape"])
         params = dict(rec.body.get("params") or {})
         niter = rec.niter
         lat = Lattice(model, shape, dtype=dtype, storage_dtype=sdt,
-                      settings=params or None)
+                      storage_repr=srepr, settings=params or None)
         mgr = CheckpointManager(self._ckpt_root(rec.id),
                                 keep_last=self.checkpoint_keep)
         newest = mgr.latest()
@@ -737,7 +740,8 @@ class GatewayService:
         self.store.put(rec)
         every = rec.checkpoint_every or max(1, niter // 10)
         plan = EnsemblePlan(model, shape, dtype=dtype, storage_dtype=sdt,
-                            base=lat, init_on_run=False)
+                            storage_repr=srepr, base=lat,
+                            init_on_run=False)
         done = start
         while done < niter:
             if self._draining and done > start:
@@ -757,7 +761,8 @@ class GatewayService:
             seg = min(every, niter - done)
             spec = JobSpec(model=model, shape=shape,
                            case=Case(name=rec.id), niter=seg,
-                           dtype=dtype, storage_dtype=sdt, plan=plan,
+                           dtype=dtype, storage_dtype=sdt,
+                           storage_repr=srepr, plan=plan,
                            tenant=rec.tenant, bin_tag=f"gw-{rec.id}",
                            timeout_s=rec.body.get("timeout_s"),
                            name=f"{rec.id}@{done}")

@@ -48,6 +48,7 @@ import numpy as np
 from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu
 
+from tclb_tpu.core import shift as ddf
 from tclb_tpu.core.lattice import LatticeState, SimParams
 from tclb_tpu.core.registry import Model
 from tclb_tpu.models import family
@@ -265,7 +266,8 @@ def make_pallas_iterate(model: Model, shape, dtype=jnp.float32,
                         present: Optional[Iterable[str]] = None,
                         ext_halo: bool = False,
                         fuse: Optional[int] = None,
-                        fuse_bz: Optional[int] = None):
+                        fuse_bz: Optional[int] = None,
+                        shift: Optional[np.ndarray] = None):
     """Build ``iterate(state, params, niter) -> state`` running the fused
     3D Pallas kernel.  Caller must check :func:`supports` first.
 
@@ -323,6 +325,11 @@ def make_pallas_iterate(model: Model, shape, dtype=jnp.float32,
     ns = model.n_storage
     f_idx = list(model.groups["f"])
     assert f_idx == list(range(q)), "kernel assumes f planes lead the stack"
+    # per-plane DDF shift at the DMA seams: the f group widens/narrows
+    # by its lattice weight, aux planes (SynthT/avg) by nothing — with
+    # shift=None every helper call is a pure astype (raw contract)
+    _shifts = ([None] * ns if shift is None
+               else [float(w) or None for w in shift])
     si = model.setting_index
     sidx = model.storage_index
     nt = {n: (int(t.mask), int(t.value)) for n, t in model.node_types.items()}
@@ -512,27 +519,35 @@ def make_pallas_iterate(model: Model, shape, dtype=jnp.float32,
         # it the compiler fuses the rolls into the collide arithmetic,
         # changing FMA contraction and breaking bit-parity with the XLA
         # path (where streaming materializes before the collide fusion).
-        # astype widens bf16 storage to the f32 compute dtype (no-op at
-        # f32 storage, so the parity contract is untouched)
+        # the widen seam restores bf16 storage to the f32 compute dtype
+        # (+ the per-plane DDF shift under the shifted representation —
+        # scalar immediates, a Pallas kernel cannot capture an array
+        # constant; no-op at f32/raw storage, so the parity contract is
+        # untouched)
         f = jax.lax.optimization_barrier(
-            jnp.stack(pulled).astype(cdtype))
+            jnp.stack([ddf.widen_plane(p, cdtype, _shifts[k])
+                       for k, p in enumerate(pulled)]))
         flags = flags_ref[:]
         zonal = zonal_ref[:]
-        synth = [scra[aslot, aux_idx.index(j)].astype(cdtype)
+        synth = [ddf.widen_plane(scra[aslot, aux_idx.index(j)], cdtype,
+                                 _shifts[j])
                  for j in synth_idx] if is_cumulant else None
         fnew, extras = _step(f, flags, zonal, synth, sett)
         for k in range(q):
-            out_ref[k] = fnew[k].astype(dtype)
+            out_ref[k] = ddf.narrow_plane(fnew[k], dtype, _shifts[k])
         if is_cumulant:
             for j in synth_idx:
                 out_ref[j] = scra[aslot, aux_idx.index(j)]
             p_inc, (ux, uy, uz) = extras
-            out_ref[avgp_idx] = (
-                scra[aslot, aux_idx.index(avgp_idx)].astype(cdtype)
-                + p_inc).astype(dtype)
+            out_ref[avgp_idx] = ddf.narrow_plane(
+                ddf.widen_plane(scra[aslot, aux_idx.index(avgp_idx)],
+                                cdtype, _shifts[avgp_idx])
+                + p_inc, dtype, _shifts[avgp_idx])
             for j, u in zip(avgu_idx, (ux, uy, uz)):
-                out_ref[j] = (scra[aslot, aux_idx.index(j)].astype(cdtype)
-                              + u).astype(dtype)
+                out_ref[j] = ddf.narrow_plane(
+                    ddf.widen_plane(scra[aslot, aux_idx.index(j)], cdtype,
+                                    _shifts[j])
+                    + u, dtype, _shifts[j])
 
     def kernel(sett, f_hbm, flags_ref, zonal_ref, out_ref, scrf, scra, sems):
         # 2-slot double buffering: band i+1's DMAs are issued before band
@@ -607,28 +622,34 @@ def make_pallas_iterate(model: Model, shape, dtype=jnp.float32,
         # it the compiler fuses the rolls into the collide arithmetic,
         # changing FMA contraction and breaking bit-parity with the XLA
         # path (where streaming materializes before the collide fusion);
-        # astype widens bf16 storage to the f32 compute dtype
+        # the widen seam restores bf16 storage to the f32 compute dtype
+        # (+ the per-plane DDF shift under the shifted representation)
         f = jax.lax.optimization_barrier(
-            jnp.stack(pulled).astype(cdtype))
+            jnp.stack([ddf.widen_plane(p, cdtype, _shifts[k])
+                       for k, p in enumerate(pulled)]))
         flags = flags_ref[:]
         zonal = zonal_ref[:]
-        synth = [scra[slot, aux_idx.index(j)].astype(cdtype)
+        synth = [ddf.widen_plane(scra[slot, aux_idx.index(j)], cdtype,
+                                 _shifts[j])
                  for j in synth_idx] if is_cumulant else None
         fnew, extras = _step(f, flags, zonal, synth, sett)
         for k in range(q):
-            out_ref[k] = fnew[k].astype(dtype)
+            out_ref[k] = ddf.narrow_plane(fnew[k], dtype, _shifts[k])
         if is_cumulant:
             # SynthT passthrough; running averages accumulate per step
             # (reference average=T densities + Lattice::resetAverage)
             for j in synth_idx:
                 out_ref[j] = scra[slot, aux_idx.index(j)]
             p_inc, (ux, uy, uz) = extras
-            out_ref[avgp_idx] = (
-                scra[slot, aux_idx.index(avgp_idx)].astype(cdtype)
-                + p_inc).astype(dtype)
+            out_ref[avgp_idx] = ddf.narrow_plane(
+                ddf.widen_plane(scra[slot, aux_idx.index(avgp_idx)],
+                                cdtype, _shifts[avgp_idx])
+                + p_inc, dtype, _shifts[avgp_idx])
             for j, u in zip(avgu_idx, (ux, uy, uz)):
-                out_ref[j] = (scra[slot, aux_idx.index(j)].astype(cdtype)
-                              + u).astype(dtype)
+                out_ref[j] = ddf.narrow_plane(
+                    ddf.widen_plane(scra[slot, aux_idx.index(j)], cdtype,
+                                    _shifts[j])
+                    + u, dtype, _shifts[j])
 
     if ring_mode:
         call = pl.pallas_call(
@@ -761,17 +782,22 @@ def make_pallas_iterate(model: Model, shape, dtype=jnp.float32,
         zones = flagbuf >> zshift
         zonalbuf = [fusion.zone_plane(ztab, c, zone_max, zones)
                     for c in range(len(zonal_names))]
-        synthbuf = [scrf[slot, j].astype(cdtype) for j in synth_idx] \
-            if is_cumulant else None
+        synthbuf = [ddf.widen_plane(scrf[slot, j], cdtype, _shifts[j])
+                    for j in synth_idx] if is_cumulant else None
         if is_cumulant:
             # widen ONCE, accumulate all K steps in f32, narrow on the
             # output write (the precision.unsafe_accum contract)
-            acc_p = scrf[slot, avgp_idx, K:K + bzK].astype(cdtype)
-            acc_u = [scrf[slot, j, K:K + bzK].astype(cdtype)
+            acc_p = ddf.widen_plane(scrf[slot, avgp_idx, K:K + bzK],
+                                    cdtype, _shifts[avgp_idx])
+            acc_u = [ddf.widen_plane(scrf[slot, j, K:K + bzK], cdtype,
+                                     _shifts[j])
                      for j in avgu_idx]
 
         # rows [0, H); widened to the compute dtype for the step chain
-        cur = [scrf[slot, k].astype(cdtype) for k in range(q)]
+        # (the DDF shift restores once here and removes once at the
+        # final narrow: all K in-between steps run on raw f in f32)
+        cur = [ddf.widen_plane(scrf[slot, k], cdtype, _shifts[k])
+               for k in range(q)]
         for j in range(K):
             lo = j + 1                       # output window in buffer rows
             n_j = bzK + 2 * (K - 1 - j)
@@ -804,13 +830,14 @@ def make_pallas_iterate(model: Model, shape, dtype=jnp.float32,
                 acc_u = [au + u[c0:c0 + bzK] for au, u in zip(acc_u, us)]
 
         for k in range(q):
-            out_ref[k] = cur[k].astype(dtype)
+            out_ref[k] = ddf.narrow_plane(cur[k], dtype, _shifts[k])
         if is_cumulant:
             for j in synth_idx:
                 out_ref[j] = scrf[slot, j, K:K + bzK]
-            out_ref[avgp_idx] = acc_p.astype(dtype)
+            out_ref[avgp_idx] = ddf.narrow_plane(acc_p, dtype,
+                                                 _shifts[avgp_idx])
             for j, au in zip(avgu_idx, acc_u):
-                out_ref[j] = au.astype(dtype)
+                out_ref[j] = ddf.narrow_plane(au, dtype, _shifts[j])
 
     if K >= 2:
         call_f = pl.pallas_call(

@@ -46,6 +46,7 @@ import numpy as np
 from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu
 
+from tclb_tpu.core import shift as ddf
 from tclb_tpu.core.lattice import (LatticeState, NodeCtx, SimParams,
                                    series_dt_overrides, series_overrides)
 from tclb_tpu.core.registry import Model
@@ -556,7 +557,8 @@ def make_pallas_iterate(model: Model, shape, dtype=jnp.float32,
                         present: Optional[set] = None,
                         ext_halo: bool = False,
                         by_cap: Optional[int] = None,
-                        full_band: Optional[bool] = None):
+                        full_band: Optional[bool] = None,
+                        shift: Optional[np.ndarray] = None):
     """Build ``iterate(state, params, niter) -> state`` running the model's
     full Iteration action as one fused Pallas band kernel per step.
 
@@ -569,7 +571,8 @@ def make_pallas_iterate(model: Model, shape, dtype=jnp.float32,
             raise ValueError("3d generic engine has no ext_halo mode")
         return make_pallas_iterate_3d(model, shape, dtype,
                                       interpret=interpret, present=present,
-                                      fuse=fuse, by_cap=by_cap)
+                                      fuse=fuse, by_cap=by_cap,
+                                      shift=shift)
     if not supports(model, shape, dtype, probe=False):
         raise ValueError(f"pallas_generic unsupported: {model.name} {shape}")
     cdtype = _COMPUTE_DTYPE
@@ -595,6 +598,10 @@ def make_pallas_iterate(model: Model, shape, dtype=jnp.float32,
         interpret = jax.default_backend() != "tpu"
 
     n_storage = model.n_storage
+    # per-plane DDF shift at the DMA seams (None = raw: pure astype, so
+    # the f32/raw path traces bit-identically to the pre-shift kernel)
+    _shifts = ([None] * n_storage if shift is None
+               else [float(w) or None for w in shift])
     zonal_names = list(model.zonal_settings)
     zshift = model.zone_shift
     zone_max = model.zone_max
@@ -711,7 +718,8 @@ def make_pallas_iterate(model: Model, shape, dtype=jnp.float32,
         # Planes are widened to the compute dtype at the read (a traced
         # no-op at f32 storage) and narrowed on the output write — the
         # whole fused action accumulates in f32.
-        work = [buff[slot, k].astype(cdtype) for k in range(n_storage)]
+        work = [ddf.widen_plane(buff[slot, k], cdtype, _shifts[k])
+                for k in range(n_storage)]
         flags_full = bufa[slot, 0].astype(jnp.int32)
         if ztab is not None:
             zones_full = flags_full >> zshift
@@ -733,7 +741,8 @@ def make_pallas_iterate(model: Model, shape, dtype=jnp.float32,
             collect_globals=g_ref is not None, full_band=full_band)
 
         for k in range(n_storage):
-            out_ref[k] = work[k][_HALO:_HALO + by, :].astype(dtype)
+            out_ref[k] = ddf.narrow_plane(work[k][_HALO:_HALO + by, :],
+                                          dtype, _shifts[k])
 
         if g_ref is not None:
             split = with_globals == "split"
@@ -999,7 +1008,8 @@ def supports_resident(model: Model, shape, dtype) -> bool:
 def make_resident_iterate(model: Model, shape, dtype=jnp.float32,
                           interpret: Optional[bool] = None,
                           present: Optional[set] = None,
-                          chunk_cap: int = 64):
+                          chunk_cap: int = 64,
+                          shift: Optional[np.ndarray] = None):
     """Generic VMEM-resident engine: ``_RESIDENT_FUSE`` full lattice
     steps per kernel launch with the state ping-ponging between two
     on-chip stacks — HBM traffic (1R+1W)/FUSE per step and ONE kernel
@@ -1018,6 +1028,8 @@ def make_resident_iterate(model: Model, shape, dtype=jnp.float32,
     if interpret is None:
         interpret = jax.default_backend() != "tpu"
     ns = model.n_storage
+    _shifts = ([None] * ns if shift is None
+               else [float(w) or None for w in shift])
     zonal_names = list(model.zonal_settings)
     n_aux = 1 + len(zonal_names)
     nt_present = set(model.node_types) if present is None else set(present)
@@ -1058,8 +1070,9 @@ def make_resident_iterate(model: Model, shape, dtype=jnp.float32,
         def one_step(src, dst):
             for c0 in range(0, ny, chunk):
                 c1 = c0 + chunk
-                work = [_circ(src, k, c0 - _HALO, c1 + _HALO).astype(cdtype)
-                        for k in range(ns)]
+                work = [ddf.widen_plane(
+                    _circ(src, k, c0 - _HALO, c1 + _HALO), cdtype,
+                    _shifts[k]) for k in range(ns)]
                 fl = _circ(aux_ref, 0, c0 - _HALO, c1 + _HALO).astype(
                     jnp.int32)
                 zon = {nm: _circ(aux_ref, 1 + j, c0 - _HALO, c1 + _HALO)
@@ -1069,8 +1082,9 @@ def make_resident_iterate(model: Model, shape, dtype=jnp.float32,
                     it_ref[0] + t * adv, nt_present, _HALO, nx, cdtype,
                     n_per_rep=n_per_rep, full_band=True)
                 for k in range(ns):
-                    dst[k, c0:c1, :] = \
-                        work[k][_HALO:_HALO + chunk, :].astype(dtype)
+                    dst[k, c0:c1, :] = ddf.narrow_plane(
+                        work[k][_HALO:_HALO + chunk, :], dtype,
+                        _shifts[k])
 
         # ping-pong scratch <-> out (saves a third whole-lattice stack);
         # an EVEN grid length lands the final step in out_ref
@@ -1110,7 +1124,8 @@ def make_resident_iterate(model: Model, shape, dtype=jnp.float32,
     # the band engine supplies the trailing in-kernel-globals step (and
     # any remainder), making the composition full_globals
     band = make_pallas_iterate(model, shape, dtype, interpret=interpret,
-                               fuse=1, present=present, full_band=True)
+                               fuse=1, present=present, full_band=True,
+                               shift=shift)
 
     @partial(jax.jit, static_argnames=("niter",), donate_argnums=0)
     def _resident_jit(state: LatticeState, params: SimParams, niter: int
@@ -1273,7 +1288,8 @@ def make_pallas_iterate_3d(model: Model, shape, dtype=jnp.float32,
                            interpret: Optional[bool] = None,
                            present: Optional[set] = None,
                            fuse: int = 1,
-                           by_cap: Optional[int] = None):
+                           by_cap: Optional[int] = None,
+                           shift: Optional[np.ndarray] = None):
     """3D generic engine: the model's full Iteration action per z-slab
     band pass, with the same registry-driven machinery as the 2D builder
     (multi-stage extension plan, zonal aux planes, in-kernel SUM globals
@@ -1318,6 +1334,8 @@ def make_pallas_iterate_3d(model: Model, shape, dtype=jnp.float32,
         interpret = jax.default_backend() != "tpu"
 
     ns = model.n_storage
+    _shifts = ([None] * ns if shift is None
+               else [float(w) or None for w in shift])
     zonal_names = list(model.zonal_settings)
     zshift = model.zone_shift
     zone_max = model.zone_max
@@ -1407,7 +1425,8 @@ def make_pallas_iterate_3d(model: Model, shape, dtype=jnp.float32,
             # widen to the compute dtype at the read (traced no-op at f32
             # storage); the whole fused action accumulates in f32 and the
             # output write narrows back to the storage dtype
-            work = [buff[slot, k].astype(cdtype) for k in range(ns)]
+            work = [ddf.widen_plane(buff[slot, k], cdtype, _shifts[k])
+                    for k in range(ns)]
             flags_full = bufa[slot, 0].astype(jnp.int32)
             if ztab is not None:
                 zones_full = flags_full >> zshift
@@ -1477,7 +1496,8 @@ def make_pallas_iterate_3d(model: Model, shape, dtype=jnp.float32,
                         [w[:lo], new, w[lo + n_i:]], axis=0)
 
             for k in range(ns):
-                out_ref[k] = work[k][R:R + bz].astype(dtype)
+                out_ref[k] = ddf.narrow_plane(work[k][R:R + bz], dtype,
+                                              _shifts[k])
 
             if g_ref is not None:
                 @pl.when(i == 0)

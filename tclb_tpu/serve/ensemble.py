@@ -128,7 +128,8 @@ class EnsemblePlan:
                  mode: str = "map",
                  storage_dtype: Any = None,
                  grad: Optional[GradSpec] = None,
-                 init_on_run: bool = True):
+                 init_on_run: bool = True,
+                 storage_repr: Optional[str] = None):
         from tclb_tpu.ops.lbm import present_types
         if grad is not None and storage_dtype is not None and \
                 jnp.dtype(storage_dtype) != jnp.dtype(dtype):
@@ -138,13 +139,16 @@ class EnsemblePlan:
         if base is None:
             base = Lattice(model, tuple(int(s) for s in shape), dtype=dtype,
                            settings=base_settings,
-                           storage_dtype=storage_dtype)
+                           storage_dtype=storage_dtype,
+                           storage_repr=storage_repr)
             if flags is not None:
                 base.set_flags(np.asarray(flags, dtype=np.uint16))
         self.model = base.model
         self.shape = base.shape
         self.dtype = base.dtype
         self.storage_dtype = base.storage_dtype
+        self.storage_repr = base.storage_repr
+        self._shift_block = base._shift_block
         self.mode = mode
         self.flags = base._flags_host()
         self.base_state = base.state
@@ -155,18 +159,25 @@ class EnsemblePlan:
         self._init = make_ensemble_step(self.model, "Init", present=None)
         if narrowed:
             # Init evaluates in the compute dtype, the carry lives narrow
-            # (same round trip as Lattice._init's precision-ladder wrap).
+            # (same round trip as Lattice._init's precision-ladder wrap;
+            # the shift block applies the at-rest representation)
             raw_init, sdt = self._init, jnp.dtype(self.storage_dtype)
+            sb = self._shift_block
 
             def _init_narrow(states, params):
+                from tclb_tpu.core import shift as ddf
                 cdt = params.settings.dtype
                 out = raw_init(
-                    states.replace(fields=states.fields.astype(cdt)), params)
-                return out.replace(fields=out.fields.astype(sdt))
+                    states.replace(
+                        fields=ddf.widen_stack(states.fields, cdt, sb)),
+                    params)
+                return out.replace(
+                    fields=ddf.narrow_stack(out.fields, sdt, sb))
             self._init = _init_narrow
         self._iterate = make_ensemble_iterate(
             self.model, present=self.present, mode=mode,
-            storage_dtype=(self.storage_dtype if narrowed else None))
+            storage_dtype=(self.storage_dtype if narrowed else None),
+            storage_shift=self._shift_block)
         self.grad = grad
         # init_on_run=False plans continue from base_state as-is (resume
         # segments): run() skips the Init stage unless told otherwise
@@ -180,7 +191,11 @@ class EnsemblePlan:
             return tag + "]"
         tag = f"ensemble_xla[{self.model.name},{self.mode},b={batch}"
         if jnp.dtype(self.storage_dtype) != jnp.dtype(self.dtype):
-            tag += f",{np.dtype(self.storage_dtype).name}"
+            # dtype AND representation: a raw-bf16 and a shifted-bf16
+            # plan compile DIFFERENT programs (the seam adds), so the
+            # CompiledCache key must split on both
+            tag += (f",{np.dtype(self.storage_dtype).name}"
+                    f"/{self.storage_repr}")
         return tag + "]"
 
     # -- pieces the cache compiles ----------------------------------------- #
@@ -364,7 +379,8 @@ class EnsemblePlan:
         batch stays on its own lane)."""
         case = case if isinstance(case, Case) else Case(settings=dict(case))
         lat = Lattice(self.model, self.shape, dtype=self.dtype,
-                      storage_dtype=self.storage_dtype, device=device)
+                      storage_dtype=self.storage_dtype,
+                      storage_repr=self.storage_repr, device=device)
         lat.set_flags(self.flags.copy())
         lat.params = case_params(self.model, self.base_params, case,
                                  self.dtype)
@@ -395,6 +411,7 @@ def run_ensemble(model: Model, cases: Sequence[Case | dict], niter: int,
                  flags: Optional[np.ndarray] = None,
                  dtype: Any = jnp.float32,
                  storage_dtype: Any = None,
+                 storage_repr: Optional[str] = None,
                  base_settings: Optional[dict[str, float]] = None,
                  base: Optional[Lattice] = None,
                  cache=None, init: bool = True) -> list[EnsembleResult]:
@@ -409,5 +426,6 @@ def run_ensemble(model: Model, cases: Sequence[Case | dict], niter: int,
         raise ValueError("run_ensemble needs `shape` (or `base`)")
     plan = EnsemblePlan(model, shape or (), flags=flags, dtype=dtype,
                         base_settings=base_settings, base=base,
-                        storage_dtype=storage_dtype)
+                        storage_dtype=storage_dtype,
+                        storage_repr=storage_repr)
     return plan.run(cases, niter, cache=cache, init=init)

@@ -601,6 +601,7 @@ def pool_doc_from_spec(spec) -> dict:
             "shape": [int(s) for s in spec.shape],
             "niter": int(spec.niter),
             "dtype": dtype, "storage_dtype": sdt,
+            "storage_repr": getattr(spec, "storage_repr", None),
             "params": dict(spec.base_settings or {}),
             "case": {"name": case.name,
                      "settings": dict(case.settings)},

@@ -62,6 +62,10 @@ class JobSpec:
     # opt-in narrowed storage (e.g. bf16): halves the per-case working
     # set, so the memory-predicated batch cap roughly doubles
     storage_dtype: Any = None
+    # at-rest representation of narrowed storage ("raw"/"shifted");
+    # None resolves to the Lattice default (shifted on a narrowed rung
+    # with a recognized velocity set — the Mach-independent choice)
+    storage_repr: Optional[str] = None
     base_settings: Optional[dict[str, float]] = None
     # a prebuilt plan (e.g. the sweep CLI's XML-derived base, whose zonal
     # base params a plain settings dict cannot express); must describe
@@ -154,8 +158,21 @@ def _bin_key(spec: JobSpec) -> tuple:
             str(jnp.dtype(spec.dtype)),
             str(jnp.dtype(spec.storage_dtype if spec.storage_dtype
                           is not None else spec.dtype)),
+            # at-rest representation: raw and shifted jobs compile to
+            # different programs, so they must never share a dispatch
+            _repr_key(spec),
             flags_digest, int(spec.niter), base, spec.bin_tag,
             None if spec.grad is None else spec.grad.key())
+
+
+def _repr_key(spec: JobSpec) -> str:
+    """Resolved storage representation of this job, for binning.  Uses
+    the same default rule as the Lattice so an explicit ``"shifted"``
+    and a None that resolves to shifted bin together."""
+    from tclb_tpu.core import shift as ddf
+    narrowed = (spec.storage_dtype is not None
+                and jnp.dtype(spec.storage_dtype) != jnp.dtype(spec.dtype))
+    return ddf.resolve_repr(spec.model, narrowed, spec.storage_repr)
 
 
 class Scheduler:
@@ -320,7 +337,8 @@ class Scheduler:
             plan = spec.plan if spec.plan is not None else EnsemblePlan(
                 spec.model, spec.shape, flags=spec.flags, dtype=spec.dtype,
                 base_settings=spec.base_settings,
-                storage_dtype=spec.storage_dtype, grad=spec.grad)
+                storage_dtype=spec.storage_dtype,
+                storage_repr=spec.storage_repr, grad=spec.grad)
             self._plans[key] = plan
         return plan
 

@@ -147,6 +147,7 @@ def _solve(spec: dict, jid: str, lane: int, beat) -> tuple[dict, bytes]:
     niter = int(spec["niter"])
 
     lat = Lattice(model, shape, dtype=dtype, storage_dtype=sdt,
+                  storage_repr=spec.get("storage_repr"),
                   settings=settings or None)
     mgr = None
     resumed_from: Optional[int] = None

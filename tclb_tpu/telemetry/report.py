@@ -287,9 +287,12 @@ def summarize(evts: list[dict]) -> dict:
                     "chunks": 0, "iters": 0, "node_updates": 0.0,
                     "total_s": 0.0, "vs_roofline": None,
                     "roofline_known": e.get("roofline_known"),
-                    "storage_dtype": e.get("storage_dtype")})
+                    "storage_dtype": e.get("storage_dtype"),
+                    "storage_repr": e.get("storage_repr")})
                 if e.get("storage_dtype") is not None:
                     g["storage_dtype"] = e["storage_dtype"]
+                if e.get("storage_repr") is not None:
+                    g["storage_repr"] = e["storage_repr"]
                 g["chunks"] += 1
                 g["iters"] += int(e.get("iters", 0))
                 g["node_updates"] += (float(e.get("nodes", 0.0))
@@ -370,7 +373,16 @@ def compare(base: dict, other: dict, threshold: float = 0.05) -> dict:
                      "other_mlups": b and b.get("mlups"),
                      "base_vs_roofline": a and a.get("vs_roofline"),
                      "other_vs_roofline": b and b.get("vs_roofline")}
-        if a and b and a.get("mlups") and b.get("mlups"):
+        if a and b and (a.get("storage_repr") or "raw") \
+                != (b.get("storage_repr") or "raw"):
+            # a storage-representation switch is a different compiled
+            # program — like an engine change, it is a note, never a
+            # throughput regression
+            row["note"] = (
+                f"storage repr changed "
+                f"({a.get('storage_repr') or 'raw'} -> "
+                f"{b.get('storage_repr') or 'raw'}) — not comparable")
+        elif a and b and a.get("mlups") and b.get("mlups"):
             delta = (b["mlups"] - a["mlups"]) / a["mlups"]
             row["mlups_delta_pct"] = round(100 * delta, 2)
             if delta < -threshold:
@@ -575,13 +587,19 @@ def format_text(summary: dict) -> str:
     lines = []
     if summary["engines"]:
         lines.append("per-engine iterate summary")
-        lines.append(f"  {'engine':<44} {'dtype':>9} {'chunks':>6} "
+        lines.append(f"  {'engine':<44} {'storage':>17} {'chunks':>6} "
                      f"{'iters':>9} {'time_s':>10} {'MLUPS':>10} "
                      f"{'vs_roofline':>12}")
         for eng, g in sorted(summary["engines"].items()):
             star = "" if g.get("roofline_known", True) else "~"
+            sdt = g.get("storage_dtype")
+            # dtype/repr: the at-rest layout in one cell (repr only
+            # matters on a narrowed rung, where it names the encoding)
+            storage = "-" if sdt is None else (
+                f"{sdt}/{g['storage_repr']}" if g.get("storage_repr")
+                else str(sdt))
             lines.append(
-                f"  {eng:<44} {_fmt(g.get('storage_dtype')):>9} "
+                f"  {eng:<44} {storage:>17} "
                 f"{g['chunks']:>6} {g['iters']:>9} "
                 f"{_fmt(g['total_s'], 3):>10} {_fmt(g['mlups'], 1):>10} "
                 f"{star + _fmt(g['vs_roofline'], 4):>12}")

@@ -502,6 +502,52 @@ def test_precision_skips_f32_only_engines(tmp_path):
     assert scan_unsafe_accum(paths=[str(p)]) == []
 
 
+def test_unshifted_cast_fires_on_bare_astype_seams(tmp_path):
+    """A narrowed-capable kernel casting field planes with a bare
+    .astype bypasses the shared DDF-shift helpers: the widen would read
+    the stored deviation f_i - w_i as if it were f_i (and the narrow
+    would store an unshifted plane into a shifted stack) — silent wrong
+    physics the unshifted_cast check makes static."""
+    from tclb_tpu.analysis.precision import scan_unshifted_cast
+    p = tmp_path / "pallas_bad_cast.py"
+    p.write_text(_BF16_KERNEL_HEADER +
+                 "def kernel(scrf, out_ref, cdtype, dtype):\n"
+                 "    work = [scrf[0, k].astype(cdtype)"
+                 " for k in range(9)]\n"
+                 "    out_ref[0] = work[0].astype(dtype)\n")
+    fs = scan_unshifted_cast(paths=[str(p)])
+    assert [f.check for f in fs] == ["precision.unshifted_cast"] * 2
+    assert all(f.severity == "error" for f in fs)
+
+
+def test_unshifted_cast_accepts_helper_seams(tmp_path):
+    from tclb_tpu.analysis.precision import scan_unshifted_cast
+    p = tmp_path / "pallas_good_cast.py"
+    p.write_text(_BF16_KERNEL_HEADER +
+                 "from tclb_tpu.core import shift as ddf\n"
+                 "def kernel(scrf, out_ref, cdtype, dtype, w):\n"
+                 "    work = [ddf.widen_plane(scrf[0, k], cdtype, w)"
+                 " for k in range(9)]\n"
+                 "    out_ref[0] = ddf.narrow_plane(work[0], dtype, w)\n")
+    assert scan_unshifted_cast(paths=[str(p)]) == []
+
+
+def test_unshifted_cast_skips_f32_only_engines(tmp_path):
+    from tclb_tpu.analysis.precision import scan_unshifted_cast
+    p = tmp_path / "pallas_f32_cast.py"
+    p.write_text("import jax.numpy as jnp\n"
+                 "def kernel(scrf, out_ref):\n"
+                 "    out_ref[0] = scrf[0].astype(jnp.float32)\n")
+    assert scan_unshifted_cast(paths=[str(p)]) == []
+
+
+def test_unshifted_cast_clean_on_repo():
+    """The real engine modules route every field-plane cast through the
+    shared helpers (this is the check_repo wiring the CI gate runs)."""
+    from tclb_tpu.analysis.precision import scan_unshifted_cast
+    assert scan_unshifted_cast() == []
+
+
 def test_hygiene_fires_on_unpoliced_retry(tmp_path):
     bad = tmp_path / "worker.py"
     bad.write_text(

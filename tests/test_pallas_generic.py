@@ -511,8 +511,11 @@ def test_storage_dtype_bf16_xla_close_to_f32():
     ref = run(None)
     alt = run(jnp.bfloat16)
     assert alt.state.fields.dtype == jnp.dtype(jnp.bfloat16)
-    a = np.asarray(alt.state.fields, dtype=np.float32)
-    b = np.asarray(ref.state.fields)
+    # compare in the raw representation: the bf16 rung defaults to
+    # shifted at-rest storage (f_i - w_i), so the raw stacks are the
+    # representation-independent physics
+    a = alt.fields_raw()
+    b = ref.fields_raw()
     assert np.isfinite(a).all()
     denom = max(float(np.max(np.abs(b))), 1e-30)
     assert float(np.max(np.abs(a - b))) / denom < 2e-2

@@ -17,17 +17,34 @@ from tclb_tpu import precision
 
 @pytest.mark.parametrize("case", precision.CASE_NAMES)
 def test_bf16_error_within_documented_bounds(case):
-    rep = precision.error_norms(case, niter=500, n=64,
-                                storage_dtype="bfloat16")
-    assert [r["iteration"] for r in rep["checkpoints"]] == [100, 250, 500]
-    violations = precision.check_bounds(rep)
-    assert violations == [], violations
-    # the harness must be measuring something: identical runs would
-    # mean the narrowing silently didn't happen
-    assert all(r["l2"] > 0 for r in rep["checkpoints"])
-    # the informational velocity norms ride every row (the honest
-    # bf16-tolerance signal for low-Mach cases — see README)
-    assert all(r["u_linf"] > 0 for r in rep["checkpoints"])
+    """Both storage representations of each case stay inside their
+    documented bounds — off one shared f32 reference run."""
+    raw, shifted = precision.compare_reprs(case, niter=500, n=64,
+                                           storage_dtype="bfloat16")
+    for rep in (raw, shifted):
+        assert [r["iteration"] for r in rep["checkpoints"]] \
+            == [100, 250, 500]
+        violations = precision.check_bounds(rep)
+        assert violations == [], violations
+        # the harness must be measuring something: identical runs would
+        # mean the narrowing silently didn't happen
+        assert all(r["l2"] > 0 for r in rep["checkpoints"])
+        # the informational velocity norms ride every row (the honest
+        # bf16-tolerance signal for low-Mach cases — see README)
+        assert all(r["u_linf"] > 0 for r in rep["checkpoints"])
+    if case == "cavity":
+        # the DDF-shifting headline: on the Ma~0.02 cavity the shifted
+        # rung's velocity error is at least 10x below raw at every
+        # checkpoint (measured ~40x) — Mach-independent narrow storage
+        for rr, rs in zip(raw["checkpoints"], shifted["checkpoints"]):
+            assert rs["u_linf"] <= rr["u_linf"] / 10, (rr, rs)
+    else:
+        # O(1)-signal cases pay at most a bounded early transient for
+        # the default flip (kuper's spurious-current u_linf runs ~12x
+        # raw at iter 100, back to ~4x by 500) — the hard contract is
+        # the field bounds above; this guards against a blowup
+        for rr, rs in zip(raw["checkpoints"], shifted["checkpoints"]):
+            assert rs["u_linf"] <= 20 * rr["u_linf"], (rr, rs)
 
 
 def test_check_bounds_flags_violations():
@@ -58,3 +75,14 @@ def test_cli_json_smoke(capsys):
     assert rc == 0
     assert out["violations"] == []
     assert out["reports"][0]["case"] == "cavity"
+    # --repr defaults to 'both': one report per representation
+    assert [r["storage_repr"] for r in out["reports"]] \
+        == ["raw", "shifted"]
+
+
+def test_cli_single_repr(capsys):
+    rc = precision.main(["--case", "cavity", "--niter", "50",
+                         "--repr", "shifted", "--format", "json"])
+    out = json.loads(capsys.readouterr().out)
+    assert rc == 0
+    assert [r["storage_repr"] for r in out["reports"]] == ["shifted"]

@@ -1,0 +1,314 @@
+"""DDF-shifted storage representation (core/shift.py + its seams).
+
+The shifted representation stores the deviation ``f_i - w_i`` at rest so
+bf16's 8-bit mantissa goes to the signal instead of the O(1)
+rest-equilibrium background.  These tests pin the contract edges:
+
+* weight recognition derives the standard D2Q9/D3Q19/D3Q27 tables from
+  ``Model.ei`` and refuses everything else (fields can never shift);
+* representation resolution: shifted is the *default* narrow rung, the
+  full-width f32 path stays raw (and bit-identical — the raw seams are
+  pure ``astype``, no ``+ 0.0`` is ever traced);
+* checkpoints stamp ``storage`` (dtype + repr) and restore *converts*
+  across representations bit-faithfully rather than refusing, while an
+  unknown repr stamp fails ``latest()``/restore with a structured error
+  instead of silently falling back to a stale checkpoint;
+* serving keys (ensemble ``engine_tag``, scheduler ``_bin_key``) split
+  on the representation — a raw-bf16 and a shifted-bf16 plan compile
+  different programs and must never share a cache entry or a batch.
+"""
+
+import json
+import os
+
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from tclb_tpu import checkpoint as ckpt
+from tclb_tpu.checkpoint import manifest as mf
+from tclb_tpu.checkpoint import restore as rst
+from tclb_tpu.checkpoint.manager import CheckpointManager
+from tclb_tpu.core import shift as ddf
+from tclb_tpu.core.lattice import Lattice
+from tclb_tpu.models import get_model
+
+
+def _cavity(model="d2q9", n=16, **kw):
+    m = get_model(model)
+    lat = Lattice(m, (n,) * m.ndim, dtype=jnp.float32,
+                  settings={"nu": 0.05, "Velocity": 0.02}, **kw)
+    flags = np.full((n,) * m.ndim, m.flag_for("MRT"), dtype=np.uint16)
+    flags[0] = flags[-1] = m.flag_for("Wall")
+    lat.set_flags(flags)
+    lat.init()
+    return lat
+
+
+# --------------------------------------------------------------------------- #
+# Weight recognition / shift derivation
+# --------------------------------------------------------------------------- #
+
+
+@pytest.mark.parametrize("name,q,w0", [
+    ("d2q9", 9, 4.0 / 9.0),
+    ("d3q19", 19, 1.0 / 3.0),
+    ("d3q27", 27, 8.0 / 27.0),
+])
+def test_storage_shift_recognizes_standard_sets(name, q, w0):
+    m = get_model(name)
+    vec = ddf.storage_shift(m)
+    assert vec.shape == (m.n_storage,)
+    dens = vec[vec > 0]
+    # every standard set: q weights summing to 1, rest plane = w0
+    assert len(dens) % q == 0 and len(dens) >= q
+    np.testing.assert_allclose(dens[:q].sum(), 1.0, rtol=1e-12)
+    assert float(vec.max()) == pytest.approx(w0)
+    # non-density planes (fields, averaged copies) never shift
+    n_dens = len(m.densities)
+    assert not np.any(vec[n_dens:])
+
+
+def test_group_weights_rejects_nonstandard_groups():
+    # all-zero offsets (how field groups appear in Model.ei): the ring
+    # counts cannot match a velocity set
+    assert ddf.group_weights(np.zeros((9, 3), dtype=np.int64)) is None
+    # right member count, wrong rings
+    assert ddf.group_weights(np.ones((9, 3), dtype=np.int64)) is None
+    # non-unit offsets are never a standard set
+    ei = np.zeros((9, 3), dtype=np.int64)
+    ei[1, 0] = 2
+    assert ddf.group_weights(ei) is None
+
+
+def test_repr_resolution_defaults_and_refusals():
+    m = get_model("d2q9")
+    assert ddf.resolve_repr(m, False, None) == "raw"
+    assert ddf.resolve_repr(m, True, None) == "shifted"
+    assert ddf.resolve_repr(m, True, "raw") == "raw"
+    with pytest.raises(ValueError, match="narrowed"):
+        ddf.resolve_repr(m, False, "shifted")
+    with pytest.raises(ValueError, match="must be one of"):
+        ddf.resolve_repr(m, True, "hyperbolic")
+
+
+def test_lattice_repr_resolution():
+    assert _cavity().storage_repr == "raw"
+    assert _cavity(storage_dtype=jnp.bfloat16).storage_repr == "shifted"
+    assert _cavity(storage_dtype=jnp.bfloat16,
+                   storage_repr="raw").storage_repr == "raw"
+    with pytest.raises(ValueError, match="narrowed"):
+        _cavity(storage_repr="shifted")
+
+
+def test_raw_seams_are_pure_casts():
+    """shift=None must never trace ``+ 0.0``: ``-0.0 + 0.0 == +0.0``
+    would silently break the f32 path's bit-identity contract."""
+    x = jnp.asarray([-0.0, 1.5], dtype=jnp.float32)
+    y = ddf.widen_plane(x, jnp.float32, None)
+    np.testing.assert_array_equal(
+        np.asarray(y).view(np.uint32), np.asarray(x).view(np.uint32))
+    z = ddf.narrow_plane(x, jnp.float32, None)
+    np.testing.assert_array_equal(
+        np.asarray(z).view(np.uint32), np.asarray(x).view(np.uint32))
+
+
+def test_shifted_at_rest_layout_and_physics():
+    """At rest the shifted lattice stores deviations (small numbers);
+    both representations describe the same physics through the raw
+    accessors."""
+    raw = _cavity(storage_dtype=jnp.bfloat16, storage_repr="raw")
+    sh = _cavity(storage_dtype=jnp.bfloat16, storage_repr="shifted")
+    vec = ddf.storage_shift(raw.model)
+    dens = vec > 0
+    # raw at-rest planes carry the O(1) background, shifted ones don't
+    raw_f = np.asarray(raw.state.fields, dtype=np.float64)
+    sh_f = np.asarray(sh.state.fields, dtype=np.float64)
+    assert np.max(np.abs(raw_f[dens])) > 0.1
+    assert np.max(np.abs(sh_f[dens])) < 0.1
+    # same physics once un-shifted
+    np.testing.assert_allclose(raw.fields_raw(), sh.fields_raw(),
+                               atol=1e-2)
+    # quantities come out in raw physics units on both representations
+    np.testing.assert_allclose(np.asarray(sh.get_quantity("Rho")),
+                               np.asarray(raw.get_quantity("Rho")),
+                               atol=2e-2)
+    np.testing.assert_allclose(np.asarray(sh.get_quantity("Rho")),
+                               1.0, atol=5e-2)
+
+
+def test_shifted_iteration_tracks_raw_reference():
+    """A short shifted-bf16 run stays close to the f32 reference — and
+    much closer than raw-bf16 on the velocity field (the ladder's
+    reason to flip the default)."""
+    ref = _cavity(n=32)
+    raw = _cavity(n=32, storage_dtype=jnp.bfloat16, storage_repr="raw")
+    sh = _cavity(n=32, storage_dtype=jnp.bfloat16,
+                 storage_repr="shifted")
+    for lat in (ref, raw, sh):
+        lat.iterate(40)
+    u = np.asarray(ref.get_quantity("U"), dtype=np.float64)
+    du_raw = np.max(np.abs(
+        np.asarray(raw.get_quantity("U"), dtype=np.float64) - u))
+    du_sh = np.max(np.abs(
+        np.asarray(sh.get_quantity("U"), dtype=np.float64) - u))
+    assert du_sh <= du_raw / 10
+
+
+# --------------------------------------------------------------------------- #
+# Checkpoint stamping + cross-representation restore
+# --------------------------------------------------------------------------- #
+
+
+def test_npy_safe_roundtrips_bfloat16():
+    import ml_dtypes
+    a = np.arange(-8, 8, dtype=np.float32).astype(ml_dtypes.bfloat16)
+    packed = rst.npy_safe(a)
+    assert packed.dtype == np.uint16
+    back = rst.npy_restore(packed, "bfloat16")
+    np.testing.assert_array_equal(back.view(np.uint16),
+                                  a.view(np.uint16))
+    # f32 arrays pass through untouched
+    b = np.ones(3, dtype=np.float32)
+    assert rst.npy_safe(b) is b
+    assert rst.npy_restore(b, "float32") is b
+
+
+def test_checkpoint_stamps_storage_and_restores_across_reprs(tmp_path):
+    sh = _cavity(storage_dtype=jnp.bfloat16)
+    sh.iterate(12)
+    d1 = str(tmp_path / "shifted")
+    ckpt.save_checkpoint(d1, sh)
+    man = mf.read_manifest(d1)
+    assert man["storage"] == {"dtype": "bfloat16", "repr": "shifted"}
+    assert rst.storage_layout(man) == ("bfloat16", "shifted")
+
+    # shifted-bf16 -> raw-f32 lattice: restore CONVERTS, not refuses
+    wide = _cavity()
+    ckpt.restore_lattice(wide, d1)
+    assert int(np.asarray(wide.state.iteration)) == 12
+    np.testing.assert_allclose(wide.fields_raw(), sh.fields_raw(),
+                               atol=1e-6)
+
+    # ... and back onto a shifted-bf16 lattice bit-faithfully: f64
+    # conversion arithmetic preserves every representable deviation
+    d2 = str(tmp_path / "wide")
+    ckpt.save_checkpoint(d2, wide)
+    assert mf.read_manifest(d2)["storage"] == {"dtype": "float32",
+                                               "repr": "raw"}
+    sh2 = _cavity(storage_dtype=jnp.bfloat16)
+    ckpt.restore_lattice(sh2, d2)
+    np.testing.assert_array_equal(
+        np.asarray(sh2.state.fields).view(np.uint16),
+        np.asarray(sh.state.fields).view(np.uint16))
+
+
+def test_same_repr_restore_is_bit_exact_at_rest(tmp_path):
+    sh = _cavity(storage_dtype=jnp.bfloat16)
+    sh.iterate(8)
+    d = str(tmp_path / "ck")
+    ckpt.save_checkpoint(d, sh)
+    sh2 = _cavity(storage_dtype=jnp.bfloat16)
+    ckpt.restore_lattice(sh2, d)
+    np.testing.assert_array_equal(
+        np.asarray(sh2.state.fields).view(np.uint16),
+        np.asarray(sh.state.fields).view(np.uint16))
+    # restored lattices keep computing identically
+    sh.iterate(8)
+    sh2.iterate(8)
+    np.testing.assert_array_equal(
+        np.asarray(sh2.state.fields).view(np.uint16),
+        np.asarray(sh.state.fields).view(np.uint16))
+
+
+def test_legacy_npz_roundtrip_across_reprs(tmp_path):
+    sh = _cavity(storage_dtype=jnp.bfloat16)
+    sh.iterate(6)
+    p = str(tmp_path / "state.npz")
+    sh.save(p)
+    same = _cavity(storage_dtype=jnp.bfloat16)
+    same.load(p)
+    np.testing.assert_array_equal(
+        np.asarray(same.state.fields).view(np.uint16),
+        np.asarray(sh.state.fields).view(np.uint16))
+    wide = _cavity()
+    wide.load(p)
+    np.testing.assert_allclose(wide.fields_raw(), sh.fields_raw(),
+                               atol=1e-6)
+
+
+def test_unknown_repr_is_a_structured_error(tmp_path):
+    lat = _cavity(storage_dtype=jnp.bfloat16)
+    lat.iterate(4)
+    mgr = CheckpointManager(str(tmp_path / "root"), keep_last=3,
+                            async_saves=False)
+    path = mgr.save(lat, step=4)
+    man = mf.read_manifest(path)
+    man["storage"]["repr"] = "hyperbolic"
+    with open(os.path.join(path, "manifest.json"), "w") as fh:
+        json.dump(man, fh)
+
+    # the checkpoint is intact — latest() must NOT fall back past it
+    with pytest.raises(mf.CheckpointError) as ei:
+        mgr.latest()
+    assert ei.value.kind == "storage_repr"
+    with pytest.raises(mf.CheckpointError) as ei:
+        ckpt.restore_lattice(_cavity(storage_dtype=jnp.bfloat16),
+                             str(path))
+    assert ei.value.kind == "storage_repr"
+
+
+def test_pre_stamp_manifest_reads_as_raw():
+    man = {"dtype": "float32"}
+    assert rst.storage_layout(man) == ("float32", "raw")
+
+
+# --------------------------------------------------------------------------- #
+# Serving keys split on representation
+# --------------------------------------------------------------------------- #
+
+
+def test_engine_tag_and_bin_key_split_on_repr():
+    from tclb_tpu.serve.ensemble import Case, EnsemblePlan
+    from tclb_tpu.serve.scheduler import JobSpec, _bin_key
+    m = get_model("d2q9")
+    flags = np.full((16, 16), m.flag_for("MRT"), dtype=np.uint16)
+    base = dict(flags=flags, base_settings={"nu": 0.05})
+    f32 = EnsemblePlan(m, (16, 16), **base)
+    raw = EnsemblePlan(m, (16, 16), storage_dtype=jnp.bfloat16,
+                       storage_repr="raw", **base)
+    sh = EnsemblePlan(m, (16, 16), storage_dtype=jnp.bfloat16, **base)
+    assert sh.storage_repr == "shifted"
+    tags = {p.engine_tag(4) for p in (f32, raw, sh)}
+    assert len(tags) == 3
+    assert "bfloat16/shifted" in sh.engine_tag(4)
+    assert "/" not in f32.engine_tag(4).split("[")[1]
+
+    def spec(**kw):
+        return JobSpec(model=m, shape=(16, 16), case=Case(name="c"),
+                       niter=5, **kw)
+    k_f32 = _bin_key(spec())
+    k_raw = _bin_key(spec(storage_dtype=jnp.bfloat16,
+                          storage_repr="raw"))
+    k_def = _bin_key(spec(storage_dtype=jnp.bfloat16))
+    k_sh = _bin_key(spec(storage_dtype=jnp.bfloat16,
+                         storage_repr="shifted"))
+    assert k_def == k_sh            # None resolves to the default
+    assert len({k_f32, k_raw, k_sh}) == 3
+
+
+def test_gateway_validates_storage_repr():
+    from tclb_tpu.gateway import jobs as gj
+    body = {"model": "d2q9", "shape": [16, 16], "niter": 5}
+    gj.validate_body(dict(body, storage_dtype="bf16",
+                          storage_repr="shifted"))
+    gj.validate_body(dict(body, storage_dtype="bf16",
+                          storage_repr="raw"))
+    with pytest.raises(gj.ValidationError, match="must be one of"):
+        gj.validate_body(dict(body, storage_dtype="bf16",
+                              storage_repr="hyperbolic"))
+    with pytest.raises(gj.ValidationError, match="narrowed"):
+        gj.validate_body(dict(body, storage_repr="shifted"))
+    with pytest.raises(gj.ValidationError, match="narrowed"):
+        gj.validate_body(dict(body, storage_dtype="f32",
+                              storage_repr="shifted"))

@@ -10,7 +10,36 @@ from __future__ import annotations
 
 import argparse
 import json
+import os
+import subprocess
 import sys
+
+
+def _changed_files() -> set:
+    """Repo-relative paths changed vs HEAD (staged + unstaged), for
+    ``--changed`` pre-commit filtering.  Empty set when git is absent
+    or this is not a work tree (the filter then hides everything, which
+    a pre-commit hook on a pristine tree should)."""
+    from tclb_tpu.analysis.hygiene import _REPO_ROOT
+    try:
+        out = subprocess.run(
+            ["git", "diff", "--name-only", "HEAD"],
+            cwd=_REPO_ROOT, capture_output=True, text=True, timeout=30)
+    except (OSError, subprocess.TimeoutExpired):
+        return set()
+    if out.returncode != 0:
+        return set()
+    return {line.strip() for line in out.stdout.splitlines()
+            if line.strip()}
+
+
+def _check_selected(check: str, wanted) -> bool:
+    """True when ``check`` matches a ``--check`` entry — exact id, or a
+    family prefix (``concurrency`` selects every ``concurrency.*``)."""
+    for w in wanted:
+        if check == w or check.startswith(w + "."):
+            return True
+    return False
 
 
 def _parse_shape(text):
@@ -47,9 +76,27 @@ def main(argv=None) -> int:
                    default="info",
                    help="hide findings below this severity in the output "
                         "(the exit code always reflects errors)")
+    p.add_argument("--check", default=None, metavar="NAME[,NAME...]",
+                   help="only run/report these checks — exact ids "
+                        "(concurrency.lock_order_cycle) or families "
+                        "(concurrency); exit code reflects the filtered "
+                        "set")
+    p.add_argument("--changed", action="store_true",
+                   help="only report findings located in files changed "
+                        "vs HEAD (fast pre-commit mode; model-level "
+                        "findings without a file locator are hidden)")
     args = p.parse_args(argv)
 
-    if not args.all and not args.models:
+    wanted = None
+    if args.check:
+        wanted = [w.strip() for w in args.check.split(",") if w.strip()]
+        if not wanted:
+            print("error: --check needs at least one name",
+                  file=sys.stderr)
+            return 2
+
+    # --check/--changed alone mean "run the repo gate" (pre-commit use)
+    if not args.all and not args.models and not (wanted or args.changed):
         p.print_usage(sys.stderr)
         print("error: give model names or --all", file=sys.stderr)
         return 2
@@ -61,8 +108,31 @@ def main(argv=None) -> int:
         return 2
 
     names = sorted(known) if args.all else args.models
+    # a pure-concurrency --check never produces model findings: skip the
+    # per-model analysis entirely (this is the fast pre-commit path)
+    if wanted is not None and all(
+            w == "concurrency" or w.startswith("concurrency.")
+            for w in wanted):
+        names = []
     per_model = {n: analysis.analyze_model(n, args.shape) for n in names}
-    repo = analysis.analyze_repo() if args.all else []
+    run_repo = args.all or (not args.models and (wanted is not None
+                                                 or args.changed))
+    repo = analysis.analyze_repo() if run_repo else []
+
+    if wanted is not None:
+        per_model = {n: [f for f in fs if _check_selected(f.check, wanted)]
+                     for n, fs in per_model.items()}
+        repo = [f for f in repo if _check_selected(f.check, wanted)]
+    if args.changed:
+        changed = _changed_files()
+
+        def in_changed(f):
+            path = f.where.split(":")[0].replace(os.sep, "/")
+            return path in changed
+
+        per_model = {n: [f for f in fs if in_changed(f)]
+                     for n, fs in per_model.items()}
+        repo = [f for f in repo if in_changed(f)]
 
     everything = repo + [f for fs in per_model.values() for f in fs]
     n_err = sum(f.severity == "error" for f in everything)

@@ -1,0 +1,1141 @@
+"""Whole-program lock-discipline analysis of the serving planes.
+
+PRs 10-13 made the solver a multi-threaded serving product: gateway
+handler threads, the scheduler worker, per-lane stager/exec threads,
+pool supervisors, drain hooks and the SIGTERM handler all share state
+behind explicit ``threading`` locks.  Because every thread entry point
+and every lock is visible in the AST, a RacerX-style lock-discipline
+pass (Engler & Ashcraft, SOSP 2003) is tractable — this module is that
+pass, and the CI gate runs it on every commit:
+
+* ``concurrency.unguarded_shared_state`` — an instance attribute of a
+  thread-spawning class is reachable from two thread entry points and
+  written outside any lock.  Intentional lock-free patterns (the
+  telemetry single-boolean gate) carry a per-site waiver.
+* ``concurrency.lock_order_cycle`` — the cross-module lock-order graph
+  (lock B acquired while A is held, including through resolved calls)
+  has a cycle: two threads taking the edges in opposite order deadlock.
+* ``concurrency.blocking_under_lock`` — sleep / fsync / ``device_put``
+  / pipe IPC / subprocess-wait / thread-join executed while a lock is
+  held: every other thread contending on that lock inherits the stall.
+* ``concurrency.signal_unsafe`` — a signal handler (or drain hook,
+  which runs on the signal-handling main thread) acquires a
+  non-reentrant lock or performs IO within two calls of the handler: if
+  the interrupted main thread holds that lock, the process self-
+  deadlocks mid-shutdown.
+
+**Waiver syntax** (all four checks): a comment on the flagged line (or
+the line directly above) of the form::
+
+    # concurrency-ok[TAG]: justification
+
+with TAG one of ``unguarded``, ``lock-order``, ``blocking``, ``signal``
+(comma-separate to waive several checks at one site).  A waiver without
+a justification does not count.
+
+Scope and soundness: the pass resolves ``self.method()`` calls, module
+functions, imported ``module.fn`` references, and attributes/locals
+whose class is statically known (``x = ClassName(...)`` or an annotated
+``__init__`` parameter).  Dynamic dispatch (callbacks, subscriber
+fan-outs) is out of scope; mutation through container methods
+(``list.append``) is not treated as a write.  The runtime half —
+:mod:`tclb_tpu.telemetry.locks` under ``TCLB_LOCK_DEBUG=1`` — covers
+what the static pass cannot see.
+"""
+
+from __future__ import annotations
+
+import ast
+import os
+import re
+from typing import Optional
+
+from tclb_tpu.analysis.findings import Finding
+from tclb_tpu.analysis.hygiene import (_REPO_ROOT, _module_name, _py_files,
+                                       _resolve_from)
+
+_PKG_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+#: package subtrees (plus single files) the serving-plane analysis walks
+_DEFAULT_DIRS = ("serve", "gateway", "telemetry", "checkpoint")
+_DEFAULT_FILES = ("faults.py",)
+
+_WAIVER_RE = re.compile(
+    r"#\s*concurrency-ok\[([a-z, -]+)\]\s*:\s*(\S.*)")
+
+_LOCK_CTORS = {"Lock": "lock", "RLock": "rlock", "Condition": "condition"}
+_MAKE_CTORS = {"make_lock": "lock", "make_rlock": "rlock"}
+
+#: http.server request-handler entry points (each runs on its own
+#: ThreadingHTTPServer thread)
+_HTTP_HANDLERS = ("do_GET", "do_POST", "do_PUT", "do_DELETE", "do_PATCH")
+
+
+def _default_paths() -> list:
+    out = []
+    for d in _DEFAULT_DIRS:
+        p = os.path.join(_PKG_ROOT, d)
+        if os.path.isdir(p):
+            out += _py_files(p)
+    for f in _DEFAULT_FILES:
+        p = os.path.join(_PKG_ROOT, f)
+        if os.path.isfile(p):
+            out.append(p)
+    return sorted(out)
+
+
+def _short(mod: str) -> str:
+    return mod[len("tclb_tpu."):] if mod.startswith("tclb_tpu.") else mod
+
+
+def _rel(path: str) -> str:
+    ap = os.path.abspath(path)
+    if ap.startswith(_REPO_ROOT + os.sep):
+        return os.path.relpath(ap, _REPO_ROOT)
+    return os.path.basename(ap)
+
+
+# --------------------------------------------------------------------------- #
+# model
+# --------------------------------------------------------------------------- #
+
+
+class _Fn:
+    """Everything the checks need about one function/method body."""
+
+    __slots__ = ("module", "qualname", "cls", "path", "lineno",
+                 "acquires", "edges", "blocking", "calls",
+                 "writes", "reads", "self_calls")
+
+    def __init__(self, module, qualname, cls, path, lineno):
+        self.module = module
+        self.qualname = qualname
+        self.cls = cls                  # enclosing class name or None
+        self.path = path
+        self.lineno = lineno
+        self.acquires = []              # (lock_id, lineno)
+        self.edges = []                 # (held_id, lock_id, lineno)
+        self.blocking = []              # (desc, lineno, tuple(held))
+        self.calls = []                 # ((module, qualname), lineno, held)
+        self.writes = []                # (attr, lineno, tuple(held))
+        self.reads = []                 # (attr, lineno)
+        self.self_calls = set()         # method names called on self
+
+
+class _Module:
+    __slots__ = ("name", "short", "path", "tree", "lines", "waivers",
+                 "imports", "mod_locks", "classes", "functions",
+                 "var_types")
+
+    def __init__(self, name, path, tree, lines):
+        self.name = name
+        self.short = _short(name)
+        self.path = path
+        self.tree = tree
+        self.lines = lines
+        self.waivers = _collect_waivers(lines)
+        self.imports = {}               # alias -> "module" or "module:attr"
+        self.mod_locks = {}             # name -> kind
+        self.classes = {}               # ClassName -> _Class
+        self.functions = {}             # qualname -> ast node
+        self.var_types = {}             # module-level var -> (mod, Class)
+
+
+class _Class:
+    __slots__ = ("name", "locks", "aliases", "attr_types", "methods",
+                 "spawns_threads", "thread_targets")
+
+    def __init__(self, name):
+        self.name = name
+        self.locks = {}                 # attr -> kind
+        self.aliases = {}               # attr -> attr (Condition -> its lock)
+        self.attr_types = {}            # attr -> (module, ClassName)
+        self.methods = {}               # qualname suffix -> ast node
+        self.spawns_threads = False
+        self.thread_targets = set()     # method names run on spawned threads
+
+
+class _Program:
+    __slots__ = ("modules", "functions", "findings", "thread_entries",
+                 "signal_entries", "lock_kinds")
+
+    def __init__(self):
+        self.modules = {}               # module name -> _Module
+        self.functions = {}             # (module, qualname) -> _Fn
+        self.findings = []              # parse errors
+        self.thread_entries = set()     # (module, qualname)
+        self.signal_entries = set()     # (module, qualname)
+        self.lock_kinds = {}            # lock_id -> "lock"|"rlock"|"condition"
+
+
+def _collect_waivers(lines) -> dict:
+    out = {}
+    for i, line in enumerate(lines, 1):
+        m = _WAIVER_RE.search(line)
+        if m:
+            tags = {t.strip() for t in m.group(1).split(",") if t.strip()}
+            out[i] = tags
+    return out
+
+
+def _waived(mod: _Module, lineno: int, tag: str) -> bool:
+    """A waiver applies to its own line (trailing comment) or anywhere
+    in the contiguous comment block directly above the site — so the
+    justification may take several lines."""
+    if tag in mod.waivers.get(lineno, ()):
+        return True
+    i = lineno - 1
+    while i >= 1 and i <= len(mod.lines):
+        line = mod.lines[i - 1].strip()
+        if not line.startswith("#"):
+            break
+        if tag in mod.waivers.get(i, ()):
+            return True
+        i -= 1
+    return False
+
+
+def _call_root(func) -> Optional[str]:
+    """Terminal attribute/name of a call's func expression."""
+    if isinstance(func, ast.Name):
+        return func.id
+    if isinstance(func, ast.Attribute):
+        return func.attr
+    return None
+
+
+def _is_lock_ctor(mod: _Module, call: ast.Call) -> Optional[str]:
+    """The lock kind a constructor call produces, or None."""
+    f = call.func
+    if isinstance(f, ast.Attribute) and isinstance(f.value, ast.Name):
+        if f.value.id == "threading" and f.attr in _LOCK_CTORS:
+            return _LOCK_CTORS[f.attr]
+        if f.value.id == "locks" and f.attr in _MAKE_CTORS:
+            return _MAKE_CTORS[f.attr]
+    if isinstance(f, ast.Name):
+        tgt = mod.imports.get(f.id, "")
+        if tgt.endswith(":" + f.id) or tgt == "":
+            if f.id in _LOCK_CTORS and "threading:" in tgt + ":":
+                pass
+        if f.id in _LOCK_CTORS and \
+                mod.imports.get(f.id, "").split(":")[-1] == f.id:
+            return _LOCK_CTORS[f.id]
+        if f.id in _MAKE_CTORS and \
+                mod.imports.get(f.id, "").split(":")[-1] == f.id:
+            return _MAKE_CTORS[f.id]
+    return None
+
+
+def _cond_lock_arg(call: ast.Call) -> Optional[ast.expr]:
+    """The lock argument of a ``Condition(lock)`` constructor call."""
+    root = _call_root(call.func)
+    if root == "Condition" and call.args:
+        return call.args[0]
+    return None
+
+
+# --------------------------------------------------------------------------- #
+# pass 1: structure
+# --------------------------------------------------------------------------- #
+
+
+def _load(paths) -> _Program:
+    prog = _Program()
+    for path in paths:
+        try:
+            with open(path) as fh:
+                src = fh.read()
+            tree = ast.parse(src)
+        except (OSError, SyntaxError) as e:
+            prog.findings.append(Finding(
+                "concurrency.unparseable", "error", "",
+                f"cannot parse {path}: {e}", _rel(path)))
+            continue
+        name = _module_name(path, _PKG_ROOT)
+        mod = _Module(name, path, tree, src.splitlines())
+        prog.modules[name] = mod
+        _scan_structure(mod)
+    _resolve_entries(prog)
+    return prog
+
+
+def _scan_structure(mod: _Module) -> None:
+    for node in mod.tree.body:
+        if isinstance(node, ast.Import):
+            for a in node.names:
+                mod.imports[a.asname or a.name.split(".")[0]] = a.name
+        elif isinstance(node, ast.ImportFrom):
+            base = _resolve_from(node.module, node.level, mod.name)
+            for a in node.names:
+                mod.imports[a.asname or a.name] = f"{base}:{a.name}"
+        elif isinstance(node, ast.Assign) and len(node.targets) == 1 \
+                and isinstance(node.targets[0], ast.Name) \
+                and isinstance(node.value, ast.Call):
+            tgt = node.targets[0].id
+            kind = _is_lock_ctor(mod, node.value)
+            if kind:
+                mod.mod_locks[tgt] = kind
+            else:
+                cls = _class_of_call(mod, node.value)
+                if cls:
+                    mod.var_types[tgt] = cls
+        elif isinstance(node, ast.ClassDef):
+            _scan_class(mod, node)
+        elif isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            _collect_functions(mod, node, prefix="")
+
+
+def _collect_functions(mod: _Module, node, prefix: str) -> None:
+    qual = prefix + node.name
+    mod.functions[qual] = node
+    for child in ast.walk(node):
+        if child is not node and \
+                isinstance(child, (ast.FunctionDef, ast.AsyncFunctionDef)) \
+                and child.name not in mod.functions:
+            mod.functions[qual + "." + child.name] = child
+
+
+def _scan_class(mod: _Module, node: ast.ClassDef) -> None:
+    cls = _Class(node.name)
+    mod.classes[node.name] = cls
+    for item in node.body:
+        if not isinstance(item, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            continue
+        qual = f"{node.name}.{item.name}"
+        cls.methods[item.name] = item
+        mod.functions[qual] = item
+        for child in ast.walk(item):
+            if child is not item and \
+                    isinstance(child,
+                               (ast.FunctionDef, ast.AsyncFunctionDef)):
+                mod.functions[qual + "." + child.name] = child
+        ann = {a.arg: a.annotation for a in item.args.args
+               if a.annotation is not None}
+        for stmt in ast.walk(item):
+            if isinstance(stmt, ast.Assign) and len(stmt.targets) == 1:
+                tgt = stmt.targets[0]
+                if isinstance(tgt, ast.Attribute) \
+                        and isinstance(tgt.value, ast.Name) \
+                        and tgt.value.id == "self":
+                    _note_self_assign(mod, cls, tgt.attr, stmt.value, ann)
+            elif isinstance(stmt, ast.AnnAssign) \
+                    and isinstance(stmt.target, ast.Attribute) \
+                    and isinstance(stmt.target.value, ast.Name) \
+                    and stmt.target.value.id == "self":
+                ty = _class_of_annotation(mod, stmt.annotation)
+                if ty:
+                    cls.attr_types[stmt.target.attr] = ty
+
+
+def _note_self_assign(mod, cls, attr, value, ann) -> None:
+    if isinstance(value, ast.Call):
+        kind = _is_lock_ctor(mod, value)
+        if kind:
+            cls.locks[attr] = kind
+            arg = _cond_lock_arg(value)
+            if arg is not None and isinstance(arg, ast.Attribute) \
+                    and isinstance(arg.value, ast.Name) \
+                    and arg.value.id == "self":
+                cls.aliases[attr] = arg.attr
+            return
+        ty = _class_of_call(mod, value)
+        if ty:
+            cls.attr_types[attr] = ty
+            return
+    if isinstance(value, ast.Name) and value.id in ann:
+        ty = _class_of_annotation(mod, ann[value.id])
+        if ty:
+            cls.attr_types[attr] = ty
+
+
+def _class_of_call(mod: _Module, call: ast.Call):
+    """(module, ClassName) when the call constructs a known class."""
+    f = call.func
+    name = None
+    if isinstance(f, ast.Name):
+        name = f.id
+    elif isinstance(f, ast.Attribute) and isinstance(f.value, ast.Name):
+        base = mod.imports.get(f.value.id)
+        if base and ":" not in base:
+            return (base, f.attr) if f.attr[:1].isupper() else None
+        name = None
+    if name is None:
+        return None
+    if name in mod.classes:
+        return (mod.name, name)
+    tgt = mod.imports.get(name)
+    if tgt and ":" in tgt:
+        m2, attr = tgt.split(":", 1)
+        if attr[:1].isupper():
+            return (m2, attr)
+    return None
+
+
+def _class_of_annotation(mod: _Module, ann):
+    if isinstance(ann, ast.Constant) and isinstance(ann.value, str):
+        name = ann.value.split("[")[0].strip().strip("'\"")
+    elif isinstance(ann, ast.Name):
+        name = ann.id
+    elif isinstance(ann, ast.Attribute):
+        name = ann.attr
+    else:
+        return None
+    if name in mod.classes:
+        return (mod.name, name)
+    tgt = mod.imports.get(name)
+    if tgt and ":" in tgt:
+        m2, attr = tgt.split(":", 1)
+        return (m2, attr)
+    return None
+
+
+def _resolve_entries(prog: _Program) -> None:
+    """Find thread targets, HTTP handler methods, signal handlers and
+    drain hooks across every loaded module."""
+    for mod in prog.modules.values():
+        for cname, cls in mod.classes.items():
+            for mname in cls.methods:
+                if mname in _HTTP_HANDLERS:
+                    prog.thread_entries.add((mod.name, f"{cname}.{mname}"))
+        for qual, fn in list(mod.functions.items()):
+            encl_cls = qual.split(".")[0] if qual.split(".")[0] \
+                in mod.classes else None
+            for node in ast.walk(fn):
+                if not isinstance(node, ast.Call):
+                    continue
+                root = _call_root(node.func)
+                if root == "Thread":
+                    tgt = next((kw.value for kw in node.keywords
+                                if kw.arg == "target"), None)
+                    _mark_entry(prog, mod, encl_cls, qual, tgt,
+                                prog.thread_entries)
+                elif root == "signal" and isinstance(node.func,
+                                                     ast.Attribute) \
+                        and len(node.args) == 2:
+                    _mark_entry(prog, mod, encl_cls, qual, node.args[1],
+                                prog.signal_entries)
+                elif root == "register_drain_hook" and len(node.args) == 2:
+                    _mark_entry(prog, mod, encl_cls, qual, node.args[1],
+                                prog.signal_entries)
+        for cname, cls in mod.classes.items():
+            for (m2, q2) in prog.thread_entries:
+                if m2 == mod.name and q2.startswith(cname + "."):
+                    cls.spawns_threads = True
+                    cls.thread_targets.add(q2.split(".", 1)[1])
+
+
+def _mark_entry(prog, mod, encl_cls, encl_qual, expr, into: set) -> None:
+    if expr is None:
+        return
+    if isinstance(expr, ast.Attribute) and \
+            isinstance(expr.value, ast.Name) and expr.value.id == "self" \
+            and encl_cls is not None:
+        if expr.attr in mod.classes[encl_cls].methods:
+            into.add((mod.name, f"{encl_cls}.{expr.attr}"))
+        return
+    if isinstance(expr, ast.Name):
+        # a module function, or a function nested in the enclosing one
+        nested = f"{encl_qual}.{expr.id}"
+        if nested in mod.functions:
+            into.add((mod.name, nested))
+        elif expr.id in mod.functions:
+            into.add((mod.name, expr.id))
+
+
+# --------------------------------------------------------------------------- #
+# pass 2: per-function walk (held-lock tracking)
+# --------------------------------------------------------------------------- #
+
+_BLOCKING_WRITE_BASES = ("journal", "sink", "stdin", "stdout", "file", "fh")
+
+
+class _Walker:
+    """Statement-ordered walk of one function body, tracking the stack
+    of held locks (``with`` scoping exact; bare ``acquire``/``release``
+    approximated in source order)."""
+
+    def __init__(self, prog: _Program, mod: _Module, fn: _Fn,
+                 node, cls: Optional[_Class]):
+        self.prog = prog
+        self.mod = mod
+        self.fn = fn
+        self.cls = cls
+        self.node = node
+        self.var_types = dict(mod.var_types)
+        args = node.args
+        for a in list(args.args) + list(args.kwonlyargs):
+            if a.annotation is not None:
+                ty = _class_of_annotation(mod, a.annotation)
+                if ty:
+                    self.var_types[a.arg] = ty
+
+    def run(self) -> None:
+        self._stmts(self.node.body, [])
+
+    # -- lock expression resolution ----------------------------------------- #
+
+    def _lock_of(self, expr) -> Optional[str]:
+        if isinstance(expr, ast.Attribute) and \
+                isinstance(expr.value, ast.Name) and \
+                expr.value.id == "self" and self.cls is not None:
+            attr = self.cls.aliases.get(expr.attr, expr.attr)
+            if attr in self.cls.locks:
+                return f"{self.mod.short}.{self.cls.name}.{attr}"
+        if isinstance(expr, ast.Name) and expr.id in self.mod.mod_locks:
+            return f"{self.mod.short}.{expr.id}"
+        return None
+
+    def _lock_kind(self, lock_id: str) -> str:
+        return self.prog.lock_kinds.get(lock_id, "lock")
+
+    def _register_kind(self, expr, lock_id: str) -> None:
+        if lock_id in self.prog.lock_kinds:
+            return
+        kind = None
+        if isinstance(expr, ast.Attribute) and self.cls is not None:
+            attr = self.cls.aliases.get(expr.attr, expr.attr)
+            kind = self.cls.locks.get(attr)
+        elif isinstance(expr, ast.Name):
+            kind = self.mod.mod_locks.get(expr.id)
+        self.prog.lock_kinds[lock_id] = kind or "lock"
+
+    # -- call resolution ----------------------------------------------------- #
+
+    def _callee_of(self, call: ast.Call):
+        f = call.func
+        if isinstance(f, ast.Name):
+            name = f.id
+            nested = f"{self.fn.qualname}.{name}"
+            if nested in self.mod.functions:
+                return (self.mod.name, nested)
+            if name in self.mod.functions:
+                return (self.mod.name, name)
+            tgt = self.mod.imports.get(name)
+            if tgt and ":" in tgt:
+                m2, attr = tgt.split(":", 1)
+                if attr[:1].isupper():
+                    return (m2, f"{attr}.__init__")
+                return (m2, attr)
+            return None
+        if not isinstance(f, ast.Attribute):
+            return None
+        base = f.value
+        if isinstance(base, ast.Name):
+            if base.id == "self" and self.cls is not None:
+                if f.attr in self.cls.methods:
+                    return (self.mod.name, f"{self.cls.name}.{f.attr}")
+                ty = self.cls.attr_types.get(f.attr)
+                return None if ty is None else ty
+            ty = self.var_types.get(base.id)
+            if ty is not None:
+                return (ty[0], f"{ty[1]}.{f.attr}")
+            tgt = self.mod.imports.get(base.id)
+            if tgt and ":" not in tgt:
+                return (tgt, f.attr)
+            if tgt and ":" in tgt:
+                m2, attr = tgt.split(":", 1)
+                if attr[:1].isupper():
+                    return (m2, f"{attr}.{f.attr}")
+            return None
+        if isinstance(base, ast.Attribute) and \
+                isinstance(base.value, ast.Name) and \
+                base.value.id == "self" and self.cls is not None:
+            ty = self.cls.attr_types.get(base.attr)
+            if ty is not None:
+                return (ty[0], f"{ty[1]}.{f.attr}")
+        return None
+
+    # -- blocking matcher ---------------------------------------------------- #
+
+    def _blocking_desc(self, call: ast.Call, held) -> Optional[str]:
+        f = call.func
+        root = _call_root(f)
+        if root is None:
+            return None
+        base_name = None
+        if isinstance(f, ast.Attribute):
+            if isinstance(f.value, ast.Name):
+                base_name = f.value.id
+            elif isinstance(f.value, ast.Attribute):
+                base_name = f.value.attr
+            elif isinstance(f.value, ast.Constant):
+                return None               # "sep".join(...) and friends
+        if root == "sleep" and (base_name == "time" or (
+                base_name is None and
+                self.mod.imports.get("sleep", "").endswith(":sleep"))):
+            return "time.sleep"
+        if root == "fsync":
+            return "fsync"
+        if root == "atomic_write_bytes":
+            return "atomic_write_bytes (fsync + rename)"
+        if root == "device_put":
+            return "jax.device_put"
+        if root == "select" and base_name == "select":
+            return "select.select"
+        if root in ("read_frame", "write_frame"):
+            return f"pipe IPC ({root})"
+        if root in ("recv", "communicate"):
+            return f"IPC .{root}()"
+        if root == "Popen":
+            return "subprocess.Popen"
+        if root == "wait" and isinstance(f, ast.Attribute):
+            lock = self._lock_of(f.value)
+            if lock is not None and lock in held:
+                return None               # Condition.wait releases it
+            return f"blocking .wait() on {base_name or 'object'}"
+        if root == "join" and isinstance(f, ast.Attribute) \
+                and base_name not in (None, "os", "path"):
+            return f"thread join on {base_name}"
+        if root == "write" and isinstance(f, ast.Attribute) \
+                and base_name is not None and any(
+                    b in base_name.lower() for b in _BLOCKING_WRITE_BASES):
+            return f"file/pipe write on {base_name}"
+        return None
+
+    # -- the walk ------------------------------------------------------------ #
+
+    def _stmts(self, body, held) -> None:
+        for stmt in body:
+            self._stmt(stmt, held)
+
+    def _stmt(self, stmt, held) -> None:
+        if isinstance(stmt, (ast.FunctionDef, ast.AsyncFunctionDef,
+                             ast.ClassDef)):
+            return                        # nested defs walk separately
+        if isinstance(stmt, (ast.With, ast.AsyncWith)):
+            pushed = 0
+            for item in stmt.items:
+                self._exprs(item.context_expr, held)
+                lock = self._lock_of(item.context_expr)
+                if lock is not None:
+                    self._register_kind(item.context_expr, lock)
+                    self._acquire(lock, item.context_expr.lineno, held)
+                    held.append(lock)
+                    pushed += 1
+            self._stmts(stmt.body, held)
+            for _ in range(pushed):
+                held.pop()
+            return
+        if isinstance(stmt, (ast.If, ast.For, ast.AsyncFor, ast.While)):
+            for expr in ast.iter_child_nodes(stmt):
+                if isinstance(expr, ast.expr):
+                    self._exprs(expr, held)
+            branch = list(held)
+            self._stmts(stmt.body, branch)
+            branch = list(held)
+            self._stmts(stmt.orelse, branch)
+            return
+        if isinstance(stmt, ast.Try):
+            branch = list(held)
+            self._stmts(stmt.body, branch)
+            for h in stmt.handlers:
+                branch = list(held)
+                self._stmts(h.body, branch)
+            branch = list(held)
+            self._stmts(stmt.orelse, branch)
+            self._stmts(stmt.finalbody, held)
+            return
+        # expression statements: acquire()/release() bookkeeping plus
+        # the generic expression scan
+        if isinstance(stmt, ast.Expr) and isinstance(stmt.value, ast.Call):
+            call = stmt.value
+            root = _call_root(call.func)
+            if root in ("acquire", "release") and \
+                    isinstance(call.func, ast.Attribute):
+                lock = self._lock_of(call.func.value)
+                if lock is not None:
+                    self._register_kind(call.func.value, lock)
+                    if root == "acquire":
+                        self._acquire(lock, call.lineno, held)
+                        held.append(lock)
+                    elif lock in held:
+                        held.remove(lock)
+                    return
+        for expr in ast.iter_child_nodes(stmt):
+            if isinstance(expr, ast.expr):
+                self._exprs(expr, held)
+        # simple local type inference: x = ClassName(...)
+        if isinstance(stmt, ast.Assign) and len(stmt.targets) == 1 \
+                and isinstance(stmt.targets[0], ast.Name) \
+                and isinstance(stmt.value, ast.Call):
+            ty = _class_of_call(self.mod, stmt.value)
+            if ty:
+                self.var_types[stmt.targets[0].id] = ty
+        self._attr_accesses(stmt, held)
+
+    def _acquire(self, lock, lineno, held) -> None:
+        self.fn.acquires.append((lock, lineno))
+        for h in held:
+            if h != lock:
+                self.fn.edges.append((h, lock, lineno))
+
+    def _exprs(self, expr, held) -> None:
+        for node in ast.walk(expr):
+            if not isinstance(node, ast.Call):
+                continue
+            root = _call_root(node.func)
+            if root == "Thread":
+                continue                  # construction, not a call
+            if root == "acquire" and isinstance(node.func, ast.Attribute):
+                lock = self._lock_of(node.func.value)
+                if lock is not None:
+                    # non-statement acquire (e.g. `if l.acquire(False):`)
+                    self._register_kind(node.func.value, lock)
+                    self.fn.acquires.append((lock, node.lineno))
+                    continue
+            desc = self._blocking_desc(node, held)
+            if desc is not None and held:
+                self.fn.blocking.append((desc, node.lineno, tuple(held)))
+            callee = self._callee_of(node)
+            if callee is not None:
+                self.fn.calls.append((callee, node.lineno, tuple(held)))
+                if isinstance(node.func, ast.Attribute) and \
+                        isinstance(node.func.value, ast.Name) and \
+                        node.func.value.id == "self":
+                    self.fn.self_calls.add(node.func.attr)
+
+    def _attr_accesses(self, stmt, held) -> None:
+        """self-attribute reads/writes for the shared-state map."""
+        if self.cls is None:
+            return
+
+        def is_self_attr(node):
+            return (isinstance(node, ast.Attribute)
+                    and isinstance(node.value, ast.Name)
+                    and node.value.id == "self")
+
+        targets = []
+        if isinstance(stmt, ast.Assign):
+            targets = stmt.targets
+        elif isinstance(stmt, (ast.AugAssign, ast.AnnAssign)):
+            targets = [stmt.target]
+        for tgt in targets:
+            if is_self_attr(tgt):
+                self.fn.writes.append((tgt.attr, tgt.lineno, tuple(held)))
+            elif isinstance(tgt, ast.Subscript) and is_self_attr(tgt.value):
+                # self.d[k] = v mutates the container self.d points at
+                self.fn.writes.append((tgt.value.attr, tgt.lineno,
+                                       tuple(held)))
+            elif isinstance(tgt, ast.Tuple):
+                for el in tgt.elts:
+                    if is_self_attr(el):
+                        self.fn.writes.append((el.attr, el.lineno,
+                                               tuple(held)))
+        for node in ast.walk(stmt):
+            if is_self_attr(node) and isinstance(node.ctx, ast.Load):
+                self.fn.reads.append((node.attr, node.lineno))
+
+
+# --------------------------------------------------------------------------- #
+# analysis driver
+# --------------------------------------------------------------------------- #
+
+_analysis_cache: dict = {}
+
+
+def _analyze(paths=None) -> _Program:
+    paths = list(paths) if paths is not None else _default_paths()
+    key = tuple((p, _stat_sig(p)) for p in paths)
+    cached = _analysis_cache.get(key)
+    if cached is not None:
+        return cached
+    prog = _load(paths)
+    for mod in prog.modules.values():
+        for qual, node in mod.functions.items():
+            head = qual.split(".")[0]
+            cls = mod.classes.get(head)
+            fn = _Fn(mod.name, qual, cls.name if cls else None,
+                     mod.path, node.lineno)
+            prog.functions[(mod.name, qual)] = fn
+            _Walker(prog, mod, fn, node, cls).run()
+    _analysis_cache.clear()               # keep at most one program
+    _analysis_cache[key] = prog
+    return prog
+
+
+def _stat_sig(path: str):
+    try:
+        st = os.stat(path)
+        return (st.st_mtime_ns, st.st_size)
+    except OSError:
+        return None
+
+
+def _may_acquire(prog: _Program) -> dict:
+    """Fixpoint: every lock a function may acquire, transitively through
+    resolved calls."""
+    out = {k: {l for l, _ in fn.acquires}
+           for k, fn in prog.functions.items()}
+    changed = True
+    while changed:
+        changed = False
+        for k, fn in prog.functions.items():
+            acc = out[k]
+            before = len(acc)
+            for callee, _lineno, _held in fn.calls:
+                acc |= out.get(callee, set())
+            if len(acc) != before:
+                changed = True
+    return out
+
+
+# --------------------------------------------------------------------------- #
+# check 1: unguarded shared state
+# --------------------------------------------------------------------------- #
+
+
+def scan_unguarded_shared_state(paths=None) -> list:
+    """Instance attributes of thread-spawning classes written outside
+    any lock while reachable from two or more thread entry points."""
+    prog = _analyze(paths)
+    findings = list(prog.findings)
+    for mod in prog.modules.values():
+        for cname, cls in mod.classes.items():
+            if not cls.spawns_threads and not any(
+                    m in _HTTP_HANDLERS for m in cls.methods):
+                continue
+            entries = _class_entries(prog, mod, cls)
+            if len(entries) < 2:
+                continue
+            # attr -> set of entries touching it; writes outside locks
+            touched: dict = {}
+            bad_writes: dict = {}
+            for entry, methods in entries.items():
+                for mname in methods:
+                    fn = prog.functions.get((mod.name, f"{cname}.{mname}"))
+                    if fn is None:
+                        continue
+                    for attr, lineno, held in fn.writes:
+                        if mname == "__init__":
+                            continue
+                        touched.setdefault(attr, set()).add(entry)
+                        if not held:
+                            bad_writes.setdefault(attr, []).append(
+                                (lineno, entry))
+                    for attr, _lineno in fn.reads:
+                        if mname != "__init__":
+                            touched.setdefault(attr, set()).add(entry)
+            for attr in sorted(bad_writes):
+                if attr in cls.locks or len(touched.get(attr, ())) < 2:
+                    continue
+                for lineno, entry in sorted(bad_writes[attr]):
+                    if _waived(mod, lineno, "unguarded"):
+                        continue
+                    rel = _rel(mod.path)
+                    findings.append(Finding(
+                        "concurrency.unguarded_shared_state", "error", "",
+                        f"{rel}:{lineno} writes {cname}.{attr} outside "
+                        f"any lock, but the attribute is reached from "
+                        f"{len(touched[attr])} thread entry points "
+                        f"({', '.join(sorted(touched[attr]))}); guard it "
+                        "or waive with  # concurrency-ok[unguarded]: why",
+                        f"{rel}:{lineno}",
+                        details={"class": f"{mod.short}.{cname}",
+                                 "attr": attr,
+                                 "entries": sorted(touched[attr])}))
+    return findings
+
+
+def _class_entries(prog: _Program, mod: _Module, cls: _Class) -> dict:
+    """entry label -> set of method names running under that entry.
+    Thread targets (and HTTP do_* handlers) each form one entry; every
+    externally-callable method forms the shared "api" entry (handler or
+    caller threads).  Reachability closes over ``self.x()`` calls."""
+
+    def closure(seed) -> set:
+        seen = set(seed)
+        frontier = list(seed)
+        while frontier:
+            m = frontier.pop()
+            fn = prog.functions.get((mod.name, f"{cls.name}.{m}"))
+            if fn is None:
+                continue
+            for callee in fn.self_calls:
+                if callee in cls.methods and callee not in seen:
+                    seen.add(callee)
+                    frontier.append(callee)
+        return seen
+
+    targets = set(cls.thread_targets) | {
+        m for m in cls.methods if m in _HTTP_HANDLERS}
+    entries = {}
+    for t in sorted(targets):
+        entries[f"thread:{t}"] = closure({t})
+    api_seed = {m for m in cls.methods
+                if m not in targets and not m.startswith("_")}
+    api_seed |= {m for m in ("__enter__", "__exit__") if m in cls.methods}
+    if api_seed:
+        entries["api"] = closure(api_seed) - targets
+    return entries
+
+
+# --------------------------------------------------------------------------- #
+# check 2: lock-order cycles
+# --------------------------------------------------------------------------- #
+
+
+def scan_lock_order_cycles(paths=None) -> list:
+    """Cycles in the cross-module lock-order graph (lock B taken while
+    A held, directly or through statically-resolved calls)."""
+    prog = _analyze(paths)
+    findings = list(prog.findings)
+    may = _may_acquire(prog)
+    edges: dict = {}                      # (a, b) -> witness "file:line"
+    for (mname, _qual), fn in sorted(prog.functions.items()):
+        mod = prog.modules[mname]
+        for a, b, lineno in fn.edges:
+            if not _waived(mod, lineno, "lock-order"):
+                edges.setdefault((a, b), f"{_rel(mod.path)}:{lineno}")
+        for callee, lineno, held in fn.calls:
+            if not held or _waived(mod, lineno, "lock-order"):
+                continue
+            for b in may.get(callee, ()):
+                for a in held:
+                    if a != b:
+                        edges.setdefault(
+                            (a, b),
+                            f"{_rel(mod.path)}:{lineno} (via "
+                            f"{_short(callee[0])}.{callee[1]})")
+    graph: dict = {}
+    for (a, b) in edges:
+        graph.setdefault(a, set()).add(b)
+    for cycle in _find_cycles(graph):
+        witness = []
+        for i, a in enumerate(cycle):
+            b = cycle[(i + 1) % len(cycle)]
+            witness.append(f"{a} -> {b} at {edges[(a, b)]}")
+        findings.append(Finding(
+            "concurrency.lock_order_cycle", "error", "",
+            "lock-order cycle: " + "  |  ".join(witness) +
+            " — two threads taking these edges in opposite order "
+            "deadlock; impose one global order (or waive an edge with "
+            "# concurrency-ok[lock-order]: why)",
+            cycle[0], details={"cycle": list(cycle)}))
+    return findings
+
+
+def _find_cycles(graph: dict) -> list:
+    """Minimal cycle witnesses: one per strongly-connected component
+    with more than one node (plus self-loops)."""
+    index: dict = {}
+    low: dict = {}
+    on_stack: set = set()
+    stack: list = []
+    sccs: list = []
+    counter = [0]
+
+    def strongconnect(v):
+        work = [(v, iter(sorted(graph.get(v, ()))))]
+        index[v] = low[v] = counter[0]
+        counter[0] += 1
+        stack.append(v)
+        on_stack.add(v)
+        while work:
+            node, it = work[-1]
+            advanced = False
+            for w in it:
+                if w not in index:
+                    index[w] = low[w] = counter[0]
+                    counter[0] += 1
+                    stack.append(w)
+                    on_stack.add(w)
+                    work.append((w, iter(sorted(graph.get(w, ())))))
+                    advanced = True
+                    break
+                if w in on_stack:
+                    low[node] = min(low[node], index[w])
+            if advanced:
+                continue
+            work.pop()
+            if work:
+                parent = work[-1][0]
+                low[parent] = min(low[parent], low[node])
+            if low[node] == index[node]:
+                comp = []
+                while True:
+                    w = stack.pop()
+                    on_stack.discard(w)
+                    comp.append(w)
+                    if w == node:
+                        break
+                sccs.append(comp)
+
+    nodes = set(graph) | {b for bs in graph.values() for b in bs}
+    for v in sorted(nodes):
+        if v not in index:
+            strongconnect(v)
+    cycles = []
+    for comp in sccs:
+        if len(comp) > 1:
+            cycles.append(_order_cycle(comp, graph))
+        elif comp[0] in graph.get(comp[0], ()):
+            cycles.append((comp[0],))
+    return cycles
+
+
+def _order_cycle(comp, graph) -> tuple:
+    """Walk one actual cycle through the SCC for a readable witness."""
+    comp_set = set(comp)
+    start = min(comp)
+    path = [start]
+    seen = {start}
+    node = start
+    while True:
+        nxt = min((w for w in graph.get(node, ()) if w in comp_set),
+                  default=None)
+        if nxt is None or nxt == start:
+            return tuple(path)
+        if nxt in seen:
+            return tuple(path[path.index(nxt):])
+        path.append(nxt)
+        seen.add(nxt)
+        node = nxt
+
+
+def lock_order_graph(paths=None) -> dict:
+    """The static lock-order graph ``{a: {b, ...}}`` (for validation
+    against :func:`tclb_tpu.telemetry.locks.order_graph`)."""
+    prog = _analyze(paths)
+    may = _may_acquire(prog)
+    graph: dict = {}
+    for (_mname, _qual), fn in prog.functions.items():
+        for a, b, _lineno in fn.edges:
+            graph.setdefault(a, set()).add(b)
+        for callee, _lineno, held in fn.calls:
+            for b in may.get(callee, ()):
+                for a in held:
+                    if a != b:
+                        graph.setdefault(a, set()).add(b)
+    return graph
+
+
+# --------------------------------------------------------------------------- #
+# check 3: blocking work under a lock
+# --------------------------------------------------------------------------- #
+
+
+def scan_blocking_under_lock(paths=None) -> list:
+    """sleep / fsync / device_put / pipe IPC / subprocess-wait / thread
+    join executed while a lock is held (same-function analysis)."""
+    prog = _analyze(paths)
+    findings = list(prog.findings)
+    for (mname, _qual), fn in sorted(prog.functions.items()):
+        mod = prog.modules[mname]
+        for desc, lineno, held in fn.blocking:
+            if _waived(mod, lineno, "blocking"):
+                continue
+            rel = _rel(mod.path)
+            findings.append(Finding(
+                "concurrency.blocking_under_lock", "error", "",
+                f"{rel}:{lineno} performs {desc} while holding "
+                f"{', '.join(held)} — every thread contending on that "
+                "lock inherits the stall; move the blocking work "
+                "outside the critical section (or waive with "
+                "# concurrency-ok[blocking]: why)",
+                f"{rel}:{lineno}",
+                details={"blocking": desc, "held": list(held)}))
+    return findings
+
+
+# --------------------------------------------------------------------------- #
+# check 4: signal-unsafe handler paths
+# --------------------------------------------------------------------------- #
+
+_SIGNAL_DEPTH = 2
+
+
+def scan_signal_unsafe(paths=None) -> list:
+    """Non-reentrant lock acquisition or blocking IO within
+    ``_SIGNAL_DEPTH`` calls of a signal handler or drain hook.  The
+    handler runs on the main thread between bytecodes: if the
+    interrupted code holds the same non-reentrant lock, the process
+    self-deadlocks.  Reentrant (RLock) acquisition is allowed."""
+    prog = _analyze(paths)
+    findings = list(prog.findings)
+    seen_sites = set()
+    frontier = [(entry, 0) for entry in sorted(prog.signal_entries)]
+    visited = set()
+    while frontier:
+        (key, depth) = frontier.pop()
+        if key in visited:
+            continue
+        visited.add(key)
+        fn = prog.functions.get(key)
+        if fn is None:
+            continue
+        mod = prog.modules[key[0]]
+        rel = _rel(mod.path)
+        for lock, lineno in fn.acquires:
+            kind = prog.lock_kinds.get(lock, "lock")
+            if kind in ("rlock", "condition"):
+                continue
+            site = (rel, lineno, lock)
+            if site in seen_sites or _waived(mod, lineno, "signal"):
+                continue
+            seen_sites.add(site)
+            findings.append(Finding(
+                "concurrency.signal_unsafe", "error", "",
+                f"{rel}:{lineno} acquires non-reentrant lock {lock} on "
+                f"a signal-handler path (via {fn.qualname}); if the "
+                "interrupted main thread holds it, the process "
+                "self-deadlocks — use an RLock (or waive with "
+                "# concurrency-ok[signal]: why)",
+                f"{rel}:{lineno}",
+                details={"lock": lock, "via": fn.qualname}))
+        for desc, lineno, _held in fn.blocking:
+            site = (rel, lineno, desc)
+            if site in seen_sites or _waived(mod, lineno, "signal"):
+                continue
+            seen_sites.add(site)
+            findings.append(Finding(
+                "concurrency.signal_unsafe", "error", "",
+                f"{rel}:{lineno} performs {desc} on a signal-handler "
+                f"path (via {fn.qualname}) — IO in a handler context "
+                "can wedge the dying process (or waive with "
+                "# concurrency-ok[signal]: why)",
+                f"{rel}:{lineno}",
+                details={"blocking": desc, "via": fn.qualname}))
+        for node in ast.walk(prog.modules[key[0]].functions.get(
+                key[1], ast.Pass())):
+            if isinstance(node, ast.Call):
+                root = _call_root(node.func)
+                if root == "open" and isinstance(node.func, ast.Name):
+                    lineno = node.lineno
+                    site = (rel, lineno, "open")
+                    if site in seen_sites or _waived(mod, lineno, "signal"):
+                        continue
+                    seen_sites.add(site)
+                    findings.append(Finding(
+                        "concurrency.signal_unsafe", "error", "",
+                        f"{rel}:{lineno} opens a file on a "
+                        f"signal-handler path (via {fn.qualname}) — "
+                        "IO in a handler context can wedge the dying "
+                        "process (or waive with "
+                        "# concurrency-ok[signal]: why)",
+                        f"{rel}:{lineno}",
+                        details={"blocking": "open", "via": fn.qualname}))
+        if depth < _SIGNAL_DEPTH:
+            for callee, _lineno, _held in fn.calls:
+                frontier.append((callee, depth + 1))
+    return findings
+
+
+# --------------------------------------------------------------------------- #
+# aggregate
+# --------------------------------------------------------------------------- #
+
+
+def check_concurrency(paths=None) -> list:
+    """All four concurrency checks (what ``check_repo`` chains)."""
+    out = scan_unguarded_shared_state(paths)
+    # parse failures are reported once by the first scan; the other
+    # scans re-report them, so dedupe on (check, where, message)
+    seen = {(f.check, f.where, f.message) for f in out}
+    for scan in (scan_lock_order_cycles, scan_blocking_under_lock,
+                 scan_signal_unsafe):
+        for f in scan(paths):
+            key = (f.check, f.where, f.message)
+            if key not in seen:
+                seen.add(key)
+                out.append(f)
+    return out

@@ -46,7 +46,10 @@ class Finding:
         return _RANK[self.severity]
 
     def to_dict(self) -> dict:
-        return {"check": self.check, "severity": self.severity,
+        # "code" duplicates "check": the stable, documented finding code
+        # external tooling keys on (grep-able in the check catalog)
+        return {"check": self.check, "code": self.check,
+                "severity": self.severity,
                 "model": self.model, "message": self.message,
                 "where": self.where, "details": self.details}
 

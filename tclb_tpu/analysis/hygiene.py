@@ -913,6 +913,7 @@ def scan_unsupervised_subprocess(paths=None) -> list:
 
 
 def check_repo(engine_dir=None, sources=None) -> list:
+    from tclb_tpu.analysis.concurrency import check_concurrency
     from tclb_tpu.analysis.precision import (scan_unsafe_accum,
                                              scan_unshifted_cast)
     return (scan_dead_entry_points(engine_dir, sources)
@@ -927,7 +928,8 @@ def check_repo(engine_dir=None, sources=None) -> list:
             + scan_unpoliced_retry()
             + scan_unsupervised_subprocess()
             + scan_unsafe_accum()
-            + scan_unshifted_cast())
+            + scan_unshifted_cast()
+            + check_concurrency())
 
 
 def check_model_hygiene(model: Model, shape=None) -> list:

@@ -265,6 +265,9 @@ class AsyncWriter:
             try:
                 fn()
             except BaseException as e:  # noqa: BLE001 — surfaced on wait()
+                # concurrency-ok[unguarded]: single-writer latch — only
+                # this worker writes it, and wait() joins the thread
+                # before reading (join is the happens-before edge)
                 self._error = e
 
         self._thread = threading.Thread(target=run, daemon=True,
@@ -279,5 +282,7 @@ class AsyncWriter:
             self._thread.join()
             self._thread = None
         if self._error is not None:
+            # concurrency-ok[unguarded]: read/cleared only after join()
+            # above — the writing thread is gone by this line
             err, self._error = self._error, None
             raise err

@@ -36,6 +36,7 @@ from tclb_tpu.gateway.store import JobStore
 from tclb_tpu.gateway.tenancy import (AdmissionController, RateLimiter,
                                       TenancyConfig, TokenAuth)
 from tclb_tpu.telemetry import live as tlive
+from tclb_tpu.telemetry import locks
 from tclb_tpu.utils import log
 
 
@@ -84,7 +85,7 @@ class GatewayService:
         # scheduler job id -> (record id, case index) for async fan-in
         self._pending_cases: dict[int, tuple[str, int]] = {}
         self._case_slots: dict[str, list] = {}
-        self._lock = threading.RLock()
+        self._lock = locks.make_rlock("gateway.service.GatewayService._lock")
         self._closing = False
         self._draining = False
         # process isolation: with a WorkerPool attached, solve jobs run
@@ -123,6 +124,8 @@ class GatewayService:
             from tclb_tpu.serve.scheduler import Scheduler
             if self._cache is None:
                 self._cache = CompiledCache()
+            # concurrency-ok[unguarded]: written before the worker
+            # thread exists; Thread.start() publishes it (happens-before)
             self._sched = Scheduler(max_batch=self._max_batch,
                                     cache=self._cache,
                                     on_result=self._on_sched_result,

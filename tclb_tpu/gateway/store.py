@@ -21,13 +21,13 @@ from __future__ import annotations
 
 import json
 import os
-import threading
 import time
 from typing import Optional
 
 from tclb_tpu import faults, telemetry
 from tclb_tpu.checkpoint import writer
 from tclb_tpu.gateway.jobs import TERMINAL, JobRecord
+from tclb_tpu.telemetry import locks
 
 SNAPSHOT_EVERY = 256
 
@@ -49,7 +49,13 @@ class JobStore:
         self.degraded = False
         self._snap_path = os.path.join(self.root, "store.json")
         self._journal_path = os.path.join(self.root, "journal.jsonl")
-        self._lock = threading.RLock()
+        # two-lock split: ``_lock`` guards the in-memory index (the
+        # request path: get/put-index/records) and is never held across
+        # IO; ``_io_lock`` serializes durable writes (journal appends,
+        # snapshot compaction, handle swaps).  Only ``_io_lock -> _lock``
+        # nesting is permitted, so the order graph stays acyclic.
+        self._lock = locks.make_lock("gateway.store.JobStore._lock")
+        self._io_lock = locks.make_lock("gateway.store.JobStore._io_lock")
         self._records: dict[str, JobRecord] = {}
         # (tenant, idempotency_key) -> job id; a client retry after a
         # dropped connection maps to the existing record, never a dupe
@@ -143,33 +149,45 @@ class JobStore:
         they never propagate into the request path.  Failed puts still
         count toward the snapshot trigger, so a degraded store keeps
         re-attempting compaction (which restores durability and clears
-        the flag) instead of staying memory-only until ``close()``."""
+        the flag) instead of staying memory-only until ``close()``.
+
+        The in-memory index is updated under ``_lock`` *before* the
+        journal append takes ``_io_lock``, so readers (and the HTTP
+        status path) never wait behind disk IO; concurrent puts of
+        different jobs may journal out of index order, which replay
+        already tolerates via the ``updated_ts`` regression guard."""
+        line = json.dumps({"op": "put", "record": rec.to_dict()}) + "\n"
         with self._lock:
             self._index(rec)
+            self._puts_since_snapshot += 1
+            want_snapshot = self._puts_since_snapshot >= self.snapshot_every
+        with self._io_lock:
             if self._journal is None:
                 # a late daemon thread finishing after close(): the
                 # final snapshot already captured everything durable
                 return
-            line = json.dumps({"op": "put", "record": rec.to_dict()}) + "\n"
             try:
                 mode = faults.fire("store.journal", job=rec.id)
                 if self._tail_torn:
                     # the previous append may have ended mid-line: lead
                     # with a newline so replay drops one unparseable
                     # fragment, not this record concatenated onto it
+                    # concurrency-ok[blocking]: _io_lock IS the durable-
+                    # write mutex; the request path holds only _lock
                     self._journal.write("\n")
                     self._tail_torn = False
                 if mode == "torn":
+                    # concurrency-ok[blocking]: _io_lock serializes IO
                     self._journal.write(line[:max(1, len(line) // 2)])
                     self._tail_torn = True
                 else:
+                    # concurrency-ok[blocking]: _io_lock serializes IO
                     self._journal.write(line)
             except (OSError, ValueError, faults.InjectedFault) as e:
                 self._tail_torn = True
                 self._degrade(e, job=rec.id)
-            self._puts_since_snapshot += 1
-            if self._puts_since_snapshot >= self.snapshot_every:
-                self._try_snapshot(job=rec.id)
+        if want_snapshot:
+            self._try_snapshot(job=rec.id)
 
     def _degrade(self, exc: BaseException, job: str = "-") -> None:
         if not self.degraded:
@@ -183,14 +201,25 @@ class JobStore:
         as the journal append: a failed compaction (ENOSPC on the
         atomic write, journal reopen failure) marks the store degraded
         and resets the put counter, so the next ``snapshot_every`` puts
-        trigger a retry rather than hammering every request."""
+        trigger a retry rather than hammering every request.  Always
+        called with *no* store lock held (it takes both internally)."""
         try:
             self.snapshot()
             return True
-        except (OSError, ValueError) as e:
-            self._puts_since_snapshot = 0
+        except ValueError as e:
+            if str(e) == "store is closed":
+                return False  # lost a benign race with close(); not a fault
+            self._reset_put_counter()
             self._degrade(e, job=job)
             return False
+        except OSError as e:
+            self._reset_put_counter()
+            self._degrade(e, job=job)
+            return False
+
+    def _reset_put_counter(self) -> None:
+        with self._lock:
+            self._puts_since_snapshot = 0
 
     def _expired(self, now: float) -> list[JobRecord]:
         if self.retain_secs is None:
@@ -205,26 +234,34 @@ class JobStore:
         and truncate the journal.  Retention GC happens here: terminal
         records past the TTL are dropped from the compacted image, and
         the snapshot carries the GC horizon so a pre-truncate journal
-        tail can never resurrect them on replay."""
-        with self._lock:
+        tail can never resurrect them on replay.
+
+        Holds ``_io_lock`` for the whole compaction (serializing against
+        journal appends) but ``_lock`` only for the in-memory GC and the
+        image capture — readers are never blocked behind the fsync."""
+        with self._io_lock:
             if self._journal is None:
                 raise ValueError("store is closed")
             now = time.time()
-            expired = self._expired(now)
-            for rec in expired:
-                self._records.pop(rec.id, None)
-                if rec.idempotency_key:
-                    self._idem.pop((rec.tenant, rec.idempotency_key), None)
+            with self._lock:
+                expired = self._expired(now)
+                for rec in expired:
+                    self._records.pop(rec.id, None)
+                    if rec.idempotency_key:
+                        self._idem.pop((rec.tenant,
+                                        rec.idempotency_key), None)
+                doc = {"seq": self._seq,
+                       "records": [r.to_dict()
+                                   for r in self._records.values()]}
+                if self.retain_secs is not None:
+                    doc["gc_horizon"] = now
+                    self._gc_horizon = now
             if expired:
                 telemetry.event("gateway.store_gc", removed=len(expired),
                                 retain_secs=self.retain_secs)
                 telemetry.counter("gateway.store_gc", len(expired))
-            doc = {"seq": self._seq,
-                   "records": [r.to_dict()
-                               for r in self._records.values()]}
-            if self.retain_secs is not None:
-                doc["gc_horizon"] = now
-                self._gc_horizon = now
+            # concurrency-ok[blocking]: the fsync+rename is the point of
+            # _io_lock; only journal appends contend, never readers
             writer.atomic_write_bytes(
                 self._snap_path,
                 json.dumps(doc, indent=1).encode())
@@ -238,7 +275,8 @@ class JobStore:
             except OSError:
                 pass
             self._tail_torn = False
-            self._puts_since_snapshot = 0
+            with self._lock:
+                self._puts_since_snapshot = 0
             self.degraded = False
             return self._snap_path
 
@@ -251,23 +289,23 @@ class JobStore:
             return False
         now = time.time() if now is None else now
         with self._lock:
-            if self._journal is None:
-                return False
             interval = max(1.0, min(self.retain_secs, 60.0))
             if now - self._last_gc_check < interval:
                 return False
             self._last_gc_check = now
             if not self._expired(now):
                 return False
-            return self._try_snapshot()
+        # compaction runs lock-free (snapshot takes what it needs); a
+        # close() racing in is caught by _try_snapshot's closed check
+        return self._try_snapshot()
 
     def close(self) -> None:
-        with self._lock:
+        # degrade-safe: if the final compaction fails (disk still full)
+        # the journal keeps whatever it has — a restart replays it
+        # instead of losing the shutdown
+        self._try_snapshot()
+        with self._io_lock:
             if self._journal is not None:
-                # degrade-safe: if the final compaction fails (disk
-                # still full) the journal keeps whatever it has — a
-                # restart replays it instead of losing the shutdown
-                self._try_snapshot()
                 self._journal.close()
                 self._journal = None
 

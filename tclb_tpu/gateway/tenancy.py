@@ -17,11 +17,11 @@ from __future__ import annotations
 
 import dataclasses
 import hmac
-import threading
 import time
 from typing import Callable, Optional, Sequence
 
 from tclb_tpu.gateway.jobs import TERMINAL, JobRecord
+from tclb_tpu.telemetry import locks
 
 #: rejection reasons (stable API + metrics label values)
 REASON_MAX_QUEUED = "tenant_max_queued"
@@ -223,7 +223,7 @@ class RateLimiter:
         self.default = default
         self.tenants = dict(tenants or {})
         self._clock = clock
-        self._lock = threading.Lock()
+        self._lock = locks.make_lock("gateway.tenancy.RateLimiter._lock")
         # tenant -> [tokens, last_refill_ts]
         self._buckets: dict[str, list[float]] = {}
 

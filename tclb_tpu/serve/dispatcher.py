@@ -44,6 +44,7 @@ import numpy as np
 
 from tclb_tpu import faults, telemetry
 from tclb_tpu.telemetry import live as tlive
+from tclb_tpu.telemetry import locks
 from tclb_tpu.core.lattice import Lattice
 from tclb_tpu.ops import fusion
 from tclb_tpu.parallel.mesh import (choose_decomposition,
@@ -416,9 +417,9 @@ class FleetDispatcher:
         self._gate = threading.Event()
         self._gate.set()
         self._plans: dict[tuple, EnsemblePlan] = {}
-        self._plan_lock = threading.Lock()
+        self._plan_lock = locks.make_lock("serve.dispatcher.FleetDispatcher._plan_lock")
         self._jobs = 0
-        self._lock = threading.Lock()
+        self._lock = locks.make_lock("serve.dispatcher.FleetDispatcher._lock")
         self._inflight: dict[int, Job] = {}
         self._closing = False
         self._started = False

@@ -50,6 +50,7 @@ from tclb_tpu import faults, telemetry
 from tclb_tpu.serve.retry import RetryPolicy
 from tclb_tpu.serve.worker import IpcError, npy_load, read_frame, write_frame
 from tclb_tpu.telemetry import live as tlive
+from tclb_tpu.telemetry import locks
 from tclb_tpu.utils import log
 
 
@@ -158,7 +159,7 @@ class WorkerPool:
         self._queue: "queue.Queue[PoolJob]" = queue.Queue()
         self._workers = [_Worker(i) for i in range(self.n)]
         self._threads: list[threading.Thread] = []
-        self._lock = threading.Lock()
+        self._lock = locks.make_lock("serve.pool.WorkerPool._lock")
         self._closing = False
         self._started = False
         self._jobs = 0

@@ -33,6 +33,7 @@ import numpy as np
 
 from tclb_tpu import faults, telemetry
 from tclb_tpu.telemetry import live as tlive
+from tclb_tpu.telemetry import locks
 from tclb_tpu.core.registry import Model
 from tclb_tpu.ops import fusion
 from tclb_tpu.serve.cache import CompiledCache
@@ -209,11 +210,11 @@ class Scheduler:
         self._rr_last: Optional[str] = None
         self._plans: dict[tuple, EnsemblePlan] = {}
         self._jobs = 0
-        self._lock = threading.Lock()
+        self._lock = locks.make_lock("serve.scheduler.Scheduler._lock")
         # held across a submit_many burst AND the worker's bin drain, so
         # the worker's next batch sees a whole burst or none of it
         # (reentrant: submit() runs under it inside submit_many)
-        self._admit = threading.RLock()
+        self._admit = locks.make_rlock("serve.scheduler.Scheduler._admit")
         self._avail = threading.Condition(self._admit)
         self._closing = False
         self._worker: Optional[threading.Thread] = None

@@ -38,6 +38,7 @@ from collections import deque
 from typing import Any, Callable, Optional
 
 from tclb_tpu.telemetry import events
+from tclb_tpu.telemetry import locks
 
 _T0 = time.time()
 
@@ -166,7 +167,7 @@ class MetricsRegistry:
     """
 
     def __init__(self) -> None:
-        self._lock = threading.Lock()
+        self._lock = locks.make_lock("telemetry.live.MetricsRegistry._lock")
         self._gauges: dict[tuple, float] = {}
         self._counters: dict[tuple, float] = {}
         self._hists: dict[tuple, _Hist] = {}
@@ -289,7 +290,7 @@ class MetricsRegistry:
 
 _registry = MetricsRegistry()
 _live_refs = 0
-_live_lock = threading.Lock()
+_live_lock = locks.make_lock("telemetry.live._live_lock")
 
 
 def registry() -> MetricsRegistry:
@@ -445,7 +446,7 @@ class FlightRecorder:
                  dump_dir: Optional[str] = None) -> None:
         self.capacity = capacity
         self._ring: deque = deque(maxlen=capacity)
-        self._lock = threading.Lock()
+        self._lock = locks.make_lock("telemetry.live.FlightRecorder._lock")
         self._refs = 0
         self._dumps: list[str] = []
         self._dump_dir = dump_dir
@@ -509,6 +510,8 @@ class FlightRecorder:
         marker.update(extra)
         try:
             os.makedirs(d, exist_ok=True)
+            # concurrency-ok[signal]: dumping on the dying path is the
+            # flight recorder's purpose; failures are contained below
             with open(path, "w") as fh:
                 for doc in ring:
                     fh.write(json.dumps(doc,
@@ -535,7 +538,10 @@ def flight_recorder() -> FlightRecorder:
 # -- drain hooks: shutdown work that must run before SIGTERM kills us -------- #
 
 _drain_hooks: dict[str, Callable[[str], Any]] = {}
-_drain_lock = threading.Lock()
+# reentrant: run_drain_hooks executes inside the SIGTERM handler on the
+# main thread — if the signal interrupts register/unregister_drain_hook
+# mid-critical-section, a plain Lock would self-deadlock the shutdown
+_drain_lock = locks.make_rlock("telemetry.live._drain_lock")
 
 
 def register_drain_hook(name: str, fn: Callable[[str], Any]) -> None:
@@ -611,7 +617,7 @@ def _install_sigterm_handler() -> None:
 # -- status providers --------------------------------------------------------- #
 
 _providers: dict[str, Callable[[], dict]] = {}
-_providers_lock = threading.Lock()
+_providers_lock = locks.make_lock("telemetry.live._providers_lock")
 
 
 def register_status(name: str, fn: Callable[[], dict]) -> None:
@@ -667,6 +673,8 @@ def status_snapshot() -> dict:
 
 # -- on-demand profiler capture ----------------------------------------------- #
 
+# raw on purpose: acquired by the caller thread, released by the worker
+# thread — per-thread sanitizer tracking cannot model cross-thread release
 _profile_lock = threading.Lock()
 
 

@@ -1,0 +1,276 @@
+"""Opt-in runtime lock sanitizer: the dynamic half of the concurrency gate.
+
+The static pass (:mod:`tclb_tpu.analysis.concurrency`) proves lock
+discipline from the AST; this module validates the same discipline
+against what the threads actually do.  With ``TCLB_LOCK_DEBUG=1`` every
+lock built through :func:`make_lock` / :func:`make_rlock` is wrapped in
+a :class:`DebugLock` that records, per thread, the order locks are
+taken in and how long they are held:
+
+* **order inversions** — thread X was ever seen taking ``a`` then ``b``;
+  some thread now takes ``b`` then ``a``.  That pair is one scheduling
+  accident away from a deadlock, even if this run got away with it.
+  Emitted as a ``lock.inversion`` telemetry event (flight-recorder and
+  trace visible) and kept in :func:`inversions` for assertions.
+* **long holds** — a lock held longer than ``TCLB_LOCK_DEBUG_MS``
+  (default 100 ms) indicates blocking work inside a critical section —
+  the runtime shadow of ``concurrency.blocking_under_lock``.  Emitted
+  as ``lock.long_hold``.
+
+Design constraints:
+
+* **strict no-op when off** — :func:`make_lock` returns a *raw*
+  ``threading.Lock`` when the sanitizer is disabled; production runs pay
+  literally nothing (no wrapper object, no extra attribute hop).
+* **no emission under a lock** — findings are queued per-thread and
+  flushed only once the thread has dropped its last instrumented lock,
+  so the sanitizer can never deadlock against the telemetry fan-out it
+  reports through.
+* **Condition-compatible** — :class:`DebugLock` implements the private
+  ``_release_save`` / ``_acquire_restore`` / ``_is_owned`` protocol, so
+  ``threading.Condition(make_rlock(...))`` behaves exactly like a
+  Condition on the raw primitive.
+
+The observed order graph (:func:`order_graph`) uses the same
+``module.Class.attr`` node names as the static analyzer's lock-order
+graph, so CI can check the runtime edges against the proven ones.
+"""
+
+from __future__ import annotations
+
+import os
+import threading
+import time
+from typing import Any, Optional
+
+#: keep at most this many inversion / long-hold records for inspection
+MAX_RECORDS = 256
+
+_enabled = os.environ.get("TCLB_LOCK_DEBUG", "") == "1"
+_long_hold_ms = float(os.environ.get("TCLB_LOCK_DEBUG_MS", "100"))
+
+_graph_lock = threading.Lock()          # raw on purpose: the meta-lock
+_order: dict[str, set[str]] = {}        # observed edges a -> b (a held
+_edge_sites: dict[tuple, str] = {}      # first witness thread per edge
+_inversions: list[dict] = []
+_long_holds: list[dict] = []
+
+_tls = threading.local()                # .held: [(name, t_acquire)],
+                                        # .pending: [event docs]
+
+
+def enabled() -> bool:
+    """Whether new locks built via make_lock/make_rlock are instrumented."""
+    return _enabled
+
+
+def long_hold_ms() -> float:
+    return _long_hold_ms
+
+
+def enable(hold_ms: Optional[float] = None) -> None:
+    """Turn the sanitizer on for locks constructed *after* this call
+    (tests; production uses ``TCLB_LOCK_DEBUG=1`` at process start)."""
+    global _enabled, _long_hold_ms
+    _enabled = True
+    if hold_ms is not None:
+        _long_hold_ms = float(hold_ms)
+
+
+def disable() -> None:
+    """Stop instrumenting newly-constructed locks (existing DebugLocks
+    keep working — they are still real locks)."""
+    global _enabled
+    _enabled = False
+
+
+def reset() -> None:
+    """Drop all recorded edges / inversions / long holds (tests)."""
+    with _graph_lock:
+        _order.clear()
+        _edge_sites.clear()
+        del _inversions[:]
+        del _long_holds[:]
+
+
+def inversions() -> list[dict]:
+    with _graph_lock:
+        return list(_inversions)
+
+
+def long_holds() -> list[dict]:
+    with _graph_lock:
+        return list(_long_holds)
+
+
+def order_graph() -> dict[str, set[str]]:
+    """Copy of the observed lock-order graph: ``{a: {b, ...}}`` means
+    some thread acquired ``b`` while holding ``a``."""
+    with _graph_lock:
+        return {a: set(bs) for a, bs in _order.items()}
+
+
+# -- per-thread bookkeeping ---------------------------------------------------- #
+
+
+def _state():
+    st = _tls
+    if not hasattr(st, "held"):
+        st.held = []
+        st.pending = []
+    return st
+
+
+def _note_acquire(name: str) -> None:
+    st = _state()
+    t = time.monotonic()
+    held_names = [n for n, _ in st.held]
+    if held_names and name not in held_names:
+        # record edges held -> name; an edge already known in the
+        # opposite direction is an order inversion
+        docs = []
+        with _graph_lock:
+            for h in dict.fromkeys(held_names):
+                if name in _order and h in _order[name]:
+                    doc = {"kind": "lock.inversion",
+                           "first": name, "then": h,
+                           "now_first": h, "now_then": name,
+                           "held": list(dict.fromkeys(held_names)),
+                           "thread": threading.current_thread().name,
+                           "prior_thread": _edge_sites.get((name, h), "?")}
+                    if len(_inversions) < MAX_RECORDS:
+                        _inversions.append(doc)
+                    docs.append(doc)
+                edge = (h, name)
+                if name not in _order.get(h, ()):
+                    _order.setdefault(h, set()).add(name)
+                    _edge_sites[edge] = threading.current_thread().name
+        st.pending.extend(docs)
+    st.held.append((name, t))
+
+
+def _note_release(name: str, full: bool = False) -> int:
+    """Pop the most recent hold of ``name`` (all of them with ``full``,
+    for Condition.wait's total release); returns the number popped."""
+    st = _state()
+    popped = 0
+    outermost_t = None
+    for i in range(len(st.held) - 1, -1, -1):
+        if st.held[i][0] == name:
+            outermost_t = st.held[i][1]
+            del st.held[i]
+            popped += 1
+            if not full:
+                break
+    if popped and outermost_t is not None:
+        dur_ms = (time.monotonic() - outermost_t) * 1e3
+        remaining = any(n == name for n, _ in st.held)
+        if not remaining and dur_ms > _long_hold_ms:
+            doc = {"kind": "lock.long_hold", "lock": name,
+                   "held_ms": round(dur_ms, 3),
+                   "limit_ms": _long_hold_ms,
+                   "thread": threading.current_thread().name}
+            with _graph_lock:
+                if len(_long_holds) < MAX_RECORDS:
+                    _long_holds.append(doc)
+            st.pending.append(doc)
+    if not st.held and st.pending:
+        pending, st.pending = st.pending, []
+        _emit(pending)
+    return popped
+
+
+def _emit(docs: list) -> None:
+    # only called with an empty held-stack: emitting takes the events
+    # lock, and a subscriber (live._observe) may take registry locks —
+    # never do that while holding an instrumented lock
+    from tclb_tpu.telemetry import events
+    for doc in docs:
+        fields = {k: v for k, v in doc.items() if k != "kind"}
+        events.event(doc["kind"], **fields)
+        events.counter(doc["kind"])
+
+
+# -- the wrapper --------------------------------------------------------------- #
+
+
+class DebugLock:
+    """An instrumented stand-in for ``threading.Lock``/``RLock`` that
+    records acquisition order and hold times.  Only constructed when the
+    sanitizer is enabled; supports the Condition lock protocol."""
+
+    __slots__ = ("name", "_inner")
+
+    def __init__(self, name: str, inner: Any) -> None:
+        self.name = name
+        self._inner = inner
+
+    def acquire(self, blocking: bool = True, timeout: float = -1) -> bool:
+        ok = self._inner.acquire(blocking, timeout)
+        if ok:
+            _note_acquire(self.name)
+        return ok
+
+    def release(self) -> None:
+        self._inner.release()
+        _note_release(self.name)
+
+    def locked(self) -> bool:
+        return self._inner.locked()
+
+    def __enter__(self) -> "DebugLock":
+        self.acquire()
+        return self
+
+    def __exit__(self, *exc) -> None:
+        self.release()
+
+    def __repr__(self) -> str:
+        return f"<DebugLock {self.name} wrapping {self._inner!r}>"
+
+    # -- threading.Condition lock protocol ---------------------------------- #
+
+    def _is_owned(self) -> bool:
+        inner = self._inner
+        if hasattr(inner, "_is_owned"):
+            return inner._is_owned()
+        if inner.acquire(False):       # plain-Lock fallback, as Condition's
+            inner.release()
+            return False
+        return True
+
+    def _release_save(self):
+        popped = _note_release(self.name, full=True)
+        if hasattr(self._inner, "_release_save"):
+            return (self._inner._release_save(), popped)
+        self._inner.release()
+        return (None, popped)
+
+    def _acquire_restore(self, saved) -> None:
+        inner_saved, popped = saved
+        if hasattr(self._inner, "_acquire_restore"):
+            self._inner._acquire_restore(inner_saved)
+        else:
+            self._inner.acquire()
+        t = time.monotonic()
+        st = _state()
+        for _ in range(max(1, popped)):
+            st.held.append((self.name, t))
+
+
+def make_lock(name: str):
+    """A ``threading.Lock`` — instrumented as a :class:`DebugLock` when
+    ``TCLB_LOCK_DEBUG=1``, otherwise the raw primitive (strict no-op).
+    ``name`` must match the static analyzer's node naming
+    (``module.Class.attr``) so the two order graphs line up."""
+    if _enabled:
+        return DebugLock(name, threading.Lock())
+    return threading.Lock()
+
+
+def make_rlock(name: str):
+    """A ``threading.RLock`` — instrumented when ``TCLB_LOCK_DEBUG=1``.
+    Reentrant re-acquisition records no order edge."""
+    if _enabled:
+        return DebugLock(name, threading.RLock())
+    return threading.RLock()

@@ -72,8 +72,9 @@ def test_cli_json_schema(capsys):
     assert set(doc["models"]) == {"d2q9"}
     assert doc["repo"] == []
     for f in doc["models"]["d2q9"]:
-        assert set(f) == {"check", "severity", "model", "message",
-                          "where", "details"}
+        assert set(f) == {"check", "code", "severity", "model",
+                          "message", "where", "details"}
+        assert f["code"] == f["check"]           # stable tooling key
         assert f["severity"] in ("error", "warning", "info")
         assert f["model"] == "d2q9"
     s = doc["summary"]
@@ -626,3 +627,282 @@ def test_hygiene_fires_on_unsupervised_subprocess(tmp_path):
     import inspect
     assert "scan_unsupervised_subprocess" \
         in inspect.getsource(hygiene.check_repo)
+
+
+# --------------------------------------------------------------------------- #
+# Concurrency: lock-discipline checks
+# --------------------------------------------------------------------------- #
+
+
+def _fresh_concurrency():
+    from tclb_tpu.analysis import concurrency
+    concurrency._analysis_cache.clear()
+    return concurrency
+
+
+def test_concurrency_fires_on_unguarded_shared_state(tmp_path):
+    con = _fresh_concurrency()
+    p = tmp_path / "svc.py"
+    p.write_text(
+        "import threading\n"
+        "class Svc:\n"
+        "    def __init__(self):\n"
+        "        self._lock = threading.Lock()\n"
+        "        self.count = 0\n"
+        "    def start(self):\n"
+        "        t = threading.Thread(target=self._loop)\n"
+        "        t.start()\n"
+        "    def _loop(self):\n"
+        "        while True:\n"
+        "            print(self.count)\n"
+        "    def bump(self):\n"
+        "        self.count += 1\n")
+    fs = con.scan_unguarded_shared_state(paths=[str(p)])
+    assert [f.check for f in fs] == ["concurrency.unguarded_shared_state"]
+    assert fs[0].severity == "error"
+    assert "count" in fs[0].message
+    assert sorted(fs[0].details["entries"]) == ["api", "thread:_loop"]
+    # the same write under the lock is clean
+    q = tmp_path / "svc_ok.py"
+    q.write_text(p.read_text().replace(
+        "    def bump(self):\n        self.count += 1\n",
+        "    def bump(self):\n"
+        "        with self._lock:\n"
+        "            self.count += 1\n"))
+    _fresh_concurrency()
+    assert con.scan_unguarded_shared_state(paths=[str(q)]) == []
+
+
+def test_concurrency_unguarded_waiver_clears_finding(tmp_path):
+    con = _fresh_concurrency()
+    p = tmp_path / "svc.py"
+    p.write_text(
+        "import threading\n"
+        "class Svc:\n"
+        "    def start(self):\n"
+        "        threading.Thread(target=self._loop).start()\n"
+        "    def _loop(self):\n"
+        "        print(self.flag)\n"
+        "    def stop(self):\n"
+        "        # concurrency-ok[unguarded]: single boolean latch, one\n"
+        "        # writer; worst case the loop sees it a tick late\n"
+        "        self.flag = True\n")
+    assert con.scan_unguarded_shared_state(paths=[str(p)]) == []
+    # a waiver without a justification does not count
+    q = tmp_path / "svc_bare.py"
+    q.write_text(p.read_text().replace(
+        "        # concurrency-ok[unguarded]: single boolean latch, one\n"
+        "        # writer; worst case the loop sees it a tick late\n",
+        "        # concurrency-ok[unguarded]:\n"))
+    _fresh_concurrency()
+    fs = con.scan_unguarded_shared_state(paths=[str(q)])
+    assert [f.check for f in fs] == ["concurrency.unguarded_shared_state"]
+
+
+def test_concurrency_fires_on_lock_order_cycle(tmp_path):
+    con = _fresh_concurrency()
+    p = tmp_path / "deadlock.py"
+    p.write_text(
+        "import threading\n"
+        "class Pair:\n"
+        "    def __init__(self):\n"
+        "        self._a = threading.Lock()\n"
+        "        self._b = threading.Lock()\n"
+        "    def forward(self):\n"
+        "        with self._a:\n"
+        "            with self._b:\n"
+        "                pass\n"
+        "    def backward(self):\n"
+        "        with self._b:\n"
+        "            with self._a:\n"
+        "                pass\n")
+    fs = con.scan_lock_order_cycles(paths=[str(p)])
+    assert [f.check for f in fs] == ["concurrency.lock_order_cycle"]
+    assert fs[0].severity == "error"
+    assert any("_a" in n for n in fs[0].details["cycle"])
+    assert any("_b" in n for n in fs[0].details["cycle"])
+    # one consistent order is clean
+    q = tmp_path / "ordered.py"
+    q.write_text(p.read_text().replace(
+        "        with self._b:\n            with self._a:\n",
+        "        with self._a:\n            with self._b:\n"))
+    _fresh_concurrency()
+    assert con.scan_lock_order_cycles(paths=[str(q)]) == []
+
+
+def test_concurrency_lock_order_cycle_through_calls(tmp_path):
+    """The inversion hides behind a method call: f holds A and calls g,
+    which takes B; h does the reverse.  Only the transitive (may-
+    acquire) propagation sees the cycle."""
+    con = _fresh_concurrency()
+    p = tmp_path / "indirect.py"
+    p.write_text(
+        "import threading\n"
+        "class Pair:\n"
+        "    def __init__(self):\n"
+        "        self._a = threading.Lock()\n"
+        "        self._b = threading.Lock()\n"
+        "    def f(self):\n"
+        "        with self._a:\n"
+        "            self.take_b()\n"
+        "    def take_b(self):\n"
+        "        with self._b:\n"
+        "            pass\n"
+        "    def h(self):\n"
+        "        with self._b:\n"
+        "            self.take_a()\n"
+        "    def take_a(self):\n"
+        "        with self._a:\n"
+        "            pass\n")
+    fs = con.scan_lock_order_cycles(paths=[str(p)])
+    assert [f.check for f in fs] == ["concurrency.lock_order_cycle"]
+
+
+def test_concurrency_fires_on_blocking_under_lock(tmp_path):
+    con = _fresh_concurrency()
+    p = tmp_path / "slow.py"
+    p.write_text(
+        "import threading\n"
+        "import time\n"
+        "import os\n"
+        "class Slow:\n"
+        "    def __init__(self):\n"
+        "        self._lock = threading.Lock()\n"
+        "    def nap(self):\n"
+        "        with self._lock:\n"
+        "            time.sleep(1.0)\n"
+        "    def sync(self, fh):\n"
+        "        with self._lock:\n"
+        "            os.fsync(fh.fileno())\n"
+        "    def fine(self):\n"
+        "        time.sleep(1.0)\n")
+    fs = con.scan_blocking_under_lock(paths=[str(p)])
+    assert {f.check for f in fs} == {"concurrency.blocking_under_lock"}
+    assert len(fs) == 2                          # nap + sync; fine is clean
+    assert all(f.severity == "error" for f in fs)
+    assert any("time.sleep" in f.message for f in fs)
+    assert any("fsync" in f.message for f in fs)
+    # waiver clears the site
+    q = tmp_path / "slow_ok.py"
+    q.write_text(p.read_text().replace(
+        "            time.sleep(1.0)\n    def sync",
+        "            # concurrency-ok[blocking]: test fixture says so\n"
+        "            time.sleep(1.0)\n    def sync").replace(
+        "            os.fsync(fh.fileno())\n",
+        "            # concurrency-ok[blocking]: test fixture says so\n"
+        "            os.fsync(fh.fileno())\n"))
+    _fresh_concurrency()
+    assert con.scan_blocking_under_lock(paths=[str(q)]) == []
+
+
+def test_concurrency_condition_wait_is_not_blocking(tmp_path):
+    """Condition.wait releases the lock it waits on — the one
+    legitimate 'blocking while holding' pattern (the scheduler's
+    _take_batch uses it)."""
+    con = _fresh_concurrency()
+    p = tmp_path / "cond.py"
+    p.write_text(
+        "import threading\n"
+        "class Q:\n"
+        "    def __init__(self):\n"
+        "        self._admit = threading.RLock()\n"
+        "        self._avail = threading.Condition(self._admit)\n"
+        "    def take(self):\n"
+        "        with self._avail:\n"
+        "            self._avail.wait(timeout=0.1)\n")
+    assert con.scan_blocking_under_lock(paths=[str(p)]) == []
+
+
+def test_concurrency_fires_on_signal_unsafe(tmp_path):
+    con = _fresh_concurrency()
+    p = tmp_path / "sig.py"
+    p.write_text(
+        "import signal\n"
+        "import threading\n"
+        "_lock = threading.Lock()\n"
+        "def _on_term(signum, frame):\n"
+        "    with _lock:\n"
+        "        pass\n"
+        "def install():\n"
+        "    signal.signal(signal.SIGTERM, _on_term)\n")
+    fs = con.scan_signal_unsafe(paths=[str(p)])
+    assert [f.check for f in fs] == ["concurrency.signal_unsafe"]
+    assert fs[0].severity == "error"
+    assert "_lock" in fs[0].message
+    # an RLock is reentrant: the interrupted main thread can re-acquire
+    q = tmp_path / "sig_ok.py"
+    q.write_text(p.read_text().replace("threading.Lock()",
+                                       "threading.RLock()"))
+    _fresh_concurrency()
+    assert con.scan_signal_unsafe(paths=[str(q)]) == []
+
+
+def test_concurrency_signal_unsafe_through_drain_hook(tmp_path):
+    """Drain hooks run inside the SIGTERM handler — a hook that grabs a
+    plain Lock one call deep is as unsafe as the handler doing it."""
+    con = _fresh_concurrency()
+    p = tmp_path / "hook.py"
+    p.write_text(
+        "import threading\n"
+        "from tclb_tpu.telemetry.live import register_drain_hook\n"
+        "_state = threading.Lock()\n"
+        "def _drain(reason):\n"
+        "    _cleanup()\n"
+        "def _cleanup():\n"
+        "    with _state:\n"
+        "        pass\n"
+        "def install():\n"
+        "    register_drain_hook('fixture', _drain)\n")
+    fs = con.scan_signal_unsafe(paths=[str(p)])
+    assert [f.check for f in fs] == ["concurrency.signal_unsafe"]
+    assert "_state" in fs[0].message
+
+
+def test_concurrency_shipped_tree_clean_and_wired():
+    """The real serving planes carry zero unwaived findings (every
+    waiver in-tree has a justification), and check_repo chains the
+    concurrency pass into the CI gate."""
+    con = _fresh_concurrency()
+    fs = con.check_concurrency()
+    assert fs == [], [f.message for f in fs]
+    import inspect
+    assert "check_concurrency" in inspect.getsource(hygiene.check_repo)
+
+
+def test_concurrency_static_graph_matches_design():
+    """The store two-lock split and the scheduler admission path give
+    exactly the documented acyclic order edges."""
+    con = _fresh_concurrency()
+    g = con.lock_order_graph()
+    assert "gateway.store.JobStore._lock" in \
+        g.get("gateway.store.JobStore._io_lock", set())
+    # the reverse edge must never appear: it would close the cycle
+    assert "gateway.store.JobStore._io_lock" not in \
+        g.get("gateway.store.JobStore._lock", set())
+    assert "serve.scheduler.Scheduler._lock" in \
+        g.get("serve.scheduler.Scheduler._admit", set())
+
+
+def test_cli_check_filter_and_codes(capsys):
+    rc = cli.main(["--check", "concurrency", "--format", "json"])
+    doc = json.loads(capsys.readouterr().out)
+    assert rc == 0
+    assert doc["models"] == {}                   # model analysis skipped
+    assert all(f["code"].startswith("concurrency.")
+               for f in doc["repo"])
+    # family prefix + exact id both parse; unknown names just match
+    # nothing (still exit 0 on a clean tree)
+    rc = cli.main(["--check",
+                   "concurrency.lock_order_cycle,hygiene.id_keyed_cache",
+                   "--format", "json"])
+    doc = json.loads(capsys.readouterr().out)
+    assert rc == 0
+
+
+def test_cli_changed_mode_runs(capsys):
+    # smoke: --changed must run the repo gate and exit cleanly whatever
+    # the work-tree state (the filter can only *hide* findings)
+    rc = cli.main(["--changed", "--format", "json"])
+    doc = json.loads(capsys.readouterr().out)
+    assert rc in (0, 1)
+    assert set(doc) == {"models", "repo", "summary"}

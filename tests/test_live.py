@@ -548,3 +548,47 @@ def test_drain_hook_unregister_is_exact():
     finally:
         live.unregister_drain_hook("gw", second)
     assert live.run_drain_hooks("x") is False
+
+
+def test_metrics_registry_concurrent_observe_and_scrape():
+    """Satellite stress for the registry lock discipline: 8 writer
+    threads x 10k events racing /metrics scrape threads.  Counters are
+    lock-guarded read-modify-write — any unguarded window would lose
+    increments; any iteration-during-mutation bug would raise in the
+    scrapers.  Asserts the exact total and zero exceptions."""
+    reg = live.registry()
+    n_threads, n_events = 8, 10_000
+    errors = []
+    done = threading.Event()
+
+    def writer(idx):
+        try:
+            for i in range(n_events):
+                live._observe({"kind": "stress", "idx": idx, "i": i})
+        except Exception as e:  # noqa: BLE001 — surfaced in the assert
+            errors.append(e)
+
+    def scraper():
+        try:
+            while not done.is_set():
+                text = reg.to_prometheus()
+                assert "tclb_events_total" in text or text == "" or True
+                reg.snapshot()
+        except Exception as e:  # noqa: BLE001
+            errors.append(e)
+
+    writers = [threading.Thread(target=writer, args=(i,))
+               for i in range(n_threads)]
+    scrapers = [threading.Thread(target=scraper) for _ in range(2)]
+    for t in scrapers + writers:
+        t.start()
+    for t in writers:
+        t.join(timeout=120.0)
+    done.set()
+    for t in scrapers:
+        t.join(timeout=30.0)
+    assert not errors, errors
+    assert all(not t.is_alive() for t in writers + scrapers)
+    snap = reg.snapshot()
+    assert snap["counters"]["tclb_events_total{kind=stress}"] == \
+        n_threads * n_events

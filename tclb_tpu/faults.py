@@ -36,6 +36,12 @@ unknown names so a typo cannot silently disable a chaos schedule):
                           checkpoint segment boundary: ``error`` hard-exits
                           the process (nonzero) — the crash the supervisor
                           must absorb without losing the job
+``pool.telemetry_relay``  worker-side relay flush of batched telemetry
+                          frames between solve chunks
+                          (``worker._TelemetryRelay.flush``): ``error`` /
+                          ``enospc`` / ``torn`` drop the batch (counted in
+                          ``pool.relay_dropped``), ``slow`` delays the
+                          flush — never the heartbeat, never the job
 ========================  ===================================================
 
 Modes: ``error`` raises :class:`InjectedFault`; ``enospc`` raises
@@ -88,6 +94,7 @@ POINTS = frozenset({
     "pool.heartbeat",
     "pool.ipc",
     "pool.worker_exit",
+    "pool.telemetry_relay",
 })
 
 MODES = frozenset({"error", "enospc", "torn", "slow"})

@@ -70,6 +70,10 @@ def add_gateway_arguments(p: argparse.ArgumentParser) -> None:
                    help="seconds without a worker heartbeat before the "
                    "supervisor declares it hung and restarts it "
                    "(with --workers)")
+    p.add_argument("--no-relay", action="store_true",
+                   help="disable the cross-process telemetry relay "
+                   "(workers stop forwarding iterate spans/events to "
+                   "the gateway's /metrics and trace; with --workers)")
     p.add_argument("--drain-grace", type=float, default=30.0,
                    help="seconds SIGTERM drain waits for in-flight jobs "
                    "to finish or park at a checkpoint before killing "
@@ -97,7 +101,8 @@ def run_gateway(args) -> int:
         from tclb_tpu.serve.pool import WorkerPool
         pool = WorkerPool(workers=workers,
                           heartbeat_timeout_s=args.heartbeat_timeout,
-                          autostart=False)
+                          autostart=False,
+                          relay=not getattr(args, "no_relay", False))
     svc = GatewayService(args.store, tenancy=tenancy,
                          queue_limit=args.queue_limit,
                          max_batch=args.max_batch,

@@ -10,6 +10,10 @@ A stdlib-threaded (``http.server.ThreadingHTTPServer``) API surface over
 * ``GET /v1/jobs/<id>``            — one record;
 * ``GET /v1/jobs/<id>/result?wait=N`` — outcome; ``wait`` long-polls on
   a plain event until the job is terminal (202 while in flight);
+* ``GET /v1/jobs/<id>/stream?wait=N&since=K`` — latest in-situ progress
+  sample (iteration / MLUPS / wall / opt-in downsampled reductions);
+  ``wait`` long-polls until a sample newer than ``since`` arrives or the
+  job goes terminal — a dashboard costs kilobytes, not field dumps;
 * ``DELETE /v1/jobs/<id>`` (or ``POST /v1/jobs/<id>/cancel``) — cancel;
 * ``GET /healthz``                 — liveness (200 while the process
   answers at all);
@@ -48,6 +52,8 @@ _INDEX = (b"tclb_tpu gateway\n"
           b"  GET    /v1/jobs                   list jobs\n"
           b"  GET    /v1/jobs/<id>              job record\n"
           b"  GET    /v1/jobs/<id>/result?wait=N  outcome (long-poll)\n"
+          b"  GET    /v1/jobs/<id>/stream?wait=N  latest progress sample "
+          b"(long-poll)\n"
           b"  DELETE /v1/jobs/<id>              cancel\n"
           b"  GET    /healthz                   liveness\n"
           b"  GET    /healthz/ready             readiness (503 draining)\n")
@@ -189,6 +195,15 @@ class _Handler(BaseHTTPRequestHandler):
                 wait = float((qs.get("wait") or ["0"])[0])
                 code, doc = self.service.result(parts[2], wait=wait,
                                                 auth_token=self._bearer())
+                self._send_json(code, doc)
+            elif parts[:2] == ["v1", "jobs"] and len(parts) == 4 \
+                    and parts[3] == "stream":
+                wait = float((qs.get("wait") or ["0"])[0])
+                since = (qs.get("since") or [None])[0]
+                code, doc = self.service.stream(
+                    parts[2], wait=wait,
+                    since=None if since is None else int(since),
+                    auth_token=self._bearer())
                 self._send_json(code, doc)
             elif not parts:
                 self._send(200, _INDEX, "text/plain; charset=utf-8")

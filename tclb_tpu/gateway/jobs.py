@@ -24,6 +24,7 @@ _KNOWN_KEYS = frozenset({
     "model", "shape", "niter", "params", "sweep", "precision",
     "storage_dtype", "storage_repr", "resumable", "checkpoint_every",
     "timeout_s", "tenant", "idempotency_key", "name", "digest",
+    "stream",
 })
 
 _PRECISIONS = ("f32", "f64")
@@ -62,6 +63,9 @@ class JobRecord:
     error_kind: Optional[str] = None
     # per-case outcome dicts ({name, settings, globals}) once done
     results: Optional[list] = None
+    # summed per-phase wall times from the workers (stage_s / solve_s /
+    # d2h_s) — the SLO breakdown stamped onto gateway.job_done
+    phases: Optional[dict] = None
 
     def work(self) -> int:
         """The admission-control cost of this job: cells x niter x cases."""
@@ -165,6 +169,20 @@ def validate_body(body: Any, known_models: Optional[list] = None) -> dict:
                  "submit one job per point instead")
     _require(isinstance(body.get("digest", False), bool),
              "'digest' must be a bool")
+    stream = body.get("stream", False)
+    _require(isinstance(stream, (bool, dict)),
+             "'stream' must be a bool or an object")
+    if isinstance(stream, dict):
+        bad = sorted(set(stream) - {"quantity", "max_dim"})
+        _require(not bad, f"'stream' unknown keys: {bad} "
+                 f"(accepted: ['max_dim', 'quantity'])")
+        qty = stream.get("quantity")
+        _require(qty is None or (isinstance(qty, str) and qty),
+                 "'stream.quantity' must be a non-empty string")
+        md = stream.get("max_dim")
+        _require(md is None or (isinstance(md, int)
+                                and not isinstance(md, bool) and md > 0),
+                 "'stream.max_dim' must be a positive int")
     timeout_s = body.get("timeout_s")
     _require(timeout_s is None
              or (isinstance(timeout_s, (int, float))

@@ -81,6 +81,11 @@ class GatewayService:
         self.checkpoint_keep = max(1, int(checkpoint_keep))
         self._work: queue.Queue[str] = queue.Queue()
         self._done_events: dict[str, threading.Event] = {}
+        # latest in-situ progress sample per job (seq-numbered); the
+        # /stream long-poll waits on the condition for a fresher one
+        self._progress: dict[str, dict] = {}
+        self._progress_cond = threading.Condition(
+            locks.make_lock("gateway.service.GatewayService._progress_cond"))
         self._cancel: set[str] = set()
         # scheduler job id -> (record id, case index) for async fan-in
         self._pending_cases: dict[int, tuple[str, int]] = {}
@@ -320,6 +325,54 @@ class GatewayService:
         if rec.status not in J.TERMINAL:
             return 202, {"job": rec.public()}
         return 200, {"job": rec.public(), "results": rec.results}
+
+    def stream(self, job_id: str, wait: Optional[float] = None,
+               since: Optional[int] = None,
+               auth_token: Optional[str] = None) -> tuple[int, dict]:
+        """The latest in-situ progress sample for a running job
+        (iteration / MLUPS / wall / opt-in downsampled reductions).
+        ``wait`` long-polls (bounded) until a sample with ``seq`` >
+        ``since`` arrives or the job goes terminal — a dashboard polls
+        this for kilobytes instead of field dumps.  Handler-thread safe:
+        plain dict reads under a condition, zero device work."""
+        rec = self.store.get(job_id)
+        denied = self._deny(rec, job_id, auth_token)
+        if denied is not None:
+            return denied
+        floor = int(since) if since is not None else 0
+        deadline = (time.monotonic() + min(float(wait), 300.0)
+                    if wait else None)
+        with self._progress_cond:
+            while True:
+                entry = self._progress.get(job_id)
+                if entry is not None and entry["seq"] > floor:
+                    break
+                if rec.status in J.TERMINAL or deadline is None:
+                    break
+                remaining = deadline - time.monotonic()
+                if remaining <= 0:
+                    break
+                self._progress_cond.wait(timeout=min(remaining, 1.0))
+                rec = self.store.get(job_id) or rec
+            entry = self._progress.get(job_id)
+        return 200, {"job_id": job_id, "status": rec.status,
+                     "seq": 0 if entry is None else entry["seq"],
+                     "progress": (None if entry is None
+                                  else entry["sample"])}
+
+    def _on_pool_progress(self, pj) -> None:
+        """Pool ``on_progress`` fan-in: stash the worker's latest sample
+        under the gateway record id and wake /stream long-polls."""
+        rec_id = pj.doc.get("job_id")
+        if rec_id is None or pj.progress is None:
+            return
+        sample = dict(pj.progress)
+        with self._progress_cond:
+            prev = self._progress.get(rec_id)
+            self._progress[rec_id] = {
+                "seq": (1 if prev is None else prev["seq"] + 1),
+                "sample": sample}
+            self._progress_cond.notify_all()
 
     def cancel(self, job_id: str,
                auth_token: Optional[str] = None) -> tuple[int, dict]:
@@ -613,7 +666,17 @@ class GatewayService:
                 "storage_repr": body.get("storage_repr"),
                 "params": params,
                 "timeout_s": body.get("timeout_s"),
-                "digest": bool(body.get("digest"))}
+                "digest": bool(body.get("digest")),
+                # cross-process trace context: the worker stamps relayed
+                # events with this record id + parent span, so one
+                # `telemetry report --job` timeline spans both processes
+                "job_id": rec.id,
+                "parent_span": f"gw-{rec.id}",
+                # progress frames are on by default for gateway jobs
+                # (cheap: a small JSON frame per solve chunk)
+                "progress": True}
+        if body.get("stream"):
+            base["stream"] = body["stream"]
         if rec.resumable:
             # validate_body guarantees resumable => exactly one case
             docs = [dict(base,
@@ -633,8 +696,10 @@ class GatewayService:
         rec.started_ts = _now()
         rec.touch()
         self.store.put(rec)
-        handles = [self._pool.submit(d) for d in docs]
+        handles = [self._pool.submit(d, on_progress=self._on_pool_progress)
+                   for d in docs]
         results, errors = [], []
+        phases: dict[str, float] = {}
         for i, (pj, doc) in enumerate(zip(handles, docs)):
             name = doc["case"]["name"]
             try:
@@ -645,6 +710,8 @@ class GatewayService:
                 results.append({"name": name, "error": repr(e)})
                 errors.append(repr(e))
                 continue
+            for k, v in (res.get("phases") or {}).items():
+                phases[k] = round(phases.get(k, 0.0) + float(v), 6)
             row = {"name": name,
                    "settings": doc["case"]["settings"],
                    "globals": res.get("globals") or {}}
@@ -664,6 +731,7 @@ class GatewayService:
                                     lane=res.get("lane"))
                     telemetry.counter("gateway.jobs.resumed")
         rec.results = results
+        rec.phases = phases or None
         if errors:
             rec.error = "; ".join(errors[:4])
         else:
@@ -798,11 +866,18 @@ class GatewayService:
         self._cancel.discard(rec.id)
         ev = self._done_events.setdefault(rec.id, threading.Event())
         ev.set()
+        # wake /stream long-polls so a terminal job answers immediately
+        with self._progress_cond:
+            self._progress_cond.notify_all()
         wait_s = (None if rec.started_ts is None
                   else round(rec.started_ts - rec.created_ts, 6))
+        ph = rec.phases or {}
         telemetry.event("gateway.job_done", job_id=rec.id,
                         tenant=rec.tenant, status=status,
                         queue_wait_s=wait_s,
+                        stage_s=ph.get("stage_s"),
+                        solve_s=ph.get("solve_s"),
+                        d2h_s=ph.get("d2h_s"),
                         wall_s=round(rec.finished_ts - rec.created_ts, 6),
                         resumed=rec.resumed_from is not None)
         telemetry.counter("gateway.jobs.done" if status == J.DONE

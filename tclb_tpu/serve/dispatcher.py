@@ -903,10 +903,12 @@ class FleetDispatcher:
                 self._redistribute(item.batch)
         lane.failstreak = 0
         lane.evicted = False
-        lane.start()
+        # emit BEFORE start(): once the lane threads run, a parked job can
+        # complete and its observer must already see the reinstatement
         telemetry.event("serve.device_reinstated", device=lane.device_str,
                         lane=lane.index)
         telemetry.counter("serve.device_reinstated")
+        lane.start()
         log.warning(f"fleet: lane {lane.index} ({lane.device_str}) "
                     "probed healthy; reinstated")
         return True

@@ -26,14 +26,29 @@ Supervision contract, per worker:
 Job specs and results cross the pipe as plain JSON + ``.npy`` payloads
 (never pickled device arrays).  Fault points fired on the supervisor
 side: ``pool.spawn`` (spawn attempt) and ``pool.ipc`` (frame send /
-result receive); ``pool.heartbeat`` / ``pool.worker_exit`` fire inside
-the worker — the installed plan crosses the process boundary because
-:func:`_spawn` re-serializes it into the child's ``TCLB_FAULTS``.
+result receive); ``pool.heartbeat`` / ``pool.worker_exit`` /
+``pool.telemetry_relay`` fire inside the worker — the installed plan
+crosses the process boundary because :func:`_spawn` re-serializes it
+into the child's ``TCLB_FAULTS``.
+
+Cross-process telemetry relay (on by default, ``relay=False`` to opt
+out): workers batch their telemetry events into ``{"t": "telemetry"}``
+frames between solve chunks, and the supervisor re-emits each event
+into the parent fan-out stamped with ``worker_pid`` / ``lane`` /
+``incarnation`` — so worker iterate spans, engine fallbacks, and
+failchecks reach the gateway's ``/metrics``, ``/status``, flight ring,
+and JSONL trace, and ``telemetry report --job <id>`` renders one
+timeline spanning both processes.  ``{"t": "progress"}`` frames land on
+the in-flight :class:`PoolJob` (``job.progress`` + ``on_progress``
+callback) for the gateway's ``/stream`` long-poll.  Unknown frame kinds
+are counted (``pool.unknown_frame``) and warned once per kind, so
+supervisor/worker protocol drift is visible.
 
 Monitor contract: the pool registers a ``pool`` ``/status`` provider
-(per-worker pid / state / restarts / last-heartbeat age) and attaches
-the flight recorder; every worker attaches its own recorder in-process,
-so a worker crash leaves its own ``flight-<pid>.jsonl``.
+(per-worker pid / state / restarts / last-heartbeat age + recent worker
+post-mortems with their ``flight-<pid>.jsonl`` paths) and attaches the
+flight recorder; every worker attaches its own recorder in-process, so
+a worker crash leaves its own dump.
 """
 
 from __future__ import annotations
@@ -62,14 +77,19 @@ class PoolJob:
     """Handle for one submitted job: wait on :meth:`result`."""
 
     def __init__(self, jid: str, doc: dict,
-                 on_done: Optional[Callable[["PoolJob"], None]] = None):
+                 on_done: Optional[Callable[["PoolJob"], None]] = None,
+                 on_progress: Optional[Callable[["PoolJob"], None]] = None):
         self.id = jid
         self.doc = doc
         self.attempts = 0
         self.status = "queued"
         self.error: Optional[BaseException] = None
+        #: latest worker progress sample (iter / mlups / wall_s
+        #: [/ reductions]) — updated in place as frames arrive
+        self.progress: Optional[dict] = None
         self._result: Optional[dict] = None
         self._on_done = on_done
+        self._on_progress = on_progress
         self._evt = threading.Event()
 
     @property
@@ -144,8 +164,12 @@ class WorkerPool:
                  stable_after_s: float = 30.0,
                  worker_cmd: Optional[list] = None,
                  env: Optional[dict] = None,
-                 autostart: bool = True) -> None:
+                 autostart: bool = True,
+                 relay: bool = True) -> None:
         self.n = max(1, int(workers))
+        #: ask workers to relay their telemetry events over the pipe
+        #: (TCLB_POOL_RELAY=1 at spawn); off = strict no-op worker-side
+        self.relay = bool(relay)
         self.heartbeat_timeout_s = float(heartbeat_timeout_s)
         self.spawn_timeout_s = float(spawn_timeout_s)
         self.term_grace_s = float(term_grace_s)
@@ -166,6 +190,8 @@ class WorkerPool:
         self._done = 0
         self._failed = 0
         self._requeued = 0
+        self._unknown_kinds: set = set()    # warned-once frame kinds
+        self._worker_dumps: list[dict] = []  # recent flight post-mortems
         self._status_fn = self._status
         if autostart:
             self.start()
@@ -222,15 +248,18 @@ class WorkerPool:
     # -- submission --------------------------------------------------------- #
 
     def submit(self, doc: dict,
-               on_done: Optional[Callable[[PoolJob], None]] = None
+               on_done: Optional[Callable[[PoolJob], None]] = None,
+               on_progress: Optional[Callable[[PoolJob], None]] = None
                ) -> PoolJob:
-        """Enqueue one plain-JSON job spec; returns a :class:`PoolJob`."""
+        """Enqueue one plain-JSON job spec; returns a :class:`PoolJob`.
+        ``on_progress`` fires on each worker progress frame with the
+        handle (latest sample on ``job.progress``)."""
         if self._closing:
             raise RuntimeError("pool is closed")
         with self._lock:
             self._jobs += 1
             jid = f"pj-{self._jobs}"
-        job = PoolJob(jid, dict(doc), on_done)
+        job = PoolJob(jid, dict(doc), on_done, on_progress)
         if self._started and all(w.state in ("dead", "stopped")
                                  for w in self._workers):
             # nobody will ever drain the queue: fail fast instead of
@@ -277,6 +306,10 @@ class WorkerPool:
         env = dict(os.environ)
         env.update(self.env)
         env["TCLB_POOL_LANE"] = str(w.lane)
+        if self.relay:
+            env["TCLB_POOL_RELAY"] = "1"
+        else:
+            env.pop("TCLB_POOL_RELAY", None)
         # the installed fault plan crosses the process boundary, so
         # worker-side points (pool.heartbeat / pool.worker_exit) fire
         # under the same seeded schedule
@@ -402,6 +435,10 @@ class WorkerPool:
             except Exception as e:  # noqa: BLE001 — IPC failure = lane
                 self._requeue(w, job, f"ipc send: {e!r}")   # failure
                 return self._reap(w, "ipc")
+            telemetry.event("serve.pool_job_started", job=job.id,
+                            job_id=job.doc.get("job_id"), lane=w.lane,
+                            pid=w.pid, incarnation=w.restarts,
+                            attempt=job.attempts)
             verdict = self._await_result(w, job)
             if verdict == "done":
                 w.jobs_done += 1
@@ -456,10 +493,56 @@ class WorkerPool:
                     with self._lock:
                         self._failed += 1
                 telemetry.event("serve.pool_job_done", job=job.id,
+                                job_id=job.doc.get("job_id"),
                                 lane=w.lane, ok=bool(doc.get("ok")),
                                 attempts=job.attempts)
                 return "done"
-            # unknown frame kinds are forward-compat noise: ignore
+            if t == "telemetry":
+                self._reemit(w, doc)
+                continue
+            if t == "progress" and doc.get("id") == job.id:
+                job.progress = {k: v for k, v in doc.items()
+                                if k not in ("t", "id")}
+                if job._on_progress is not None:
+                    try:
+                        job._on_progress(job)
+                    except Exception as e:  # noqa: BLE001 — advisory
+                        log.warning(
+                            f"pool: on_progress callback failed: {e!r}")
+                continue
+            # unknown frame kinds are protocol drift between supervisor
+            # and worker versions: count them, warn once per kind
+            telemetry.counter("pool.unknown_frame")
+            if t not in self._unknown_kinds:
+                self._unknown_kinds.add(t)
+                log.warning(f"pool: ignoring unknown IPC frame kind "
+                            f"{t!r} from lane {w.lane} (pid {w.pid})")
+
+    def _reemit(self, w: _Worker, doc: dict) -> None:
+        """Re-emit one relayed telemetry batch into the parent fan-out,
+        stamped with the worker's identity — this is what carries iterate
+        spans, fallbacks, and failchecks across the process boundary into
+        ``/metrics``, ``/status``, the flight ring, and the trace."""
+        evs = doc.get("events") or ()
+        dropped = doc.get("dropped") or 0
+        if dropped:
+            telemetry.counter("pool.relay_dropped", int(dropped))
+        if evs:
+            telemetry.counter("pool.relay_events", len(evs))
+        for ev in evs:
+            if not isinstance(ev, dict):
+                continue
+            fields = dict(ev)
+            kind = fields.pop("kind", None)
+            if not kind:
+                continue
+            # event() preserves a passed `ts`, so the worker's original
+            # timestamps survive re-emission and the merged timeline
+            # keeps true ordering
+            fields.setdefault("worker_pid", w.pid)
+            fields.setdefault("lane", w.lane)
+            fields.setdefault("incarnation", w.restarts)
+            telemetry.event(str(kind), **fields)
 
     def _requeue(self, w: _Worker, job: PoolJob, reason: str) -> None:
         """A job lost to a worker failure goes back in the queue (up to
@@ -485,6 +568,27 @@ class WorkerPool:
                 # its waiter on a queue nobody serves
                 self._fail_queued("pool is closed")
 
+    def _flight_path(self, pid: Optional[int]) -> Optional[str]:
+        """Where a dead worker's flight-recorder dump lands (same rule
+        as ``FlightRecorder.dump``: TCLB_FLIGHT_DIR, else cwd)."""
+        if pid is None:
+            return None
+        d = (self.env.get("TCLB_FLIGHT_DIR")
+             or os.environ.get("TCLB_FLIGHT_DIR") or os.getcwd())
+        return os.path.join(d, f"flight-{pid}.jsonl")
+
+    def _note_dump(self, w: _Worker, reason: str,
+                   flight: Optional[str]) -> None:
+        """Remember a dead worker's post-mortem for the ``/status``
+        provider, so triage doesn't hunt the flight dir by pid."""
+        rec = {"lane": w.lane, "pid": w.pid, "reason": reason,
+               "flight": (flight if flight and os.path.exists(flight)
+                          else None),
+               "ts": round(time.time(), 3)}
+        with self._lock:
+            self._worker_dumps.append(rec)
+            del self._worker_dumps[:-8]
+
     def _kill_proc(self, w: _Worker, reason: str) -> None:
         """SIGTERM-then-SIGKILL escalation (SIGTERM lets the worker's
         flight recorder dump its ring first)."""
@@ -500,9 +604,11 @@ class WorkerPool:
                 proc.wait(timeout=10)
             except subprocess.TimeoutExpired:  # pragma: no cover
                 pass
+        flight = self._flight_path(w.pid)
         telemetry.event("serve.worker_killed", lane=w.lane, pid=w.pid,
-                        reason=reason)
+                        reason=reason, flight=flight)
         telemetry.counter("pool.workers.killed")
+        self._note_dump(w, reason, flight)
 
     def _reap(self, w: _Worker, reason: str) -> str:
         w.state = "respawning"
@@ -510,11 +616,13 @@ class WorkerPool:
         if proc is not None and proc.poll() is None:
             self._kill_proc(w, reason)
         else:
+            flight = self._flight_path(w.pid)
             telemetry.event("serve.worker_exit", lane=w.lane, pid=w.pid,
                             returncode=(None if proc is None
                                         else proc.returncode),
-                            reason=reason)
+                            reason=reason, flight=flight)
             telemetry.counter("pool.workers.exited")
+            self._note_dump(w, reason, flight)
         for fh in (getattr(proc, "stdin", None),
                    getattr(proc, "stdout", None)):
             try:
@@ -567,6 +675,7 @@ class WorkerPool:
         with self._lock:
             jobs = {"submitted": self._jobs, "done": self._done,
                     "failed": self._failed, "requeued": self._requeued}
+            dumps = list(self._worker_dumps)
         return {
             "workers": [{
                 "lane": w.lane, "pid": w.pid, "state": w.state,
@@ -577,6 +686,7 @@ class WorkerPool:
             "live": self.live_workers(),
             "queue_depth": self._queue.qsize(),
             "jobs": jobs,
+            "worker_dumps": dumps,
             "heartbeat_timeout_s": self.heartbeat_timeout_s,
             "closing": self._closing,
         }

@@ -121,6 +121,9 @@ _META = {
     "tclb_pool_workers_restarted_total": ("counter",
                                           "Pool workers respawned after a "
                                           "crash or hang, by lane"),
+    "tclb_gateway_phase_seconds": ("histogram",
+                                   "Gateway job phase latency (queue_wait/"
+                                   "stage/solve/d2h/e2e), by phase"),
 }
 
 CONTENT_TYPE = "text/plain; version=0.0.4; charset=utf-8"
@@ -308,13 +311,24 @@ def _observe(doc: dict) -> None:
         name = doc.get("name")
         dur = doc.get("dur_s")
         if name == "iterate":
+            # relayed worker spans carry a worker_pid stamp; keep it as
+            # a label so per-process series survive worker restarts
+            wp = doc.get("worker_pid")
             if dur is not None:
-                reg.observe("tclb_iterate_seconds", dur)
+                if wp is not None:
+                    reg.observe("tclb_iterate_seconds", dur,
+                                worker_pid=str(wp))
+                else:
+                    reg.observe("tclb_iterate_seconds", dur)
             engine = str(doc.get("engine", "?"))
             model = str(doc.get("model", "?"))
             if doc.get("mlups") is not None:
-                reg.gauge("tclb_mlups", doc["mlups"],
-                          engine=engine, model=model)
+                if wp is not None:
+                    reg.gauge("tclb_mlups", doc["mlups"], engine=engine,
+                              model=model, worker_pid=str(wp))
+                else:
+                    reg.gauge("tclb_mlups", doc["mlups"],
+                              engine=engine, model=model)
             if doc.get("vs_roofline") is not None:
                 reg.gauge("tclb_vs_roofline", doc["vs_roofline"],
                           engine=engine)
@@ -325,13 +339,17 @@ def _observe(doc: dict) -> None:
                 if nodes:
                     reg.count("tclb_node_updates_total",
                               float(nodes) * float(iters))
-            reg.set_info("last_iterate", {
+            last = {
                 "engine": engine, "model": model,
                 "mlups": doc.get("mlups"),
                 "vs_roofline": doc.get("vs_roofline"),
                 "iteration": doc.get("iteration"),
                 "dur_s": dur, "ts": doc.get("ts"),
-            })
+            }
+            if wp is not None:
+                last["worker_pid"] = wp
+                last["lane"] = doc.get("lane")
+            reg.set_info("last_iterate", last)
         elif name in ("serve.batch", "serve.lane_batch"):
             if dur is not None:
                 reg.observe("tclb_batch_seconds", dur)
@@ -400,6 +418,17 @@ def _observe(doc: dict) -> None:
         if doc.get("queue_wait_s") is not None:
             reg.observe("tclb_gateway_queue_wait_seconds",
                         doc["queue_wait_s"])
+        # per-phase SLO histograms: one series per phase of the job's
+        # door-to-result path
+        for phase, field in (("queue_wait", "queue_wait_s"),
+                             ("stage", "stage_s"),
+                             ("solve", "solve_s"),
+                             ("d2h", "d2h_s"),
+                             ("e2e", "wall_s")):
+            v = doc.get(field)
+            if v is not None:
+                reg.observe("tclb_gateway_phase_seconds", float(v),
+                            phase=phase)
 
 
 def enable_live() -> MetricsRegistry:

@@ -250,6 +250,36 @@ def _faults_summary(evts: list[dict]) -> dict:
     }
 
 
+_SLO_PHASES = (("queue_wait", "queue_wait_s"), ("stage", "stage_s"),
+               ("solve", "solve_s"), ("d2h", "d2h_s"), ("e2e", "wall_s"))
+
+
+def _slo_summary(evts: list[dict]) -> dict:
+    """Per-phase latency distribution of finished gateway jobs (from
+    ``gateway.job_done`` events: queue-wait, stage, solve, d2h, and
+    door-to-result end-to-end).  Empty dict when the trace has no
+    finished gateway jobs."""
+    vals: dict[str, list] = {phase: [] for phase, _ in _SLO_PHASES}
+    for e in evts:
+        if e.get("kind") != "gateway.job_done":
+            continue
+        for phase, field in _SLO_PHASES:
+            v = e.get(field)
+            if v is not None:
+                vals[phase].append(float(v))
+    out: dict = {}
+    for phase, _ in _SLO_PHASES:
+        vs = vals[phase]
+        if not vs:
+            continue
+        out[phase] = {
+            "count": len(vs),
+            "p50_s": round(_percentile(vs, 0.50), 6),
+            "p95_s": round(_percentile(vs, 0.95), 6),
+            "max_s": round(max(vs), 6)}
+    return out
+
+
 def summarize(evts: list[dict]) -> dict:
     """Aggregate one trace into the report structure (all plain dicts,
     JSON-serializable as-is)."""
@@ -347,6 +377,7 @@ def summarize(evts: list[dict]) -> dict:
             "adjoint": _adjoint_summary(evts),
             "fleet": _fleet_summary(evts),
             "gateway": _gateway_summary(evts),
+            "slo": _slo_summary(evts),
             "faults": _faults_summary(evts),
             "engine_selected": [
                 {k: v for k, v in e.items() if k not in ("kind",)}
@@ -496,6 +527,28 @@ def compare(base: dict, other: dict, threshold: float = 0.05) -> dict:
                     "other": wb,
                     "delta_pct": row["queue_wait_p95_delta_pct"]})
         out["gateway"] = row
+    # per-phase SLO drift: a p95 that grew beyond the threshold names
+    # WHICH phase of the door-to-result path regressed (queue vs stage
+    # vs solve vs d2h) instead of just "jobs got slower"
+    sa = base.get("slo") or {}
+    sb = other.get("slo") or {}
+    if sa or sb:
+        rows: dict = {}
+        for phase in (p for p, _ in _SLO_PHASES
+                      if p in sa or p in sb):
+            pa = (sa.get(phase) or {}).get("p95_s")
+            pb = (sb.get(phase) or {}).get("p95_s")
+            row = {"base_p95_s": pa, "other_p95_s": pb}
+            if pa and pb is not None:
+                delta = (pb - pa) / pa
+                row["p95_delta_pct"] = round(100 * delta, 2)
+                if delta > threshold:
+                    out["regressions"].append({
+                        "what": "slo_phase_p95", "phase": phase,
+                        "base": pa, "other": pb,
+                        "delta_pct": row["p95_delta_pct"]})
+            rows[phase] = row
+        out["slo"] = rows
     # fallback-chain drift is a regression signal of its own (an engine
     # newly failing to compile shows up here before any timing does)
     fb_a = [(f.get("from"), f.get("to")) for f in base.get("fallbacks", [])]
@@ -536,6 +589,15 @@ _TIMELINE_VERBS = {
     "serve.job_degraded": "degraded",
     "serve.job_done": "done",
     "failcheck": "failcheck",
+    # gateway + pool verbs: with the cross-process relay, one --job
+    # timeline runs gateway door -> worker kernel and back
+    "gateway.admitted": "queued",
+    "gateway.resumed": "resumed",
+    "gateway.parked": "parked",
+    "gateway.job_done": "done",
+    "serve.pool_job_started": "worker-sent",
+    "serve.pool_job_requeued": "requeued",
+    "serve.pool_job_done": "pool-done",
 }
 
 
@@ -692,6 +754,20 @@ def format_text(summary: dict) -> str:
                     f"{_fmt(r['queue_wait_p50_s'], 4):>11} "
                     f"{_fmt(r['queue_wait_p95_s'], 4):>11}")
         lines.append("")
+    if summary.get("slo"):
+        slo = summary["slo"]
+        lines.append("gateway SLO (per-phase latency)")
+        lines.append(f"  {'phase':<14} {'jobs':>6} {'p50_s':>10} "
+                     f"{'p95_s':>10} {'max_s':>10}")
+        for phase, _ in _SLO_PHASES:
+            r = slo.get(phase)
+            if r is None:
+                continue
+            lines.append(f"  {phase:<14} {r['count']:>6} "
+                         f"{_fmt(r['p50_s'], 4):>10} "
+                         f"{_fmt(r['p95_s'], 4):>10} "
+                         f"{_fmt(r['max_s'], 4):>10}")
+        lines.append("")
     if summary.get("faults"):
         fa = summary["faults"]
         lines.append("injected faults (chaos)")
@@ -783,6 +859,13 @@ def format_compare_text(diff: dict) -> str:
             "queue wait p95 "
             f"{_fmt(gw['base_queue_wait_p95_s'], 4)}s -> "
             f"{_fmt(gw['other_queue_wait_p95_s'], 4)}s")
+    if diff.get("slo"):
+        for phase, row in diff["slo"].items():
+            d = row.get("p95_delta_pct")
+            lines.append(
+                f"  slo {phase}: p95 {_fmt(row['base_p95_s'], 4)}s -> "
+                f"{_fmt(row['other_p95_s'], 4)}s"
+                + (f" ({_fmt(d, 2)}%)" if d is not None else ""))
     if diff.get("fallback_drift"):
         lines.append("  fallback drift: "
                      f"base={diff['fallback_drift']['base']} "

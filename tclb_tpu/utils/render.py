@@ -57,6 +57,21 @@ def write_png(path: str, rgb: np.ndarray) -> str:
     return path
 
 
+def downsample(plane: np.ndarray, max_dim: int = 32) -> np.ndarray:
+    """Stride-sampled coarse view of a 2D plane, at most ``max_dim``
+    points per axis — the kilobyte-sized in-situ extract a worker
+    streams over its job progress channel instead of a full-field dump
+    (the relay analogue of Catalyst's downsampled co-processing view)."""
+    plane = np.asarray(plane)
+    if plane.ndim != 2:
+        raise ValueError(
+            f"downsample expects a 2D plane, got shape {plane.shape}")
+    max_dim = max(1, int(max_dim))
+    sy = max(1, -(-plane.shape[0] // max_dim))
+    sx = max(1, -(-plane.shape[1] // max_dim))
+    return plane[::sy, ::sx]
+
+
 def render_frame(path: str, plane: np.ndarray,
                  vmin=None, vmax=None) -> str:
     """Render a 2D scalar plane to a PNG (row 0 at the bottom, like the

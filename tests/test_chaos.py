@@ -422,16 +422,17 @@ while True:
 
 
 def test_pool_points_registered_and_spec_roundtrips():
-    """All four pool.* injection points are in the authoritative
+    """All five pool.* injection points are in the authoritative
     registry (a typo cannot silently disable a schedule) and a combined
     schedule round-trips through to_spec — the serialization that
     carries a plan into worker subprocesses."""
     for point in ("pool.spawn", "pool.heartbeat", "pool.ipc",
-                  "pool.worker_exit"):
+                  "pool.worker_exit", "pool.telemetry_relay"):
         assert point in faults.POINTS
     plan = FaultPlan.parse(
         "seed=42;pool.spawn:error:n=1;pool.heartbeat:error:n=1:after=3;"
-        "pool.ipc:error:n=1:after=1;pool.worker_exit:error:n=1:after=2")
+        "pool.ipc:error:n=1:after=1;pool.worker_exit:error:n=1:after=2;"
+        "pool.telemetry_relay:torn:n=2")
     assert FaultPlan.parse(plan.to_spec()) == plan
     with pytest.raises(ValueError, match="unknown injection point"):
         FaultPlan.parse("pool.nonsense:error")
@@ -517,6 +518,54 @@ def test_pool_worker_exit_schedule_blast_radius(tmp_path):
     finally:
         pool.close()
         faults.uninstall()
+
+
+@pytest.mark.slow
+@pytest.mark.parametrize("mode", ["error", "slow", "torn"])
+def test_pool_telemetry_relay_schedule_never_blocks_jobs(tmp_path, mode):
+    """Seeded pool.telemetry_relay schedules (the wedged-relay chaos
+    contract): injected relay faults in REAL workers drop telemetry
+    batches (observable via pool.relay_dropped) but never block a
+    heartbeat, lose a job, or perturb the solve — results stay
+    bit-identical to a clean run and the watchdog never fires a
+    missed-heartbeat false positive."""
+    from tclb_tpu.serve.pool import WorkerPool
+    base = {"model": "d2q9", "shape": [8, 16], "niter": 30,
+            "params": {"nu": 0.05}, "digest": True,
+            "case": {"name": "r", "settings": {}}}
+    with WorkerPool(workers=1, autostart=False, relay=False) as pool:
+        ref_sha = pool.submit(dict(base)).result(
+            timeout=600)["state_sha256"]
+
+    evts = []
+    telemetry.subscribe(evts.append)
+    clause = {"error": "pool.telemetry_relay:error:p=0.6:n=4",
+              "slow": "pool.telemetry_relay:slow:delay=0.05",
+              "torn": "pool.telemetry_relay:torn:p=0.6:n=4"}[mode]
+    faults.install(FaultPlan.parse(f"seed=88;{clause}"))
+    pool = WorkerPool(workers=1, autostart=False)
+    try:
+        jobs = pool.run([dict(base), dict(base)], timeout=600)
+        assert [j.status for j in jobs] == ["done", "done"]
+        for j in jobs:
+            assert j._result["state_sha256"] == ref_sha
+        # relay loss never masquerades as a hang: the watchdog stayed
+        # quiet and nothing was requeued or restarted
+        assert not [e for e in evts
+                    if e.get("kind") == "serve.worker_hung"]
+        st = pool.stats()
+        assert st["requeued"] == 0 and st["restarts"] == 0
+        from tclb_tpu.telemetry import events as tevents
+        ctrs = tevents.counters()
+        if mode in ("error", "torn"):
+            # the dropped batches were counted, and later frames still
+            # made it across (the relay recovers between injections)
+            assert ctrs.get("pool.relay_dropped", 0) >= 1
+        assert ctrs.get("pool.relay_events", 0) >= 1
+    finally:
+        pool.close()
+        faults.uninstall()
+        telemetry.unsubscribe(evts.append)
 
 
 @pytest.mark.slow

@@ -483,7 +483,8 @@ def _spawn_cli_gateway(tmp_path, store, tag, workers=2, extra=()):
     """Start ``python -m tclb_tpu gateway --workers N`` and parse the
     gateway + monitor URLs it prints."""
     env = dict(os.environ, JAX_PLATFORMS="cpu", PYTHONPATH=REPO,
-               TCLB_FLIGHT_DIR=str(tmp_path / f"flight-{tag}"))
+               TCLB_FLIGHT_DIR=str(tmp_path / f"flight-{tag}"),
+               TCLB_TELEMETRY=str(tmp_path / f"trace-{tag}.jsonl"))
     logf = open(tmp_path / f"gateway-{tag}.log", "w+")
     proc = subprocess.Popen(
         [sys.executable, "-m", "tclb_tpu", "gateway",
@@ -594,6 +595,30 @@ def test_gateway_pool_worker_sigkill_resume_bit_identical(tmp_path):
         _, snap, _ = _http(urls["monitor"] + "/status")
         assert sum(w["restarts"]
                    for w in snap["pool"]["workers"]) >= 1
+        # cross-process relay: worker-originated iterate metrics reach
+        # the GATEWAY's /metrics, labelled by the worker pid
+        with urllib.request.urlopen(urls["monitor"] + "/metrics",
+                                    timeout=30) as resp:
+            metrics = resp.read().decode()
+        assert 'tclb_iterate_seconds_count{worker_pid="' in metrics
+        assert 'tclb_gateway_phase_seconds_count{phase="solve"}' \
+            in metrics
+        # ... and the JSONL trace stitches ONE timeline for the job:
+        # worker iterate spans from BOTH incarnations (before and after
+        # the SIGKILL), keyed by the gateway record id
+        from tclb_tpu.telemetry import report
+        evts = report.load(str(tmp_path / "trace-a.jsonl"))
+        je = report.job_events(evts, jid)
+        pids = {e["worker_pid"] for e in je
+                if e.get("kind") == "span" and e.get("name") == "iterate"
+                and e.get("worker_pid") is not None}
+        assert len(pids) >= 2, \
+            f"expected iterate spans from 2 worker incarnations: {pids}"
+        kinds = {e.get("kind") for e in je}
+        assert {"gateway.admitted", "serve.pool_job_started",
+                "gateway.resumed", "gateway.job_done"} <= kinds
+        done = next(e for e in je if e.get("kind") == "gateway.job_done")
+        assert done.get("solve_s") is not None
     finally:
         proc.kill()
         proc.wait()

@@ -1,0 +1,531 @@
+"""Cross-process telemetry relay + in-situ streaming tests.
+
+Fast coverage of the observability pipe between worker subprocesses and
+the supervisor: the bounded worker-side relay queue (`_TelemetryRelay`
+backpressure, faulted-flush containment, torn-frame discipline), the
+supervisor re-emit (worker identity stamping, preserved timestamps,
+``pool.relay_dropped`` / ``pool.relay_events`` accounting), progress
+frame routing onto :class:`PoolJob` handles, unknown-frame counting,
+worker post-mortem harvesting into ``/status``, the relay-off strict
+no-op, and the gateway's ``/v1/jobs/<id>/stream`` long-poll — all
+against stub workers speaking the frame protocol (no solver imports),
+so the whole suite runs in milliseconds.
+"""
+
+import io
+import json
+import os
+import sys
+import threading
+import time
+import urllib.request
+
+import pytest
+
+from tclb_tpu import faults, telemetry
+from tclb_tpu.faults import FaultPlan
+from tclb_tpu.serve.pool import PoolJobError, WorkerPool
+from tclb_tpu.serve.retry import RetryPolicy
+from tclb_tpu.serve.worker import _TelemetryRelay, read_frame
+from tclb_tpu.telemetry import events, live
+
+
+@pytest.fixture(autouse=True)
+def _clean():
+    telemetry.disable()
+    live.registry().reset()
+    faults.uninstall()
+    yield
+    faults.uninstall()
+    telemetry.disable()
+    live.registry().reset()
+
+
+# --------------------------------------------------------------------------- #
+# Worker-side relay unit: bounded queue, contained faults, torn frames
+# --------------------------------------------------------------------------- #
+
+
+def test_relay_queue_cap_honored_and_drops_counted():
+    relay = _TelemetryRelay(lane=0, cap=4)
+    for i in range(7):
+        relay.sink({"kind": "span", "name": "iterate", "i": i})
+    assert len(relay) == 4                      # cap, not 7
+    assert relay.dropped_total == 3
+    buf = io.BytesIO()
+    relay.flush(buf, "pj-1", "gw-job-1", "gw-span")
+    buf.seek(0)
+    doc, payload = read_frame(buf)
+    assert doc["t"] == "telemetry" and doc["id"] == "pj-1"
+    assert len(doc["events"]) == 4 and doc["dropped"] == 3
+    assert payload == b""
+    # every relayed doc carries the cross-process trace context
+    for ev in doc["events"]:
+        assert ev["job_id"] == "gw-job-1"
+        assert ev["parent_span"] == "gw-span"
+    # drained: an empty relay writes no frame at all
+    buf2 = io.BytesIO()
+    relay.flush(buf2, "pj-1", "gw-job-1")
+    assert buf2.getvalue() == b""
+
+
+def test_relay_skips_counters_snapshots():
+    """Counter snapshots stay worker-local — the parent folds its own
+    counter sessions, and relaying a child's cumulative snapshot would
+    double-count in `telemetry report`."""
+    relay = _TelemetryRelay(lane=0)
+    relay.sink({"kind": "counters", "counters": {"x": 1}})
+    relay.sink({"kind": "span", "name": "iterate"})
+    assert len(relay) == 1
+
+
+def test_relay_faulted_flush_drops_batch_never_raises():
+    """The pool.telemetry_relay chaos point: an error-mode injection
+    drops that flush's batch (counted), the relay keeps working, and
+    the loss is re-reported on the next successful frame."""
+    faults.install(FaultPlan.parse("seed=9;pool.telemetry_relay:error:n=1"))
+    relay = _TelemetryRelay(lane=0)
+    relay.sink({"kind": "span", "name": "iterate"})
+    relay.sink({"kind": "failcheck"})
+    buf = io.BytesIO()
+    relay.flush(buf, "pj-1", "t-1")             # injected: must not raise
+    assert buf.getvalue() == b""                # nothing written
+    assert relay.dropped_total == 2
+    relay.sink({"kind": "span", "name": "iterate"})
+    buf2 = io.BytesIO()
+    relay.flush(buf2, "pj-1", "t-1")            # budget spent: clean
+    buf2.seek(0)
+    doc, _ = read_frame(buf2)
+    assert len(doc["events"]) == 1
+    assert doc["dropped"] == 2                  # the loss is observable
+
+
+def test_relay_torn_mode_writes_no_partial_frame():
+    """Torn mode must write NOTHING: a half frame would desync the
+    whole pipe, so the contained truncation drops the batch instead."""
+    faults.install(FaultPlan.parse("seed=9;pool.telemetry_relay:torn:n=1"))
+    relay = _TelemetryRelay(lane=0)
+    relay.sink({"kind": "span", "name": "iterate"})
+    buf = io.BytesIO()
+    relay.flush(buf, "pj-1", "t-1")
+    assert buf.getvalue() == b""
+    assert relay.dropped_total == 1
+
+
+def test_relay_write_failure_contained():
+    class _Broken:
+        def write(self, b):
+            raise OSError("pipe gone")
+
+        def flush(self):
+            pass
+
+    relay = _TelemetryRelay(lane=0)
+    relay.sink({"kind": "span"})
+    relay.flush(_Broken(), "pj-1", "t-1")       # must not raise
+    assert relay.dropped_total == 1
+
+
+def test_relay_off_is_strict_noop_in_worker_main():
+    """Without TCLB_POOL_RELAY the worker builds no relay at all — no
+    queue, no subscriber, no clock reads.  Asserted at the seam the
+    worker main() gates on, plus: subscribing a relay sink is what flips
+    the telemetry gate, so no-relay keeps events.enabled() False."""
+    assert not events.enabled()
+    relay = _TelemetryRelay(lane=0)
+    events.subscribe(relay.sink)
+    try:
+        assert events.enabled()
+    finally:
+        events.unsubscribe(relay.sink)
+    assert not events.enabled()
+
+
+# --------------------------------------------------------------------------- #
+# Supervisor side, against a stub worker speaking the frame protocol
+# --------------------------------------------------------------------------- #
+
+RELAY_STUB = """
+import json, os, struct, sys, time
+H = struct.Struct("!II")
+out = os.fdopen(os.dup(1), "wb"); os.dup2(2, 1)
+inp = os.fdopen(os.dup(0), "rb")
+lane = int(sys.argv[sys.argv.index("--lane") + 1])
+RELAY = os.environ.get("TCLB_POOL_RELAY") == "1"
+
+def send(doc):
+    body = json.dumps(doc).encode()
+    out.write(H.pack(len(body), 0)); out.write(body); out.flush()
+
+def recv():
+    h = inp.read(H.size)
+    if len(h) < H.size:
+        raise EOFError
+    bl, pl = H.unpack(h)
+    doc = json.loads(inp.read(bl).decode())
+    inp.read(pl)
+    return doc
+
+send({"t": "ready", "pid": os.getpid(), "lane": lane})
+while True:
+    try:
+        doc = recv()
+    except EOFError:
+        sys.exit(0)
+    if doc.get("t") == "shutdown":
+        sys.exit(0)
+    if doc.get("t") != "job":
+        continue
+    jid, spec = doc["id"], doc.get("spec") or {}
+    send({"t": "hb", "id": jid})
+    if RELAY and spec.get("events"):            # honest worker: relays
+        send({"t": "telemetry", "id": jid,      # only when asked to
+              "events": spec["events"],
+              "dropped": spec.get("dropped", 0)})
+    if spec.get("progress") or spec.get("stream"):
+        niter = spec.get("niter", 2)
+        for i in range(1, 3):
+            fr = {"t": "progress", "id": jid, "iter": i, "niter": niter,
+                  "wall_s": 0.01 * i, "mlups": 1.5 * i}
+            if spec.get("stream"):
+                fr["reductions"] = {"quantity": "rho", "mean": 1.0,
+                                    "min": 0.9, "max": 1.1,
+                                    "shape": [2, 2],
+                                    "data": [[1.0, 1.0], [1.0, 1.0]]}
+            send(fr)
+            time.sleep(0.02)
+    for fr in spec.get("frames") or []:
+        fr = dict(fr); fr.setdefault("id", jid); send(fr)
+    if spec.get("behave") == "crash":
+        os._exit(3)
+    gate = os.environ.get("STUB_GATE")
+    while gate and not os.path.exists(gate):
+        send({"t": "hb", "id": jid})            # stay live while held
+        time.sleep(0.05)
+    send({"t": "result", "id": jid, "ok": True, "lane": lane,
+          "pid": os.getpid(), "relay_env": RELAY,
+          "globals": {"x": 1.0}, "iteration": spec.get("niter", 0),
+          "phases": {"stage_s": 0.01, "solve_s": 0.2, "d2h_s": 0.001}})
+"""
+
+
+@pytest.fixture()
+def stub_cmd(tmp_path):
+    script = tmp_path / "relay_stub.py"
+    script.write_text(RELAY_STUB)
+    return [sys.executable, str(script)]
+
+
+def _fast_pool(stub_cmd, **kw):
+    kw.setdefault("workers", 1)
+    kw.setdefault("heartbeat_timeout_s", 3.0)
+    kw.setdefault("spawn_timeout_s", 30.0)
+    kw.setdefault("term_grace_s", 0.5)
+    kw.setdefault("stable_after_s", 0.2)
+    kw.setdefault("retry_policy",
+                  RetryPolicy(max_attempts=4, base_delay_s=0.02,
+                              max_delay_s=0.1))
+    return WorkerPool(worker_cmd=stub_cmd, autostart=False, **kw)
+
+
+def test_reemit_stamps_worker_identity_and_preserves_ts(stub_cmd):
+    """Relayed events re-enter the parent fan-out stamped with the
+    worker's pid / lane / incarnation, with the worker's original
+    timestamps intact — the merged timeline keeps true ordering."""
+    seen = []
+    telemetry.subscribe(seen.append)
+    try:
+        worker_events = [
+            {"kind": "span", "name": "iterate", "ts": 123.456,
+             "dur_s": 0.5, "mlups": 2.0, "job_id": "gw-1",
+             "parent_span": "gw-span-1"},
+            {"kind": "engine_selected", "ts": 123.001, "engine": "xla"},
+        ]
+        with _fast_pool(stub_cmd) as pool:
+            job = pool.submit({"events": worker_events, "dropped": 5})
+            res = job.result(timeout=60)
+        iterate = [e for e in seen if e.get("kind") == "span"
+                   and e.get("name") == "iterate"]
+        assert len(iterate) == 1
+        ev = iterate[0]
+        assert ev["worker_pid"] == res["pid"]
+        assert ev["lane"] == 0 and ev["incarnation"] == 0
+        assert ev["ts"] == 123.456              # original ts survives
+        assert ev["job_id"] == "gw-1"
+        assert ev["parent_span"] == "gw-span-1"
+        sel = [e for e in seen if e.get("kind") == "engine_selected"]
+        assert sel and sel[0]["ts"] == 123.001
+        ctrs = events.counters()
+        assert ctrs.get("pool.relay_events") == 2
+        assert ctrs.get("pool.relay_dropped") == 5
+    finally:
+        telemetry.unsubscribe(seen.append)
+
+
+def test_unknown_frame_kind_counted_and_warned_once(stub_cmd):
+    """Protocol drift (a frame kind this supervisor doesn't know) is
+    counted and warned once per kind — and never fails the job."""
+    seen = []
+    telemetry.subscribe(seen.append)
+    try:
+        with _fast_pool(stub_cmd) as pool:
+            job = pool.submit({"frames": [{"t": "bogus", "x": 1},
+                                          {"t": "bogus", "x": 2},
+                                          {"t": "wat"}]})
+            assert job.result(timeout=60)["globals"] == {"x": 1.0}
+            assert pool._unknown_kinds == {"bogus", "wat"}
+        assert events.counters().get("pool.unknown_frame") == 3
+    finally:
+        telemetry.unsubscribe(seen.append)
+
+
+def test_progress_frames_land_on_job_and_callback(stub_cmd):
+    samples = []
+    with _fast_pool(stub_cmd) as pool:
+        job = pool.submit({"progress": True, "niter": 2},
+                          on_progress=lambda j: samples.append(
+                              dict(j.progress)))
+        job.result(timeout=60)
+    assert len(samples) == 2
+    assert [s["iter"] for s in samples] == [1, 2]
+    assert all("t" not in s and "id" not in s for s in samples)
+    assert job.progress["iter"] == 2 and job.progress["mlups"] == 3.0
+
+
+def test_progress_callback_error_never_fails_job(stub_cmd):
+    def bad(_):
+        raise RuntimeError("dashboard died")
+
+    with _fast_pool(stub_cmd) as pool:
+        job = pool.submit({"progress": True}, on_progress=bad)
+        assert job.result(timeout=60)["globals"] == {"x": 1.0}
+
+
+def test_relay_env_set_by_default_and_cleared_on_opt_out(stub_cmd):
+    """relay=True (the default) asks workers to relay via
+    TCLB_POOL_RELAY=1; relay=False must clear it even if it leaked into
+    the supervisor's own environment — the worker-side strict no-op."""
+    with _fast_pool(stub_cmd) as pool:
+        assert pool.submit({}).result(timeout=60)["relay_env"] is True
+    seen = []
+    telemetry.subscribe(seen.append)
+    try:
+        with _fast_pool(stub_cmd, relay=False,
+                        env={"TCLB_POOL_RELAY": "1"}) as pool:
+            job = pool.submit({"events": [{"kind": "span",
+                                           "name": "iterate"}]})
+            assert job.result(timeout=60)["relay_env"] is False
+        # no telemetry frames -> nothing re-emitted, nothing counted
+        assert not [e for e in seen if e.get("kind") == "span"]
+        assert "pool.relay_events" not in events.counters()
+    finally:
+        telemetry.unsubscribe(seen.append)
+
+
+def test_worker_crash_harvests_flight_dump(stub_cmd, tmp_path):
+    """A dead worker's post-mortem is harvested: the exit event carries
+    its flight-<pid>.jsonl path and the pool /status provider lists the
+    recent dumps, so triage never hunts the flight dir by pid."""
+    seen = []
+    telemetry.subscribe(seen.append)
+    try:
+        with _fast_pool(stub_cmd, job_attempts=1,
+                        env={"TCLB_FLIGHT_DIR": str(tmp_path)}) as pool:
+            pool.start()
+            deadline = time.monotonic() + 30
+            while pool.live_workers() < 1 \
+                    and time.monotonic() < deadline:
+                time.sleep(0.02)
+            pid = pool._workers[0].pid
+            assert pid is not None
+            # the stub attaches no recorder; fake the dump it would leave
+            flight = tmp_path / f"flight-{pid}.jsonl"
+            flight.write_text('{"kind": "flight_dump"}\n')
+            job = pool.submit({"behave": "crash"})
+            with pytest.raises(PoolJobError):
+                job.result(timeout=60)
+            deadline = time.monotonic() + 30
+            while not pool._status()["worker_dumps"] \
+                    and time.monotonic() < deadline:
+                time.sleep(0.02)
+            dumps = pool._status()["worker_dumps"]
+            assert any(d["pid"] == pid and d["flight"] == str(flight)
+                       for d in dumps)
+        exits = [e for e in seen if e.get("kind") == "serve.worker_exit"
+                 and e.get("pid") == pid]
+        assert exits and exits[0]["flight"] == str(flight)
+    finally:
+        telemetry.unsubscribe(seen.append)
+
+
+# --------------------------------------------------------------------------- #
+# Phase metrics: worker_pid labels + the gateway phase histogram
+# --------------------------------------------------------------------------- #
+
+
+def test_registry_labels_worker_iterate_spans_and_phase_histogram():
+    live.enable_live()
+    try:
+        telemetry.event("span", name="iterate", dur_s=0.25, iters=10,
+                        mlups=3.5, engine="xla", model="d2q9",
+                        iteration=10, worker_pid=4242, lane=1)
+        telemetry.event("gateway.job_done", job_id="j1", status="done",
+                        queue_wait_s=0.5, stage_s=0.1, solve_s=2.0,
+                        d2h_s=0.01, wall_s=2.7)
+        text = live.prometheus_text()
+        assert 'tclb_iterate_seconds_count{worker_pid="4242"} 1' in text
+        assert ('tclb_mlups{engine="xla",model="d2q9",'
+                'worker_pid="4242"} 3.5') in text
+        for phase in ("queue_wait", "stage", "solve", "d2h", "e2e"):
+            assert ('tclb_gateway_phase_seconds_count{phase="%s"} 1'
+                    % phase) in text
+        snap = live.registry().snapshot()
+        info = snap["info"]["last_iterate"]
+        assert info["worker_pid"] == 4242 and info["lane"] == 1
+    finally:
+        live.disable_live()
+
+
+def test_report_slo_table_and_compare_regression(tmp_path):
+    from tclb_tpu.telemetry import report
+
+    def _trace(path, solve_s):
+        telemetry.enable(str(path))
+        for i in range(4):
+            telemetry.event("gateway.job_done", job_id=f"j{i}",
+                            status="done", queue_wait_s=0.1,
+                            stage_s=0.2, solve_s=solve_s,
+                            d2h_s=0.01, wall_s=solve_s + 0.31)
+        telemetry.disable()
+        return report.summarize(report.load(str(path)))
+
+    base = _trace(tmp_path / "base.jsonl", 1.0)
+    slow = _trace(tmp_path / "slow.jsonl", 2.0)
+    assert base["slo"]["solve"]["count"] == 4
+    assert base["slo"]["solve"]["p95_s"] == pytest.approx(1.0)
+    assert base["slo"]["e2e"]["p50_s"] == pytest.approx(1.31)
+    cmp = report.compare(base, slow, threshold=0.2)
+    slo_regs = [r for r in cmp["regressions"]
+                if r["what"] == "slo_phase_p95"]
+    assert {r["phase"] for r in slo_regs} >= {"solve", "e2e"}
+    text = report.format_text(base)
+    assert "gateway SLO" in text and "solve" in text
+    ctext = report.format_compare_text(cmp)
+    assert "slo solve" in ctext
+
+
+# --------------------------------------------------------------------------- #
+# Gateway /stream long-poll (stub-backed pool: no jax, no solver)
+# --------------------------------------------------------------------------- #
+
+
+def _stream_body():
+    return {"model": "d2q9", "shape": [8, 16], "niter": 2,
+            "stream": {"quantity": "rho", "max_dim": 4}}
+
+
+def test_gateway_stream_long_poll_and_terminal_sample(stub_cmd, tmp_path):
+    from tclb_tpu.gateway.http import GatewayServer
+    from tclb_tpu.gateway.service import GatewayService
+
+    pool = _fast_pool(stub_cmd)
+    svc = GatewayService(str(tmp_path / "store"), pool=pool)
+    with GatewayServer(svc, port=0) as srv:
+        code, doc = svc.submit(_stream_body())
+        assert code == 202, doc
+        jid = doc["job"]["id"]
+        code, doc = svc.stream(jid, wait=60)
+        assert code == 200
+        assert doc["seq"] >= 1 and doc["progress"]["iter"] >= 1
+        assert doc["progress"]["reductions"]["quantity"] == "rho"
+        first_seq = doc["seq"]
+        code, res = svc.result(jid, wait=120)
+        assert code == 200 and res["job"]["status"] == "done"
+        # after terminal: the last sample is retained, seq monotonic
+        code, doc = svc.stream(jid, since=0)
+        assert code == 200 and doc["status"] == "done"
+        assert doc["seq"] >= first_seq
+        assert doc["progress"]["iter"] == 2
+        # the HTTP route serves the same document
+        with urllib.request.urlopen(
+                srv.url + f"/v1/jobs/{jid}/stream?wait=5&since=0",
+                timeout=30) as resp:
+            assert resp.status == 200
+            got = json.loads(resp.read())
+        assert got["job_id"] == jid and got["progress"]["iter"] == 2
+        # phases summed off the worker results land on the record
+        assert res["job"]["phases"]["solve_s"] == pytest.approx(0.2)
+        # unknown job: a clean 404, not a hang
+        code, doc = svc.stream("nope", wait=0)
+        assert code == 404
+
+
+def test_gateway_stream_wakes_on_terminal_when_no_newer_sample(
+        stub_cmd, tmp_path):
+    """A long-poll waiting for a sample newer than the latest one is
+    woken by job completion (instead of sleeping out its full wait
+    budget): the stub holds its result frame behind a gate file while
+    the poll is in flight."""
+    from tclb_tpu.gateway.service import GatewayService
+
+    gate = tmp_path / "gate"
+    pool = _fast_pool(stub_cmd, env={"STUB_GATE": str(gate)})
+    svc = GatewayService(str(tmp_path / "store"), pool=pool)
+    svc.start()
+    try:
+        code, doc = svc.submit({"model": "d2q9", "shape": [8, 16],
+                                "niter": 2})
+        assert code == 202, doc
+        jid = doc["job"]["id"]
+        # both progress samples land before the stub blocks on the gate
+        code, doc = svc.stream(jid, wait=60)
+        assert code == 200 and doc["status"] == "running"
+        deadline = time.monotonic() + 30
+        while doc["progress"]["iter"] < 2 \
+                and time.monotonic() < deadline:
+            code, doc = svc.stream(jid, since=doc["seq"], wait=30)
+        latest = doc["seq"]
+        got = {}
+
+        def poll():
+            got["resp"] = svc.stream(jid, since=latest, wait=120)
+
+        t = threading.Thread(target=poll)
+        t.start()
+        time.sleep(0.2)                         # poll is parked
+        gate.write_text("go")                   # release the result
+        code, _ = svc.result(jid, wait=120)
+        assert code == 200
+        t.join(timeout=30)
+        assert not t.is_alive(), "/stream long-poll outlived the job"
+        code, doc = got["resp"]
+        assert code == 200 and doc["status"] == "done"
+        assert doc["seq"] == latest             # no phantom sample
+    finally:
+        svc.close()
+
+
+def test_stream_validation_rejects_bad_specs(tmp_path):
+    from tclb_tpu.gateway.jobs import ValidationError, validate_body
+
+    ok = {"model": "d2q9", "shape": [8, 16], "niter": 2}
+    validate_body(dict(ok, stream=True))
+    validate_body(dict(ok, stream={"quantity": "rho", "max_dim": 16}))
+    for bad in ({"stream": "yes"}, {"stream": {"nope": 1}},
+                {"stream": {"quantity": ""}},
+                {"stream": {"max_dim": 0}},
+                {"stream": {"max_dim": True}}):
+        with pytest.raises(ValidationError):
+            validate_body(dict(ok, **bad))
+
+
+def test_downsample_strides_and_rejects_non_2d():
+    import numpy as np
+
+    from tclb_tpu.utils.render import downsample
+    plane = np.arange(64 * 48, dtype=np.float64).reshape(64, 48)
+    coarse = downsample(plane, max_dim=16)
+    assert max(coarse.shape) <= 16
+    assert coarse[0, 0] == plane[0, 0]          # stride sample, not blur
+    with pytest.raises(ValueError):
+        downsample(np.zeros(8), max_dim=4)

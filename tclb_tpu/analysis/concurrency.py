@@ -57,7 +57,7 @@ from tclb_tpu.analysis.hygiene import (_REPO_ROOT, _module_name, _py_files,
 _PKG_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 
 #: package subtrees (plus single files) the serving-plane analysis walks
-_DEFAULT_DIRS = ("serve", "gateway", "telemetry", "checkpoint")
+_DEFAULT_DIRS = ("serve", "gateway", "telemetry", "checkpoint", "cluster")
 _DEFAULT_FILES = ("faults.py",)
 
 _WAIVER_RE = re.compile(

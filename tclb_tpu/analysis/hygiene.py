@@ -788,7 +788,8 @@ def scan_unpoliced_retry(paths=None) -> list:
     ``RetryPolicy``/``retry_policy``."""
     if paths is None:
         paths = (_py_files(os.path.join(_PKG_ROOT, "serve"))
-                 + _py_files(os.path.join(_PKG_ROOT, "gateway")))
+                 + _py_files(os.path.join(_PKG_ROOT, "gateway"))
+                 + _py_files(os.path.join(_PKG_ROOT, "cluster")))
     findings = []
     for path in paths:
         try:
@@ -863,10 +864,14 @@ def scan_unsupervised_subprocess(paths=None) -> list:
     a crash loses whatever job it carried.  The structural signature is
     any call to a spawning API (``subprocess.Popen/run/call/check_*``,
     ``os.fork``/``forkpty``/``posix_spawn``) or a ``from subprocess
-    import Popen``-style alias, outside the pool module."""
+    import Popen``-style alias, outside the pool module.  The cluster
+    plane (``tclb_tpu/cluster``) is held to the same rule: the
+    host-agent supervises its local lanes *through* ``WorkerPool``
+    rather than spawning children of its own."""
     if paths is None:
         paths = (_py_files(os.path.join(_PKG_ROOT, "serve"))
-                 + _py_files(os.path.join(_PKG_ROOT, "gateway")))
+                 + _py_files(os.path.join(_PKG_ROOT, "gateway"))
+                 + _py_files(os.path.join(_PKG_ROOT, "cluster")))
     findings = []
     for path in paths:
         if os.path.basename(path) == "pool.py" \

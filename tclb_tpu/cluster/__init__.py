@@ -1,0 +1,28 @@
+"""Cross-host pod serving: cluster control plane + host-agent data plane.
+
+The gateway process stays the single control plane and does zero device
+work; each host in the pod runs one **host-agent**
+(``python -m tclb_tpu.cluster.agent --gateway HOST:PORT``) that enrolls
+over a TCP control channel, supervises its local
+:class:`~tclb_tpu.serve.pool.WorkerPool` as the data plane, and streams
+heartbeats, phase timings, and relayed telemetry back.
+
+* :mod:`tclb_tpu.cluster.wire` — the shared length-prefixed JSON/npy
+  frame protocol (moved out of ``serve/worker.py`` so the worker pipe
+  and the control channel speak the same format);
+* :mod:`tclb_tpu.cluster.registry` — gateway-side host bookkeeping:
+  enrollment state, heartbeat ages, fair-share routing with
+  host-affinity for resumable segments;
+* :mod:`tclb_tpu.cluster.server` — the gateway-side
+  :class:`ClusterServer`: speaks the pool protocol
+  (``submit``/``live_workers``/``close``), so
+  ``GatewayService(pool=ClusterServer(...))`` swaps the local worker
+  pool for an enrolled pod without any service-layer changes;
+* :mod:`tclb_tpu.cluster.agent` — the per-host agent process.
+"""
+
+from tclb_tpu.cluster.wire import (MAX_FRAME, Channel, IpcError, npy_bytes,
+                                   npy_load, read_frame, write_frame)
+
+__all__ = ["MAX_FRAME", "Channel", "IpcError", "npy_bytes", "npy_load",
+           "read_frame", "write_frame"]

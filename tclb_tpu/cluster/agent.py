@@ -1,0 +1,370 @@
+"""Per-host agent: the data-plane half of the serving pod.
+
+``python -m tclb_tpu.cluster.agent --gateway HOST:PORT`` runs on every
+host in the pod.  It enrolls with the gateway's
+:class:`~tclb_tpu.cluster.server.ClusterServer` over a TCP control
+channel (the shared :mod:`~tclb_tpu.cluster.wire` ``!II`` framing),
+supervises its local :class:`~tclb_tpu.serve.pool.WorkerPool` as the
+data plane — all device work happens in this host's worker lanes — and
+streams back:
+
+* **heartbeats** carrying the pool's ``/status`` fragment (live lanes,
+  queue depth, worker post-mortems) at ``--hb-interval`` cadence;
+* **results** (globals, phase timings, digests, optional ``.npy`` field
+  payloads) as each job finishes;
+* **relayed telemetry**: the agent process's event fan-out — which
+  already carries the worker events the pool re-emitted with
+  ``worker_pid``/``lane``/``incarnation`` stamps — batched behind the
+  heartbeat, so the gateway renders one cross-host timeline.
+
+Preemption contract: the agent process is disposable.  A SIGKILLed
+agent takes its workers with it; on restart it re-enrolls under the
+same ``--host-id`` (next incarnation) and the gateway requeues the lost
+host's in-flight jobs — resumable ones re-enter from
+``CheckpointManager.latest()`` on whatever host picks them up, so the
+run completes bit-identically.  The reconnect loop itself retries
+forever with jittered backoff (the gateway may be restarting too).
+
+Fault point fired here: ``cluster.host_exit`` (``error`` hard-exits the
+agent in the heartbeat loop — the abrupt host death the gateway's
+watchdog and requeue path must absorb).
+"""
+
+from __future__ import annotations
+
+import argparse
+import os
+import signal
+import socket
+import sys
+import threading
+from typing import Any, Optional
+
+from tclb_tpu import faults
+from tclb_tpu.cluster import wire
+from tclb_tpu.serve.pool import PoolJob, WorkerPool
+from tclb_tpu.serve.retry import RetryPolicy
+from tclb_tpu.telemetry import locks
+from tclb_tpu.utils import log
+
+#: bounded relay queue (same discipline as the worker pipe relay):
+#: events beyond this cap between two heartbeat flushes are dropped
+#: and counted, never allowed to grow agent memory or block liveness
+RELAY_QUEUE_CAP = 1024
+
+
+class _AgentRelay:
+    """Agent-side bridge from the in-process telemetry fan-out to the
+    control channel.  ``sink`` is an ``events.subscribe`` subscriber:
+    O(1) append, no I/O, safe under the events lock; the heartbeat loop
+    drains it into one ``{"t": "telemetry"}`` frame right after each
+    beat — relay can lag, liveness cannot."""
+
+    def __init__(self, cap: int = RELAY_QUEUE_CAP) -> None:
+        from collections import deque
+        self.cap = max(1, int(cap))
+        self._q: Any = deque()
+        self._lock = locks.make_lock("cluster.agent._AgentRelay._lock")
+        self.dropped_total = 0
+        self._dropped_pending = 0
+
+    def sink(self, doc: dict) -> None:
+        # counters snapshots stay host-local (the gateway folds its own
+        # sessions); docs already stamped with a host have been through
+        # a gateway re-emit — skipping them makes the relay loop-proof
+        # when agent and server share one process (tests)
+        if doc.get("kind") == "counters" or "host" in doc:
+            return
+        if len(self._q) >= self.cap:
+            with self._lock:
+                self.dropped_total += 1
+                self._dropped_pending += 1
+            return
+        self._q.append(doc)
+
+    def drain(self) -> tuple[list, int]:
+        q = self._q
+        batch: list = []
+        while q:
+            try:
+                batch.append(q.popleft())
+            except IndexError:  # pragma: no cover — lone consumer
+                break
+        with self._lock:
+            dropped = self._dropped_pending
+            self._dropped_pending = 0
+        return batch, dropped
+
+    def requeue(self, batch: list, dropped: int) -> None:
+        """Put an unsendable batch back as counted loss."""
+        with self._lock:
+            self.dropped_total += len(batch)
+            self._dropped_pending += len(batch) + dropped
+
+
+class ClusterAgent:
+    """One host's enrollment in the serving pod (see module doc)."""
+
+    def __init__(self, gateway: str, *, host_id: Optional[str] = None,
+                 workers: int = 1, hb_interval_s: float = 2.0,
+                 relay: bool = True,
+                 reconnect: Optional[RetryPolicy] = None,
+                 reconnect_forever: bool = True,
+                 pool: Optional[WorkerPool] = None,
+                 pool_kw: Optional[dict] = None) -> None:
+        ghost, _, gport = gateway.rpartition(":")
+        self.gateway = (ghost or "127.0.0.1", int(gport))
+        self.host_id = host_id or \
+            f"{socket.gethostname()}-{os.getpid()}"
+        self.hb_interval_s = max(0.05, float(hb_interval_s))
+        self.reconnect = reconnect if reconnect is not None else \
+            RetryPolicy(max_attempts=8, base_delay_s=0.2,
+                        max_delay_s=10.0)
+        self.reconnect_forever = bool(reconnect_forever)
+        self.pool = pool if pool is not None else WorkerPool(
+            workers=max(1, int(workers)), autostart=False,
+            **(pool_kw or {}))
+        self.incarnation: Optional[int] = None
+        self._relay: Optional[_AgentRelay] = None
+        if relay:
+            from tclb_tpu.telemetry import events
+            self._relay = _AgentRelay()
+            events.subscribe(self._relay.sink)
+        self._lock = locks.make_lock("cluster.agent.ClusterAgent._lock")
+        self._stop_evt = threading.Event()
+        self._chan: Optional[wire.Channel] = None
+        self._thread: Optional[threading.Thread] = None
+
+    # -- lifecycle ------------------------------------------------------------ #
+
+    def start(self) -> "ClusterAgent":
+        """Run the agent on a background thread (in-process tests; the
+        CLI drives :meth:`run` on the main thread instead)."""
+        self.pool.start()
+        t = threading.Thread(target=self.run, name="tclb-cluster-agent",
+                             daemon=True)
+        t.start()
+        self._thread = t
+        return self
+
+    def stop(self, timeout: float = 10.0) -> None:
+        self._stop_evt.set()
+        with self._lock:
+            ch = self._chan
+        if ch is not None:
+            ch.close()  # wakes the session reader
+        t = self._thread
+        if t is not None:
+            t.join(timeout=timeout)
+        if self._relay is not None:
+            from tclb_tpu.telemetry import events
+            events.unsubscribe(self._relay.sink)
+        self.pool.close(wait=False)
+
+    def run(self) -> int:
+        """Enroll-serve-reconnect until stopped.  Returns an exit code
+        (0 = clean shutdown, 1 = gave up reconnecting)."""
+        self.pool.start()
+        attempt = 0
+        while not self._stop_evt.is_set():
+            try:
+                ch = wire.connect(*self.gateway)
+            except OSError as e:
+                attempt += 1
+                delay = self.reconnect.next_delay(
+                    attempt, key=f"{self.host_id}:connect")
+                if delay is None:
+                    if not self.reconnect_forever:
+                        log.warning(
+                            f"agent: gateway {self.gateway[0]}:"
+                            f"{self.gateway[1]} unreachable after "
+                            f"{attempt} attempts — giving up ({e!r})")
+                        return 1
+                    # keep retrying at the backoff ceiling forever:
+                    # a preempted gateway host comes back eventually
+                    attempt = 0
+                    delay = self.reconnect.max_delay_s
+                if self._stop_evt.wait(delay or 1.0):
+                    return 0
+                continue
+            attempt = 0
+            verdict = self._session(ch)
+            if verdict == "shutdown" or self._stop_evt.is_set():
+                return 0
+            # channel lost: loop around and re-enroll
+        return 0
+
+    # -- one enrolled session ------------------------------------------------- #
+
+    def _session(self, ch: wire.Channel) -> str:
+        try:
+            ch.send({"t": "enroll", "host": self.host_id,
+                     "pid": os.getpid(), "lanes": self.pool.n})
+            ack, _ = ch.recv()
+        except (OSError, ValueError, EOFError, wire.IpcError) as e:
+            ch.close()
+            log.warning(f"agent: enrollment failed: {e!r}")
+            return "lost"
+        if ack.get("t") != "enrolled":
+            ch.close()
+            log.warning(f"agent: enrollment refused: "
+                        f"{ack.get('error') or ack}")
+            return "lost"
+        self.incarnation = int(ack.get("incarnation") or 0)
+        with self._lock:
+            self._chan = ch
+        # the smoke harness greps this line for liveness
+        print(f"agent: enrolled host={self.host_id} "
+              f"incarnation={self.incarnation} lanes={self.pool.n}",
+              flush=True)
+        log.notice(f"agent: enrolled with gateway as {self.host_id} "
+                   f"(incarnation {self.incarnation})")
+        hb = threading.Thread(target=self._hb_loop, args=(ch,),
+                              name="tclb-cluster-agent-hb", daemon=True)
+        hb.start()
+        verdict = "lost"
+        while True:
+            try:
+                doc, _payload = ch.recv()
+            except EOFError:
+                break
+            except (wire.IpcError, OSError, ValueError) as e:
+                log.warning(f"agent: control channel lost: {e!r}")
+                break
+            t = doc.get("t")
+            if t == "shutdown":
+                verdict = "shutdown"
+                break
+            if t == "job":
+                self._start_job(ch, doc)
+        with self._lock:
+            self._chan = None
+        ch.close()  # stops the heartbeat thread's sends
+        hb.join(timeout=self.hb_interval_s + 5.0)
+        return verdict
+
+    def _hb_loop(self, ch: wire.Channel) -> None:
+        while not self._stop_evt.wait(self.hb_interval_s):
+            try:
+                faults.fire("cluster.host_exit", host=self.host_id,
+                            at="hb")
+            except faults.InjectedFault:
+                # the abrupt host death the gateway must absorb: no
+                # goodbye frame, no pool teardown — straight down
+                os._exit(23)
+            try:
+                ch.send({"t": "hb", "host": self.host_id,
+                         "status": self.pool._status()})
+            except Exception:  # noqa: BLE001 — channel is gone
+                ch.close()  # wake the session reader
+                return
+            self._flush_relay(ch)
+
+    def _flush_relay(self, ch: wire.Channel) -> None:
+        if self._relay is None:
+            return
+        batch, dropped = self._relay.drain()
+        if not batch and not dropped:
+            return
+        try:
+            ch.send({"t": "telemetry", "host": self.host_id,
+                     "events": batch, "dropped": dropped})
+        except Exception:  # noqa: BLE001 — relay loss counted, not fatal
+            self._relay.requeue(batch, dropped)
+
+    # -- job plumbing --------------------------------------------------------- #
+
+    def _start_job(self, ch: wire.Channel, doc: dict) -> None:
+        gid = str(doc.get("id"))
+        spec = doc.get("spec") or {}
+
+        def on_progress(pj: PoolJob) -> None:
+            frame = {"t": "progress", "id": gid}
+            frame.update(pj.progress or {})
+            frame["host"] = self.host_id
+            try:
+                ch.send(frame)
+            except Exception:  # noqa: BLE001 — advisory
+                pass
+
+        def on_done(pj: PoolJob) -> None:
+            payload = b""
+            if pj.error is not None:
+                frame = {"t": "result", "id": gid, "ok": False,
+                         "error": str(pj.error),
+                         "error_kind": type(pj.error).__name__,
+                         "host": self.host_id,
+                         "attempts": pj.attempts}
+            else:
+                res = dict(pj._result or {})
+                fields = res.pop("fields", None)
+                if fields is not None:
+                    payload = wire.npy_bytes(fields)
+                res["host"] = self.host_id
+                frame = dict({"t": "result", "id": gid, "ok": True},
+                             **res)
+            try:
+                ch.send(frame, payload)
+            except Exception:  # noqa: BLE001 — channel gone: the
+                # gateway requeues via its host-death path
+                pass
+
+        try:
+            self.pool.submit(spec, on_done=on_done,
+                             on_progress=on_progress)
+        except Exception as e:  # noqa: BLE001 — closed/lane-dead pool
+            try:
+                ch.send({"t": "result", "id": gid, "ok": False,
+                         "error": repr(e),
+                         "error_kind": type(e).__name__,
+                         "host": self.host_id})
+            except Exception:  # noqa: BLE001
+                pass
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(
+        prog="tclb-cluster-agent",
+        description="pod host-agent: enrolls this host's worker pool "
+                    "with a serving gateway's cluster control plane")
+    ap.add_argument("--gateway", required=True, metavar="HOST:PORT",
+                    help="cluster control-plane address (the gateway "
+                         "CLI prints `cluster: HOST:PORT`)")
+    ap.add_argument("--host-id", default=None,
+                    help="stable pod identity for rejoin semantics "
+                         "(default: <hostname>-<pid>, which never "
+                         "rejoins — set it for preemptible hosts)")
+    ap.add_argument("--workers", type=int, default=1,
+                    help="local worker lanes (data-plane width)")
+    ap.add_argument("--hb-interval", type=float, default=2.0,
+                    metavar="SECONDS", help="heartbeat cadence")
+    ap.add_argument("--heartbeat-timeout", type=float, default=60.0,
+                    metavar="SECONDS",
+                    help="local pool's per-worker heartbeat timeout")
+    ap.add_argument("--no-relay", action="store_true",
+                    help="do not relay telemetry events to the gateway")
+    args = ap.parse_args(argv)
+
+    agent = ClusterAgent(
+        args.gateway, host_id=args.host_id, workers=args.workers,
+        hb_interval_s=args.hb_interval, relay=not args.no_relay,
+        pool_kw={"heartbeat_timeout_s": args.heartbeat_timeout})
+
+    def _on_sigterm(signum, frame):  # signal-safe: Event.set only
+        agent._stop_evt.set()
+
+    try:
+        signal.signal(signal.SIGTERM, _on_sigterm)
+    except (ValueError, OSError):  # pragma: no cover — exotic hosts
+        pass
+
+    print(f"agent: host={agent.host_id} workers={agent.pool.n} "
+          f"gateway={args.gateway}", flush=True)
+    try:
+        return agent.run()
+    finally:
+        agent.pool.close(wait=False)
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,218 @@
+"""Gateway-side host bookkeeping for the serving pod.
+
+:class:`HostRegistry` is the control plane's view of every enrolled
+host-agent: enrollment state and incarnation, advertised lanes, the
+latest heartbeat's pool status, in-flight job assignments, and recent
+dead-host post-mortems.  The router lives here too:
+
+* **fair-share spread** — a burst of scheduler bins lands one-batch-
+  per-host: :meth:`pick` chooses the live host with the lowest
+  load share (in-flight + queued, normalized by lanes), round-robin on
+  ties, so 16 queued jobs spread across a 2-host pod instead of one
+  host swallowing the sweep;
+* **host affinity for resumable segments** — a resumable job
+  (``ckpt_root`` in its spec) sticks to the host already holding its
+  warm lattice and newest checkpoint; the affinity dissolves when the
+  host dies (checkpoints live on the shared store, so any survivor can
+  resume from ``CheckpointManager.latest()`` bit-identically);
+* **requeue-on-host-death** — :meth:`mark_lost` atomically claims the
+  dead host's in-flight jobs so the server requeues each exactly once,
+  no matter whether the watchdog, the reader thread, or a re-enrollment
+  noticed the death first.
+
+The registry only mutates state; telemetry events
+(``gateway.host_enrolled`` / ``host_lost`` / ``host_rejoined``) are
+emitted by the :class:`~tclb_tpu.cluster.server.ClusterServer` outside
+the registry lock.
+"""
+
+from __future__ import annotations
+
+import time
+from typing import Any, Optional
+
+from tclb_tpu.telemetry import locks
+
+
+class HostRecord:
+    """One enrolled host-agent incarnation (state owned by the
+    registry; the channel is owned by the server's reader thread)."""
+
+    __slots__ = ("host", "pid", "lanes", "incarnation", "state",
+                 "enrolled_ts", "last_beat", "status", "channel",
+                 "inflight", "jobs_done", "close_reason", "order")
+
+    def __init__(self, host: str, pid: Optional[int], lanes: int,
+                 incarnation: int, channel: Any, order: int):
+        self.host = host
+        self.pid = pid
+        self.lanes = max(1, int(lanes))
+        self.incarnation = incarnation
+        self.state = "live"          # live / lost
+        self.enrolled_ts = round(time.time(), 3)
+        self.last_beat = time.monotonic()
+        self.status: Optional[dict] = None   # latest heartbeat fragment
+        self.channel = channel
+        self.inflight: dict[str, Any] = {}   # job id -> PoolJob
+        self.jobs_done = 0
+        self.close_reason: Optional[str] = None
+        self.order = order
+
+
+class HostRegistry:
+    """Thread-safe host table + cross-host router (see module doc)."""
+
+    def __init__(self) -> None:
+        self._lock = locks.make_lock("cluster.registry.HostRegistry._lock")
+        self._hosts: dict[str, HostRecord] = {}
+        self._affinity: dict[str, str] = {}  # ckpt_root -> host id
+        self._dumps: list[dict] = []         # recent dead-host notes
+        self._rr = 0                         # round-robin tiebreak
+
+    # -- enrollment ---------------------------------------------------------- #
+
+    def enroll(self, host: str, pid: Optional[int], lanes: int,
+               channel: Any) -> tuple[HostRecord, bool,
+                                      Optional[HostRecord]]:
+        """Register one enrollment; returns ``(record, rejoined,
+        stale)`` where ``stale`` is a still-live previous incarnation of
+        the same host id the caller must tear down (its channel closed,
+        its in-flight jobs requeued)."""
+        with self._lock:
+            prev = self._hosts.get(host)
+            stale = prev if prev is not None and prev.state == "live" \
+                else None
+            incarnation = 0 if prev is None else prev.incarnation + 1
+            self._rr += 1
+            rec = HostRecord(host, pid, lanes, incarnation, channel,
+                             order=self._rr)
+            self._hosts[host] = rec
+            return rec, prev is not None, stale
+
+    def beat(self, rec: HostRecord) -> None:
+        rec.last_beat = time.monotonic()
+
+    def update_status(self, rec: HostRecord,
+                      status: Optional[dict]) -> None:
+        if isinstance(status, dict):
+            with self._lock:
+                rec.status = status
+
+    # -- routing ------------------------------------------------------------- #
+
+    def pick(self, doc: dict) -> Optional[HostRecord]:
+        """Route one job doc to a live host (None when the pod is
+        empty).  Resumable docs keep their affinity host while it
+        lives; everything else fair-shares by load per lane."""
+        key = doc.get("ckpt_root")
+        with self._lock:
+            live = [h for h in self._hosts.values() if h.state == "live"]
+            if not live:
+                return None
+            if key:
+                owner = self._affinity.get(key)
+                if owner is not None:
+                    rec = self._hosts.get(owner)
+                    if rec is not None and rec.state == "live":
+                        return rec
+            self._rr += 1
+            rr = self._rr
+
+            def load(h: HostRecord) -> tuple:
+                q = 0
+                if h.status:
+                    q = int(h.status.get("queue_depth") or 0)
+                return (len(h.inflight) + q) / h.lanes, \
+                    (h.order + rr) % max(1, len(live)), h.order
+
+            rec = min(live, key=load)
+            if key:
+                self._affinity[key] = rec.host
+            return rec
+
+    def assign(self, rec: HostRecord, job: Any) -> bool:
+        """Claim one in-flight slot on ``rec`` (False when the host died
+        between routing and dispatch — the caller re-routes)."""
+        with self._lock:
+            if rec.state != "live":
+                return False
+            rec.inflight[job.id] = job
+            return True
+
+    def take(self, rec: HostRecord, jid: str) -> Optional[Any]:
+        """Pop one in-flight job on result arrival (None for results of
+        jobs already requeued to another host — orphans)."""
+        with self._lock:
+            job = rec.inflight.pop(jid, None)
+            if job is not None:
+                rec.jobs_done += 1
+            return job
+
+    # -- death --------------------------------------------------------------- #
+
+    def mark_lost(self, rec: HostRecord, reason: str) -> Optional[list]:
+        """Flip one incarnation to ``lost`` and claim its in-flight
+        jobs for requeue.  Idempotent: exactly one caller (watchdog vs
+        reader vs re-enroll) gets the job list — every other gets
+        ``None`` and must not requeue or emit loss events."""
+        with self._lock:
+            if rec.state != "live":
+                return None
+            rec.state = "lost"
+            rec.close_reason = reason
+            jobs = list(rec.inflight.values())
+            rec.inflight.clear()
+            for key, owner in list(self._affinity.items()):
+                if owner == rec.host:
+                    del self._affinity[key]
+            self._dumps.append({
+                "host": rec.host, "pid": rec.pid,
+                "incarnation": rec.incarnation, "reason": reason,
+                "jobs_lost": len(jobs),
+                "ts": round(time.time(), 3)})
+            del self._dumps[:-8]
+            return jobs
+
+    # -- views --------------------------------------------------------------- #
+
+    def live(self) -> list[HostRecord]:
+        with self._lock:
+            return [h for h in self._hosts.values() if h.state == "live"]
+
+    def get(self, host: str) -> Optional[HostRecord]:
+        with self._lock:
+            return self._hosts.get(host)
+
+    def live_lanes(self) -> int:
+        """Serving capacity: live workers per the newest heartbeat when
+        one arrived, the advertised lane count until then."""
+        total = 0
+        with self._lock:
+            for h in self._hosts.values():
+                if h.state != "live":
+                    continue
+                if h.status and h.status.get("live") is not None:
+                    total += int(h.status.get("live") or 0)
+                else:
+                    total += h.lanes
+        return total
+
+    def snapshot(self) -> dict:
+        """Plain-python ``/status`` fragment (monitor-thread safe)."""
+        now = time.monotonic()
+        with self._lock:
+            hosts = []
+            for h in sorted(self._hosts.values(), key=lambda x: x.host):
+                st = h.status or {}
+                hosts.append({
+                    "host": h.host, "state": h.state, "pid": h.pid,
+                    "lanes": h.lanes, "incarnation": h.incarnation,
+                    "live_workers": st.get("live"),
+                    "queue_depth": st.get("queue_depth"),
+                    "inflight": len(h.inflight),
+                    "jobs_done": h.jobs_done,
+                    "last_heartbeat_age_s": round(now - h.last_beat, 3),
+                    "enrolled_ts": h.enrolled_ts,
+                    "close_reason": h.close_reason,
+                })
+            return {"hosts": hosts, "dead_host_dumps": list(self._dumps)}

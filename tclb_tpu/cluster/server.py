@@ -1,0 +1,480 @@
+"""Gateway-side cluster control plane.
+
+:class:`ClusterServer` listens for host-agent enrollments and speaks
+the same pool protocol as :class:`~tclb_tpu.serve.pool.WorkerPool`
+(``start`` / ``submit`` / ``live_workers`` / ``stats`` / ``close``), so
+``GatewayService(pool=ClusterServer(...))`` swaps the local worker pool
+for an enrolled pod with zero service-layer changes.  The gateway
+process stays the single control plane and does **zero device work**:
+jobs are framed over TCP to host-agents, results (including ``.npy``
+field payloads) come back on the same channel.
+
+Threads:
+
+* **accept** — one enrollment handshake per connection, then hands the
+  channel to a per-host reader;
+* **per-host reader** — heartbeats, results, progress, and relayed
+  telemetry frames; a read error of any kind marks the host lost;
+* **dispatch** — pulls queued jobs and routes them through
+  :class:`~tclb_tpu.cluster.registry.HostRegistry` (fair-share +
+  resumable affinity); a send failure requeues via the host-death path;
+* **watchdog** — heartbeat ages beyond ``heartbeat_timeout_s`` sever
+  the channel so the reader notices a silently-hung host.
+
+Requeue-on-host-death reuses the worker pool's attempt semantics: a job
+is retried on surviving hosts up to ``job_attempts`` times; resumable
+jobs resume from ``CheckpointManager.latest()`` on whichever host picks
+them up, bit-identically (the checkpoint store is shared).
+"""
+
+from __future__ import annotations
+
+import queue
+import socket
+import threading
+import time
+from typing import Any, Callable, Optional
+
+from tclb_tpu import faults
+from tclb_tpu import telemetry
+from tclb_tpu.cluster import wire
+from tclb_tpu.cluster.registry import HostRecord, HostRegistry
+from tclb_tpu.serve.pool import PoolJob, PoolJobError
+from tclb_tpu.telemetry import live as tlive
+from tclb_tpu.telemetry import locks
+from tclb_tpu.utils import log
+
+
+class ClusterServer:
+    """Control plane for a serving pod (pool-protocol compatible)."""
+
+    def __init__(self, host: str = "127.0.0.1", port: int = 0, *,
+                 heartbeat_timeout_s: float = 15.0,
+                 enroll_timeout_s: float = 10.0,
+                 job_attempts: int = 2) -> None:
+        self.heartbeat_timeout_s = float(heartbeat_timeout_s)
+        self.enroll_timeout_s = float(enroll_timeout_s)
+        self.job_attempts = max(1, int(job_attempts))
+        self.registry = HostRegistry()
+        self._queue: "queue.Queue[PoolJob]" = queue.Queue()
+        self._lock = locks.make_lock("cluster.server.ClusterServer._lock")
+        self._stop_evt = threading.Event()
+        self._threads: list[threading.Thread] = []
+        self._status_fn: Optional[Callable[[], dict]] = None
+        self._started = False
+        self._closing = False
+        self._seq = 0
+        self._submitted = 0
+        self._done = 0
+        self._failed = 0
+        self._requeued = 0
+        # bind in the constructor so callers (CLI, tests) can read the
+        # resolved port before start(); accepting begins in start()
+        self._sock = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
+        self._sock.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
+        self._sock.bind((host, int(port)))
+        self._sock.listen(32)
+        self.host, self.port = self._sock.getsockname()[:2]
+
+    @property
+    def address(self) -> str:
+        return f"{self.host}:{self.port}"
+
+    # -- pool protocol -------------------------------------------------------- #
+
+    def start(self) -> "ClusterServer":
+        with self._lock:
+            if self._started or self._closing:
+                return self
+            self._started = True
+        tlive.enable_live()
+        tlive.flight_recorder().attach()
+        # keep the exact callable: unregister_status matches by identity
+        self._status_fn = self._status
+        tlive.register_status("hosts", self._status_fn)
+        for name, fn in (("tclb-cluster-accept", self._accept_loop),
+                         ("tclb-cluster-dispatch", self._dispatch_loop),
+                         ("tclb-cluster-watchdog", self._watchdog_loop)):
+            t = threading.Thread(target=fn, name=name, daemon=True)
+            t.start()
+            self._threads.append(t)
+        log.notice(f"cluster: control plane listening on {self.address}")
+        return self
+
+    def submit(self, doc: dict,
+               on_done: Optional[Callable[[PoolJob], None]] = None,
+               on_progress: Optional[Callable[[dict], None]] = None
+               ) -> PoolJob:
+        """Queue one job doc for the pod.  Unlike the local pool there
+        is no fail-fast on an empty pod — hosts enroll and re-enroll
+        over time; jobs wait for capacity."""
+        with self._lock:
+            if self._closing:
+                raise PoolJobError("cluster server is closed")
+            self._seq += 1
+            jid = f"cj-{self._seq}"
+            self._submitted += 1
+        job = PoolJob(jid, dict(doc), on_done=on_done,
+                      on_progress=on_progress)
+        self._queue.put(job)
+        return job
+
+    def live_workers(self) -> int:
+        return self.registry.live_lanes()
+
+    def live_hosts(self) -> int:
+        return len(self.registry.live())
+
+    def stats(self) -> dict:
+        with self._lock:
+            return {"submitted": self._submitted, "done": self._done,
+                    "failed": self._failed, "requeued": self._requeued,
+                    "hosts_live": len(self.registry.live()),
+                    "workers_live": self.registry.live_lanes()}
+
+    def close(self, wait: bool = True, timeout: float = 30.0) -> None:
+        with self._lock:
+            if self._closing:
+                return
+            self._closing = True
+            started = self._started
+        if wait and started:
+            deadline = time.monotonic() + max(0.0, timeout)
+            while time.monotonic() < deadline:
+                with self._lock:
+                    pending = self._submitted - self._done - self._failed
+                if pending <= 0:
+                    break
+                time.sleep(0.05)
+        self._stop_evt.set()
+        try:
+            self._sock.close()
+        except OSError:
+            pass
+        # whatever is left fails fast so a draining gateway can park
+        # its records instead of hanging on result()
+        self._fail_queued("cluster server is closed")
+        for rec in self.registry.live():
+            jobs = self.registry.mark_lost(rec, "server closed")
+            for job in jobs or ():
+                self._finish_failed(job, PoolJobError(
+                    f"job {job.id} aborted: cluster server is closed"))
+            try:
+                rec.channel.send({"t": "shutdown"})
+            except Exception:
+                pass
+            rec.channel.close()
+        if started:
+            for t in self._threads:
+                t.join(timeout=2.0)
+            tlive.unregister_status("hosts", self._status_fn)
+            tlive.flight_recorder().detach()
+            tlive.disable_live()
+
+    # -- enrollment ----------------------------------------------------------- #
+
+    def _accept_loop(self) -> None:
+        while True:
+            try:
+                conn, addr = self._sock.accept()
+            except OSError:
+                return  # listening socket closed
+            if self._closing:
+                try:
+                    conn.close()
+                except OSError:
+                    pass
+                return
+            threading.Thread(target=self._session, args=(conn, addr),
+                             name="tclb-cluster-host", daemon=True).start()
+
+    def _session(self, conn: socket.socket, addr: tuple) -> None:
+        peer = "%s:%s" % addr[:2]
+        try:
+            conn.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
+            conn.settimeout(self.enroll_timeout_s)
+            ch = wire.Channel(conn, peer=peer)
+            doc, _ = ch.recv()
+            conn.settimeout(None)
+        except Exception:
+            try:
+                conn.close()
+            except OSError:
+                pass
+            return
+        host = str(doc.get("host") or "")
+        if doc.get("t") != "enroll" or not host:
+            self._refuse(ch, "first frame must be an enroll")
+            return
+        try:
+            faults.fire("cluster.enroll", host=host, peer=peer)
+        except Exception as e:
+            telemetry.counter("cluster.hosts.rejected")
+            telemetry.event("gateway.host_rejected", host=host,
+                            error=repr(e))
+            self._refuse(ch, f"enrollment refused: {e!r}")
+            return
+        rec, rejoined, stale = self.registry.enroll(
+            host, doc.get("pid"), int(doc.get("lanes") or 1), ch)
+        if stale is not None:
+            self._host_down(stale, "replaced by re-enrollment")
+        try:
+            ch.send({"t": "enrolled", "host": host,
+                     "incarnation": rec.incarnation})
+        except Exception:
+            self._host_down(rec, "enroll ack failed")
+            return
+        telemetry.counter("cluster.hosts.enrolled")
+        telemetry.event("gateway.host_enrolled", host=host,
+                        pid=rec.pid, lanes=rec.lanes,
+                        incarnation=rec.incarnation, rejoined=rejoined)
+        if rejoined:
+            telemetry.counter("cluster.hosts.rejoined")
+            telemetry.event("gateway.host_rejoined", host=host,
+                            pid=rec.pid, incarnation=rec.incarnation)
+        log.notice(f"cluster: host {host} enrolled from {peer} "
+                   f"(lanes={rec.lanes} incarnation={rec.incarnation}"
+                   f"{' rejoin' if rejoined else ''})")
+        self._host_loop(rec, ch)
+
+    @staticmethod
+    def _refuse(ch: wire.Channel, error: str) -> None:
+        try:
+            ch.send({"t": "enroll_err", "error": error})
+        except Exception:
+            pass
+        ch.close()
+
+    # -- per-host reader ------------------------------------------------------ #
+
+    def _host_loop(self, rec: HostRecord, ch: wire.Channel) -> None:
+        while True:
+            try:
+                doc, payload = ch.recv()
+            except EOFError:
+                self._host_down(rec, "channel closed")
+                return
+            except (wire.IpcError, OSError, ValueError) as e:
+                self._host_down(rec, f"channel error: {e!r}")
+                return
+            self.registry.beat(rec)
+            kind = doc.get("t")
+            if kind == "hb":
+                self.registry.update_status(rec, doc.get("status"))
+            elif kind == "result":
+                self._on_result(rec, doc, payload)
+            elif kind == "progress":
+                self._on_progress(rec, doc)
+            elif kind == "telemetry":
+                self._reemit(rec, doc)
+            else:
+                telemetry.counter("cluster.unknown_frames")
+
+    def _on_result(self, rec: HostRecord, doc: dict,
+                   payload: bytes) -> None:
+        jid = str(doc.get("id"))
+        try:
+            verdict = faults.fire("cluster.channel", host=rec.host,
+                                  job=jid, op="recv")
+        except Exception as e:
+            # an injected receive fault loses the frame with the
+            # channel: the job requeues via the host-death path
+            self._host_down(rec, f"injected channel fault: {e!r}")
+            return
+        if verdict == "torn":
+            rec.channel.tear()
+            self._host_down(rec, "torn control frame (recv)")
+            return
+        job = self.registry.take(rec, jid)
+        if job is None:
+            # result for a job already requeued elsewhere (the host
+            # was presumed dead but delivered late) — drop it; the
+            # retry owns the record now
+            telemetry.counter("cluster.orphan_results")
+            return
+        ok = bool(doc.get("ok"))
+        if ok:
+            res = {k: v for k, v in doc.items()
+                   if k not in ("t", "id", "ok")}
+            if payload:
+                res["fields"] = wire.npy_load(payload)
+            res.setdefault("host", rec.host)
+            job._finish(res, None)
+            with self._lock:
+                self._done += 1
+        else:
+            job._finish(None, PoolJobError(
+                f"job {jid} failed on host {rec.host}: "
+                f"{doc.get('error')}"))
+            with self._lock:
+                self._failed += 1
+        telemetry.event("cluster.job_done", job=jid,
+                        job_id=job.doc.get("job_id"), host=rec.host,
+                        ok=ok, attempts=job.attempts)
+
+    def _on_progress(self, rec: HostRecord, doc: dict) -> None:
+        jid = str(doc.get("id"))
+        with self.registry._lock:
+            job = rec.inflight.get(jid)
+        if job is None:
+            return
+        info = {k: v for k, v in doc.items() if k not in ("t", "id")}
+        info.setdefault("host", rec.host)
+        job.progress = info
+        if job._on_progress is None:
+            return
+        try:
+            job._on_progress(job)
+        except Exception as e:  # advisory, never fatal
+            log.warning(f"cluster: progress callback failed: {e!r}")
+
+    def _reemit(self, rec: HostRecord, doc: dict) -> None:
+        """Re-emit one relayed telemetry batch into the gateway's
+        fan-out, stamped with the originating host (the agent already
+        stamped ``worker_pid``/``lane``/``incarnation``)."""
+        events = doc.get("events") or ()
+        dropped = int(doc.get("dropped") or 0)
+        if dropped:
+            telemetry.counter("cluster.relay_dropped", dropped)
+        for ev in events:
+            if not isinstance(ev, dict):
+                continue
+            fields = dict(ev)
+            kind = fields.pop("kind", None)
+            if not kind:
+                continue
+            fields.setdefault("host", rec.host)
+            telemetry.counter("cluster.relay_events")
+            try:
+                telemetry.event(str(kind), **fields)
+            except Exception as e:  # advisory path, never fatal
+                log.warning(f"cluster: relay re-emit failed: {e!r}")
+
+    # -- dispatch ------------------------------------------------------------- #
+
+    def _dispatch_loop(self) -> None:
+        while True:
+            try:
+                job = self._queue.get(timeout=0.2)
+            except queue.Empty:
+                if self._closing:
+                    return
+                continue
+            if job.done:
+                continue
+            self._dispatch_one(job)
+
+    def _dispatch_one(self, job: PoolJob) -> None:
+        while not job.done:
+            if self._closing:
+                self._finish_failed(job, PoolJobError(
+                    f"job {job.id} aborted: cluster server is closed"))
+                return
+            rec = self.registry.pick(job.doc)
+            if rec is None:
+                # empty pod: hold the job until a host enrolls
+                if self._stop_evt.wait(0.05):
+                    self._finish_failed(job, PoolJobError(
+                        f"job {job.id} aborted: cluster server is "
+                        "closed"))
+                    return
+                continue
+            if not self.registry.assign(rec, job):
+                continue  # host died between routing and claim
+            job.attempts += 1
+            job.status = "running"
+            try:
+                verdict = faults.fire("cluster.channel", host=rec.host,
+                                      job=job.id, op="send")
+                if verdict == "torn":
+                    rec.channel.tear()
+                    raise wire.IpcError("torn control frame (send)")
+                rec.channel.send(
+                    {"t": "job", "id": job.id, "spec": job.doc})
+            except Exception as e:
+                # the channel is unusable; the host-death path claims
+                # the just-assigned job and requeues or fails it
+                self._host_down(rec, f"job send failed: {e!r}")
+                return
+            telemetry.event("cluster.job_dispatched", job=job.id,
+                            job_id=job.doc.get("job_id"), host=rec.host,
+                            attempt=job.attempts)
+            return
+
+    # -- death ---------------------------------------------------------------- #
+
+    def _host_down(self, rec: HostRecord, reason: str) -> None:
+        jobs = self.registry.mark_lost(rec, reason)
+        rec.channel.close()
+        if jobs is None:
+            return  # another thread already handled this incarnation
+        telemetry.counter("cluster.hosts.lost")
+        telemetry.event("gateway.host_lost", host=rec.host, pid=rec.pid,
+                        incarnation=rec.incarnation, reason=reason,
+                        jobs_requeued=len(jobs))
+        log.warning(f"cluster: host {rec.host} lost ({reason}); "
+                 f"requeueing {len(jobs)} in-flight job(s)")
+        for job in jobs:
+            self._requeue(job, rec.host, reason)
+
+    def _requeue(self, job: PoolJob, host: str, reason: str) -> None:
+        if job.done:
+            return
+        if job.attempts >= self.job_attempts:
+            self._finish_failed(job, PoolJobError(
+                f"job {job.id} failed after {job.attempts} attempt(s); "
+                f"last host {host} lost: {reason}"))
+            return
+        job.status = "queued"
+        with self._lock:
+            self._requeued += 1
+        telemetry.counter("cluster.jobs.requeued")
+        telemetry.event("cluster.job_requeued", job=job.id,
+                        job_id=job.doc.get("job_id"), host=host,
+                        reason=reason, attempts=job.attempts)
+        self._queue.put(job)
+
+    def _finish_failed(self, job: PoolJob, err: Exception) -> None:
+        if job.done:
+            return
+        job._finish(None, err)
+        with self._lock:
+            self._failed += 1
+
+    def _fail_queued(self, reason: str) -> None:
+        while True:
+            try:
+                job = self._queue.get_nowait()
+            except queue.Empty:
+                return
+            self._finish_failed(job, PoolJobError(
+                f"job {job.id} aborted: {reason}"))
+
+    # -- watchdog ------------------------------------------------------------- #
+
+    def _watchdog_loop(self) -> None:
+        tick = max(0.2, min(1.0, self.heartbeat_timeout_s / 4.0))
+        while not self._stop_evt.wait(tick):
+            now = time.monotonic()
+            for rec in self.registry.live():
+                age = now - rec.last_beat
+                if age > self.heartbeat_timeout_s:
+                    telemetry.event("cluster.host_hung", host=rec.host,
+                                    beat_age_s=round(age, 3))
+                    self._host_down(
+                        rec, f"heartbeat timeout ({age:.1f}s)")
+
+    # -- status provider ------------------------------------------------------ #
+
+    def _status(self) -> dict:
+        snap = self.registry.snapshot()
+        with self._lock:
+            snap["jobs"] = {
+                "submitted": self._submitted, "done": self._done,
+                "failed": self._failed, "requeued": self._requeued}
+            snap["closing"] = self._closing
+        snap["live"] = self.registry.live_lanes()
+        snap["queue_depth"] = self._queue.qsize()
+        snap["heartbeat_timeout_s"] = self.heartbeat_timeout_s
+        snap["address"] = self.address
+        return snap

@@ -1,0 +1,170 @@
+"""Length-prefixed JSON/npy frame protocol (the ``!II`` wire).
+
+Grown as :mod:`tclb_tpu.serve.worker`'s pipe protocol and moved here so
+the worker pipe (supervisor <-> lane subprocess, stdin/stdout) and the
+cluster control channel (gateway <-> host-agent, TCP) speak one wire
+format:
+
+* every frame is an 8-byte ``!II`` header (JSON length, payload length)
+  followed by a UTF-8 JSON document and an optional raw binary payload
+  (``.npy`` bytes for array data) — **never** pickled objects, so a
+  malicious or corrupt peer can at worst feed bad numbers, not code;
+* a clean close at a frame boundary raises ``EOFError``; a torn or
+  malformed frame raises :class:`IpcError` — the distinction the
+  supervisors use to tell shutdown from failure;
+* oversized length prefixes are refused (:data:`MAX_FRAME`) instead of
+  allocating unbounded buffers.
+
+:class:`Channel` wraps a connected socket in the same protocol with a
+write lock, so an agent's heartbeat, result, and relay threads can
+interleave whole frames — never bytes.
+"""
+
+from __future__ import annotations
+
+import io
+import json
+import socket
+import struct
+from typing import BinaryIO, Optional
+
+_HEADER = struct.Struct("!II")
+
+#: refuse absurd frames instead of allocating unbounded buffers
+MAX_FRAME = 1 << 30
+
+
+class IpcError(RuntimeError):
+    """A torn or malformed frame on the wire."""
+
+
+def write_frame(fh: BinaryIO, doc: dict, payload: bytes = b"") -> None:
+    """Write one length-prefixed frame: JSON doc + raw payload bytes."""
+    from tclb_tpu.telemetry import events
+    body = json.dumps(doc, default=events._json_default).encode()
+    fh.write(_HEADER.pack(len(body), len(payload)))
+    fh.write(body)
+    if payload:
+        fh.write(payload)
+    fh.flush()
+
+
+def _read_exact(fh: BinaryIO, n: int) -> bytes:
+    chunks = []
+    while n > 0:
+        chunk = fh.read(n)
+        if not chunk:
+            raise IpcError(f"pipe closed mid-frame ({n} bytes short)")
+        chunks.append(chunk)
+        n -= len(chunk)
+    return b"".join(chunks)
+
+
+def read_frame(fh: BinaryIO) -> tuple[dict, bytes]:
+    """Read one frame; EOFError on a clean close at a frame boundary,
+    :class:`IpcError` on a torn or malformed one."""
+    header = fh.read(_HEADER.size)
+    if not header:
+        raise EOFError("pipe closed")
+    if len(header) < _HEADER.size:
+        header += _read_exact(fh, _HEADER.size - len(header))
+    body_len, payload_len = _HEADER.unpack(header)
+    if body_len > MAX_FRAME or payload_len > MAX_FRAME:
+        raise IpcError(f"oversized frame ({body_len}+{payload_len} bytes)")
+    try:
+        doc = json.loads(_read_exact(fh, body_len).decode())
+    except (ValueError, UnicodeDecodeError) as e:
+        raise IpcError(f"malformed frame body: {e}") from e
+    payload = _read_exact(fh, payload_len) if payload_len else b""
+    if not isinstance(doc, dict):
+        raise IpcError("frame body must be a JSON object")
+    return doc, payload
+
+
+def npy_bytes(arr) -> bytes:
+    """Serialize a host array as ``.npy`` bytes (the only array wire
+    format — plain data, never pickles)."""
+    import numpy as np
+    buf = io.BytesIO()
+    np.save(buf, np.ascontiguousarray(np.asarray(arr)),
+            allow_pickle=False)
+    return buf.getvalue()
+
+
+def npy_load(payload: bytes):
+    import numpy as np
+    return np.load(io.BytesIO(payload), allow_pickle=False)
+
+
+class Channel:
+    """One framed duplex control channel over a connected socket.
+
+    Reads are single-threaded by convention (one reader thread per
+    channel); writes are serialized by a :func:`locks.make_lock` lock so
+    concurrent senders (heartbeat thread, result callbacks, relay
+    flush) interleave whole frames, never bytes.  Every send/recv error
+    maps to the channel being unusable — callers tear the session down
+    and re-enroll rather than resynchronize a desynced stream.
+    """
+
+    def __init__(self, sock: socket.socket,
+                 peer: Optional[str] = None) -> None:
+        from tclb_tpu.telemetry import locks
+        self.sock = sock
+        if peer is None:
+            try:
+                peer = "%s:%s" % sock.getpeername()[:2]
+            except OSError:
+                peer = "?"
+        self.peer = peer
+        self._r = sock.makefile("rb")
+        self._w = sock.makefile("wb")
+        self._wlock = locks.make_lock("cluster.wire.Channel._wlock")
+        self.closed = False
+
+    def send(self, doc: dict, payload: bytes = b"") -> None:
+        """Write one frame atomically with respect to other senders."""
+        with self._wlock:
+            # concurrency-ok[blocking]: serializing whole-frame writes is
+            # this lock's purpose — contenders are the channel's own
+            # sender threads, and a frame is one bounded send
+            write_frame(self._w, doc, payload)
+
+    def recv(self) -> tuple[dict, bytes]:
+        """Read one frame (reader-thread only)."""
+        return read_frame(self._r)
+
+    def tear(self) -> None:
+        """Chaos helper: write a deliberately torn frame (a header
+        promising more bytes than follow) and sever the socket — the
+        peer's reader sees :class:`IpcError` mid-frame, the exact
+        failure the ``cluster.channel`` ``torn`` schedule injects."""
+        with self._wlock:
+            # concurrency-ok[blocking]: one bounded write; see send()
+            try:
+                self._w.write(_HEADER.pack(64, 0))
+                self._w.write(b"{\"t\": \"torn")
+                self._w.flush()
+            except (OSError, ValueError):
+                pass
+        self.close()
+
+    def close(self) -> None:
+        self.closed = True
+        for closer in (lambda: self.sock.shutdown(socket.SHUT_RDWR),
+                       self._w.close, self._r.close, self.sock.close):
+            try:
+                closer()
+            except (OSError, ValueError):
+                pass
+
+
+def connect(host: str, port: int, timeout: Optional[float] = 10.0
+            ) -> Channel:
+    """Dial a control channel; the connect itself is bounded by
+    ``timeout``, the established channel then blocks indefinitely
+    (liveness is the heartbeat watchdog's job, not a socket timeout)."""
+    sock = socket.create_connection((host, int(port)), timeout=timeout)
+    sock.settimeout(None)
+    sock.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
+    return Channel(sock)

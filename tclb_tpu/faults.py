@@ -42,6 +42,21 @@ unknown names so a typo cannot silently disable a chaos schedule):
                           ``enospc`` / ``torn`` drop the batch (counted in
                           ``pool.relay_dropped``), ``slow`` delays the
                           flush — never the heartbeat, never the job
+``cluster.enroll``        host-agent enrollment handshake on the gateway
+                          (``ClusterServer._session``): ``error`` refuses
+                          the enrollment (the agent backs off and
+                          retries), ``slow`` delays the ack
+``cluster.channel``       control-channel frame send / result receive on
+                          the gateway (``ClusterServer._dispatch_one`` /
+                          ``_on_result``): ``error`` fails the op,
+                          ``torn`` tears the channel mid-frame — either
+                          way the host is marked lost and its in-flight
+                          jobs requeue on survivors
+``cluster.host_exit``     fired *inside* the host-agent's heartbeat loop
+                          (``ClusterAgent._hb_loop``): ``error``
+                          hard-exits the agent process (takes its worker
+                          lanes with it) — the abrupt host death the
+                          gateway watchdog and requeue path must absorb
 ========================  ===================================================
 
 Modes: ``error`` raises :class:`InjectedFault`; ``enospc`` raises
@@ -95,6 +110,9 @@ POINTS = frozenset({
     "pool.ipc",
     "pool.worker_exit",
     "pool.telemetry_relay",
+    "cluster.enroll",
+    "cluster.channel",
+    "cluster.host_exit",
 })
 
 MODES = frozenset({"error", "enospc", "torn", "slow"})

@@ -66,6 +66,17 @@ def add_gateway_arguments(p: argparse.ArgumentParser) -> None:
                    "(process isolation: a hung or crashed solve kills "
                    "one worker, never the gateway; 0 = in-process "
                    "scheduler)")
+    p.add_argument("--cluster", default=None, metavar="[HOST]:PORT",
+                   help="serve through a pod of host-agents instead of "
+                   "local lanes: listen for `python -m "
+                   "tclb_tpu.cluster.agent` enrollments on this "
+                   "address (port 0 picks a free one; the resolved "
+                   "address is printed as `cluster: HOST:PORT`)")
+    p.add_argument("--cluster-heartbeat-timeout", type=float,
+                   default=15.0, metavar="SECONDS",
+                   help="seconds without an agent heartbeat before the "
+                   "gateway declares the host lost and requeues its "
+                   "in-flight jobs (with --cluster)")
     p.add_argument("--heartbeat-timeout", type=float, default=60.0,
                    help="seconds without a worker heartbeat before the "
                    "supervisor declares it hung and restarts it "
@@ -97,7 +108,18 @@ def run_gateway(args) -> int:
         print(f"monitor: {monitor.url}/status")
     pool = None
     workers = int(getattr(args, "workers", 0) or 0)
-    if workers > 0:
+    cluster_spec = getattr(args, "cluster", None)
+    if cluster_spec:
+        # pod mode: the "pool" is the cluster control plane; host-agents
+        # bring the actual worker lanes when they enroll
+        from tclb_tpu.cluster.server import ClusterServer
+        from tclb_tpu.telemetry.live import parse_monitor_spec
+        chost, cport = parse_monitor_spec(cluster_spec)
+        pool = ClusterServer(
+            chost, cport,
+            heartbeat_timeout_s=args.cluster_heartbeat_timeout)
+        print(f"cluster: {pool.address}", flush=True)
+    elif workers > 0:
         from tclb_tpu.serve.pool import WorkerPool
         pool = WorkerPool(workers=workers,
                           heartbeat_timeout_s=args.heartbeat_timeout,
@@ -123,7 +145,8 @@ def run_gateway(args) -> int:
 
     tlive.register_drain_hook("gateway", _drain)
     print(f"gateway: {srv.url}/v1/jobs  (store: {svc.store.root}"
-          + (f", workers: {workers}" if pool is not None else "")
+          + (f", cluster: {pool.address}" if cluster_spec
+             else (f", workers: {workers}" if pool is not None else ""))
           + ")", flush=True)
     try:
         while not stop.is_set():

@@ -15,6 +15,10 @@ A stdlib-threaded (``http.server.ThreadingHTTPServer``) API surface over
   ``wait`` long-polls until a sample newer than ``since`` arrives or the
   job goes terminal — a dashboard costs kilobytes, not field dumps;
 * ``DELETE /v1/jobs/<id>`` (or ``POST /v1/jobs/<id>/cancel``) — cancel;
+* ``GET /v1/hosts``                — pod membership (cluster mode):
+  enrollment state, lanes, heartbeat ages, dead-host dumps; 404 when
+  the gateway serves through local lanes instead of a pod.  Read-only
+  operational telemetry, unauthenticated like ``/healthz``;
 * ``GET /healthz``                 — liveness (200 while the process
   answers at all);
 * ``GET /healthz/ready`` (alias ``/readyz``) — readiness: 503 +
@@ -55,6 +59,7 @@ _INDEX = (b"tclb_tpu gateway\n"
           b"  GET    /v1/jobs/<id>/stream?wait=N  latest progress sample "
           b"(long-poll)\n"
           b"  DELETE /v1/jobs/<id>              cancel\n"
+          b"  GET    /v1/hosts                  pod membership (cluster)\n"
           b"  GET    /healthz                   liveness\n"
           b"  GET    /healthz/ready             readiness (503 draining)\n")
 
@@ -180,6 +185,9 @@ class _Handler(BaseHTTPRequestHandler):
                 else:
                     self._send_json(503, {"ok": False,
                                           "retry_after_s": 5, **h})
+            elif parts == ["v1", "hosts"]:
+                code, doc = self.service.hosts()
+                self._send_json(code, doc)
             elif parts[:2] == ["v1", "jobs"] and len(parts) == 2:
                 code, doc = self.service.jobs(
                     tenant=(qs.get("tenant") or [None])[0],

@@ -71,6 +71,17 @@ class JobRecord:
         """The admission-control cost of this job: cells x niter x cases."""
         return int(self.cells) * int(self.niter) * int(self.n_cases)
 
+    def hosts(self) -> list:
+        """Distinct pod hosts that served this job's cases, in first-use
+        order (from the result rows' ``host`` stamps; empty when the job
+        ran on local lanes rather than through a cluster)."""
+        seen: list = []
+        for row in self.results or ():
+            h = row.get("host") if isinstance(row, dict) else None
+            if h is not None and h not in seen:
+                seen.append(h)
+        return seen
+
     def touch(self) -> None:
         self.updated_ts = round(time.time(), 6)
 
@@ -86,6 +97,9 @@ class JobRecord:
         """The API view: the record without the raw body's bulk."""
         doc = self.to_dict()
         doc["work"] = self.work()
+        hosts = self.hosts()
+        if hosts:
+            doc["hosts"] = hosts
         return doc
 
 

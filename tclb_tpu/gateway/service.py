@@ -416,7 +416,20 @@ class GatewayService:
                                "closing": self._closing}
         if workers is not None:
             doc["workers_live"] = workers
+        live_hosts = getattr(self._pool, "live_hosts", None)
+        if live_hosts is not None:
+            doc["hosts_live"] = live_hosts()
         return doc
+
+    def hosts(self) -> tuple[int, dict]:
+        """Pod membership view for ``GET /v1/hosts`` (cluster mode
+        only): enrollment state, lanes, heartbeat ages, and dead-host
+        dumps straight from the :class:`HostRegistry`."""
+        registry = getattr(self._pool, "registry", None)
+        if registry is None:
+            return 404, {"error": "no cluster control plane attached "
+                                  "(start the gateway with --cluster)"}
+        return 200, registry.snapshot()
 
     def drain(self, grace_s: float = 30.0) -> None:
         """Graceful shutdown, phase one: stop admission (submits answer
@@ -623,7 +636,9 @@ class GatewayService:
             self._finish_locked(rec, J.FAILED if errors else J.DONE)
 
     def _ckpt_root(self, job_id: str) -> str:
-        return os.path.join(self.store.root, "ckpt", job_id)
+        # the store owns the layout (and the pod's shared-filesystem
+        # resume contract documented there)
+        return self.store.ckpt_root(job_id)
 
     def _run_pooled(self, rec: JobRecord) -> None:
         """Drive one record through the process-isolated worker pool.
@@ -717,6 +732,11 @@ class GatewayService:
                    "globals": res.get("globals") or {}}
             if res.get("state_sha256"):
                 row["state_sha256"] = res["state_sha256"]
+            if res.get("host") is not None:
+                # pod mode: record which host served each case, so a
+                # sweep's spread across the pod is auditable from the
+                # job record alone
+                row["host"] = res["host"]
             results.append(row)
             resumed = res.get("resumed_from")
             if rec.resumable:
@@ -728,7 +748,8 @@ class GatewayService:
                         self._resumed += 1
                     telemetry.event("gateway.resumed", job_id=rec.id,
                                     tenant=rec.tenant, step=resumed,
-                                    lane=res.get("lane"))
+                                    lane=res.get("lane"),
+                                    host=res.get("host"))
                     telemetry.counter("gateway.jobs.resumed")
         rec.results = results
         rec.phases = phases or None

@@ -14,6 +14,14 @@ metadata):
   recovers every queued/running/done record (:mod:`service` then
   re-enqueues the non-terminal ones).
 
+The store root also anchors per-job checkpoint trees
+(:meth:`JobStore.ckpt_root` — ``<root>/ckpt/<job_id>``).  In pod mode
+this directory is the cross-host resume contract: every host-agent must
+see the same filesystem at the same path (NFS or equivalent), because a
+resumable job requeued off a dead host re-enters from
+``CheckpointManager.latest()`` under this root on whichever surviving
+host picks it up.
+
 Thread-safe; jax-free (HTTP handler threads write records directly).
 """
 
@@ -310,6 +318,13 @@ class JobStore:
                 self._journal = None
 
     # -- queries ------------------------------------------------------------ #
+
+    def ckpt_root(self, job_id: str) -> str:
+        """Canonical per-job checkpoint directory under the store root.
+        One definition on purpose: the serving path saves here and the
+        pod's cross-host resume contract (module doc) restores from
+        here — they must never drift apart."""
+        return os.path.join(self.root, "ckpt", job_id)
 
     def get(self, job_id: str) -> Optional[JobRecord]:
         with self._lock:

@@ -534,9 +534,13 @@ class FleetDispatcher:
         return job
 
     def _submit_pooled(self, spec: JobSpec) -> Job:
-        """Route one job through the process-isolated worker pool: the
-        spec crosses as plain JSON, the result comes back as a host-side
-        :class:`~tclb_tpu.serve.pool.PoolResult`."""
+        """Route one job through the process-isolated pool: the spec
+        crosses as plain JSON, the result comes back as a host-side
+        :class:`~tclb_tpu.serve.pool.PoolResult`.  Anything speaking
+        the pool protocol slots in via the ``pool=`` constructor arg —
+        a local :class:`WorkerPool` or a whole pod behind a
+        :class:`~tclb_tpu.cluster.server.ClusterServer` (the result
+        then carries its serving ``host``)."""
         from tclb_tpu.serve.pool import PoolResult, pool_doc_from_spec
         doc = pool_doc_from_spec(spec)   # rejects plan/grad specs early
         with self._lock:

@@ -63,7 +63,10 @@ from typing import Any, Callable, Optional
 
 from tclb_tpu import faults, telemetry
 from tclb_tpu.serve.retry import RetryPolicy
-from tclb_tpu.serve.worker import IpcError, npy_load, read_frame, write_frame
+# the !II frame protocol lives in cluster/wire.py (shared between the
+# worker pipe here and the pod control channel); worker re-exports it
+from tclb_tpu.cluster.wire import (IpcError, npy_load, read_frame,
+                                   write_frame)
 from tclb_tpu.telemetry import live as tlive
 from tclb_tpu.telemetry import locks
 from tclb_tpu.utils import log
@@ -132,6 +135,10 @@ class PoolResult:
         self.resumed_from = doc.get("resumed_from")
         self.lane = doc.get("lane")
         self.pid = doc.get("pid")
+        #: pod host id when the job came back through a cluster control
+        #: channel (None for local lanes) — lane/pid alone are ambiguous
+        #: across hosts
+        self.host = doc.get("host")
         self.fields = doc.get("fields")
 
 

@@ -54,80 +54,18 @@ library) can never corrupt the frame stream.
 from __future__ import annotations
 
 import argparse
-import io
-import json
 import os
-import struct
 import sys
 import time
 from typing import Any, BinaryIO, Optional
 
-_HEADER = struct.Struct("!II")
-
-#: refuse absurd frames instead of allocating unbounded buffers
-MAX_FRAME = 1 << 30
-
-
-class IpcError(RuntimeError):
-    """A torn or malformed frame on the worker pipe."""
-
-
-def write_frame(fh: BinaryIO, doc: dict, payload: bytes = b"") -> None:
-    """Write one length-prefixed frame: JSON doc + raw payload bytes."""
-    from tclb_tpu.telemetry import events
-    body = json.dumps(doc, default=events._json_default).encode()
-    fh.write(_HEADER.pack(len(body), len(payload)))
-    fh.write(body)
-    if payload:
-        fh.write(payload)
-    fh.flush()
-
-
-def _read_exact(fh: BinaryIO, n: int) -> bytes:
-    chunks = []
-    while n > 0:
-        chunk = fh.read(n)
-        if not chunk:
-            raise IpcError(f"pipe closed mid-frame ({n} bytes short)")
-        chunks.append(chunk)
-        n -= len(chunk)
-    return b"".join(chunks)
-
-
-def read_frame(fh: BinaryIO) -> tuple[dict, bytes]:
-    """Read one frame; EOFError on a clean close at a frame boundary,
-    :class:`IpcError` on a torn or malformed one."""
-    header = fh.read(_HEADER.size)
-    if not header:
-        raise EOFError("pipe closed")
-    if len(header) < _HEADER.size:
-        header += _read_exact(fh, _HEADER.size - len(header))
-    body_len, payload_len = _HEADER.unpack(header)
-    if body_len > MAX_FRAME or payload_len > MAX_FRAME:
-        raise IpcError(f"oversized frame ({body_len}+{payload_len} bytes)")
-    try:
-        doc = json.loads(_read_exact(fh, body_len).decode())
-    except (ValueError, UnicodeDecodeError) as e:
-        raise IpcError(f"malformed frame body: {e}") from e
-    payload = _read_exact(fh, payload_len) if payload_len else b""
-    if not isinstance(doc, dict):
-        raise IpcError("frame body must be a JSON object")
-    return doc, payload
-
-
-def npy_bytes(arr) -> bytes:
-    """Serialize a host array as ``.npy`` bytes (the only array wire
-    format — plain data, never pickles)."""
-    import numpy as np
-    buf = io.BytesIO()
-    np.save(buf, np.ascontiguousarray(np.asarray(arr)),
-            allow_pickle=False)
-    return buf.getvalue()
-
-
-def npy_load(payload: bytes):
-    import numpy as np
-    return np.load(io.BytesIO(payload), allow_pickle=False)
+# the frame protocol grew up here and moved to cluster/wire.py when the
+# control channel adopted it; re-exported so existing imports
+# (`from tclb_tpu.serve.worker import read_frame`, the pool, tests)
+# keep working
+from tclb_tpu.cluster.wire import (MAX_FRAME, IpcError,  # noqa: F401
+                                   npy_bytes, npy_load, read_frame,
+                                   write_frame)
 
 
 # --------------------------------------------------------------------------- #

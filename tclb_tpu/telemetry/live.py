@@ -124,6 +124,19 @@ _META = {
     "tclb_gateway_phase_seconds": ("histogram",
                                    "Gateway job phase latency (queue_wait/"
                                    "stage/solve/d2h/e2e), by phase"),
+    "tclb_cluster_hosts_enrolled_total": ("counter",
+                                          "Pod host-agents enrolled, by "
+                                          "host"),
+    "tclb_cluster_hosts_lost_total": ("counter",
+                                      "Pod host-agents lost (channel "
+                                      "death or heartbeat timeout), by "
+                                      "host"),
+    "tclb_cluster_hosts_rejoined_total": ("counter",
+                                          "Pod host-agents re-enrolled "
+                                          "after a loss, by host"),
+    "tclb_cluster_jobs_requeued_total": ("counter",
+                                         "Cluster jobs requeued after a "
+                                         "host death, by host"),
 }
 
 CONTENT_TYPE = "text/plain; version=0.0.4; charset=utf-8"
@@ -311,24 +324,23 @@ def _observe(doc: dict) -> None:
         name = doc.get("name")
         dur = doc.get("dur_s")
         if name == "iterate":
-            # relayed worker spans carry a worker_pid stamp; keep it as
-            # a label so per-process series survive worker restarts
+            # relayed worker spans carry a worker_pid stamp — and,
+            # through an enrolled host-agent, a host stamp; both become
+            # labels so per-process series survive worker restarts and
+            # two hosts reusing a pid stay distinct series
             wp = doc.get("worker_pid")
+            wlbl: dict = {}
+            if wp is not None:
+                wlbl["worker_pid"] = str(wp)
+                if doc.get("host") is not None:
+                    wlbl["host"] = str(doc["host"])
             if dur is not None:
-                if wp is not None:
-                    reg.observe("tclb_iterate_seconds", dur,
-                                worker_pid=str(wp))
-                else:
-                    reg.observe("tclb_iterate_seconds", dur)
+                reg.observe("tclb_iterate_seconds", dur, **wlbl)
             engine = str(doc.get("engine", "?"))
             model = str(doc.get("model", "?"))
             if doc.get("mlups") is not None:
-                if wp is not None:
-                    reg.gauge("tclb_mlups", doc["mlups"], engine=engine,
-                              model=model, worker_pid=str(wp))
-                else:
-                    reg.gauge("tclb_mlups", doc["mlups"],
-                              engine=engine, model=model)
+                reg.gauge("tclb_mlups", doc["mlups"], engine=engine,
+                          model=model, **wlbl)
             if doc.get("vs_roofline") is not None:
                 reg.gauge("tclb_vs_roofline", doc["vs_roofline"],
                           engine=engine)
@@ -349,6 +361,8 @@ def _observe(doc: dict) -> None:
             if wp is not None:
                 last["worker_pid"] = wp
                 last["lane"] = doc.get("lane")
+                if doc.get("host") is not None:
+                    last["host"] = doc["host"]
             reg.set_info("last_iterate", last)
         elif name in ("serve.batch", "serve.lane_batch"):
             if dur is not None:
@@ -412,6 +426,18 @@ def _observe(doc: dict) -> None:
     elif kind == "serve.worker_restarted":
         reg.count("tclb_pool_workers_restarted_total", 1.0,
                   lane=str(doc.get("lane", "?")))
+    elif kind == "gateway.host_enrolled":
+        reg.count("tclb_cluster_hosts_enrolled_total", 1.0,
+                  host=str(doc.get("host", "?")))
+    elif kind == "gateway.host_lost":
+        reg.count("tclb_cluster_hosts_lost_total", 1.0,
+                  host=str(doc.get("host", "?")))
+    elif kind == "gateway.host_rejoined":
+        reg.count("tclb_cluster_hosts_rejoined_total", 1.0,
+                  host=str(doc.get("host", "?")))
+    elif kind == "cluster.job_requeued":
+        reg.count("tclb_cluster_jobs_requeued_total", 1.0,
+                  host=str(doc.get("host", "?")))
     elif kind == "gateway.job_done":
         reg.count("tclb_gateway_jobs_total", 1.0,
                   status=str(doc.get("status", "?")))
@@ -460,7 +486,8 @@ def prometheus_text() -> str:
 # -- flight recorder ---------------------------------------------------------- #
 
 #: event kinds that trigger an automatic ring dump
-DUMP_KINDS = frozenset({"failcheck", "serve.device_evicted"})
+DUMP_KINDS = frozenset({"failcheck", "serve.device_evicted",
+                        "gateway.host_lost"})
 
 FLIGHT_CAPACITY = 4096
 

@@ -598,6 +598,15 @@ _TIMELINE_VERBS = {
     "serve.pool_job_started": "worker-sent",
     "serve.pool_job_requeued": "requeued",
     "serve.pool_job_done": "pool-done",
+    # cluster verbs: on a pod the same timeline crosses hosts —
+    # admission -> host dispatch -> worker iterate spans -> done, each
+    # relayed event carrying its `host` stamp
+    "cluster.job_dispatched": "host-sent",
+    "cluster.job_requeued": "requeued",
+    "cluster.job_done": "host-done",
+    "gateway.host_enrolled": "host-enroll",
+    "gateway.host_lost": "host-lost",
+    "gateway.host_rejoined": "host-rejoin",
 }
 
 

@@ -568,6 +568,130 @@ def test_pool_telemetry_relay_schedule_never_blocks_jobs(tmp_path, mode):
         telemetry.unsubscribe(evts.append)
 
 
+# --------------------------------------------------------------------------- #
+# Cluster schedules: the three cluster.* injection points
+# --------------------------------------------------------------------------- #
+
+
+def test_cluster_points_registered_and_spec_roundtrips():
+    """The three cluster.* injection points are in the authoritative
+    registry and a combined schedule round-trips through to_spec — the
+    serialization TCLB_FAULTS carries into agent subprocesses."""
+    for point in ("cluster.enroll", "cluster.channel",
+                  "cluster.host_exit"):
+        assert point in faults.POINTS
+    plan = FaultPlan.parse(
+        "seed=42;cluster.enroll:error:n=1;"
+        "cluster.channel:torn:n=1:after=2;"
+        "cluster.host_exit:error:n=1:after=3;cluster.channel:slow:delay=0.01")
+    assert FaultPlan.parse(plan.to_spec()) == plan
+
+
+def _cluster_stub_cmd(tmp_path):
+    from test_cluster import STUB_WORKER
+    tmp_path.mkdir(parents=True, exist_ok=True)
+    script = tmp_path / "stub.py"
+    script.write_text(STUB_WORKER)
+    import sys as _sys
+    return [_sys.executable, str(script)]
+
+
+def _cluster_pair(tmp_path, **server_kw):
+    """One in-process ClusterServer + two stub-pool agents."""
+    from test_cluster import _agent, _wait
+    from tclb_tpu.cluster.server import ClusterServer
+    stub = _cluster_stub_cmd(tmp_path)
+    srv = ClusterServer(**server_kw)
+    srv.start()
+    agents = [_agent(srv, h, stub).start() for h in ("hA", "hB")]
+    _wait(lambda: srv.live_hosts() == 2, what="two enrollments")
+    return srv, agents
+
+
+def _run_cluster_burst(srv, n=6):
+    jobs = [srv.submit({"n": i, "niter": 2}) for i in range(n)]
+    return {i: j.result(timeout=120)["state_sha256"]
+            for i, j in enumerate(jobs)}, jobs
+
+
+CLUSTER_SCHEDULES = [
+    # a torn control frame mid-dispatch: the host is marked lost, its
+    # job requeues on the survivor
+    "seed=19;cluster.channel:torn:n=1",
+    # a hard channel error on a result receive: same requeue contract
+    "seed=31;cluster.channel:error:n=1:after=4",
+    # slow control-plane ops must add latency only, never lose a job
+    "seed=47;cluster.channel:slow:delay=0.02:p=0.5:n=6",
+]
+
+
+@pytest.mark.parametrize("schedule", CLUSTER_SCHEDULES)
+def test_cluster_channel_schedule_zero_lost_bit_identical(
+        tmp_path, monkeypatch, schedule):
+    """Seeded cluster.channel schedules against a 2-host pod: every
+    job completes (zero lost) and every digest matches the clean run —
+    a control channel tearing mid-frame moves work, never corrupts
+    it."""
+    monkeypatch.setenv("TCLB_FLIGHT_DIR", str(tmp_path / "flight"))
+    srv, agents = _cluster_pair(tmp_path / "clean")
+    try:
+        clean, _ = _run_cluster_burst(srv)
+    finally:
+        for a in agents:
+            a.stop()
+        srv.close(wait=False)
+
+    faults.install(FaultPlan.parse(schedule))
+    srv, agents = _cluster_pair(tmp_path / "chaos", job_attempts=3)
+    try:
+        got, jobs = _run_cluster_burst(srv)
+        assert got == clean                     # bit-identical digests
+        assert all(j.status == "done" for j in jobs)  # zero lost
+        st = srv.stats()
+        assert st["done"] == 6 and st["failed"] == 0
+        if "torn" in schedule or "error" in schedule:
+            assert st["requeued"] >= 1
+            assert st["hosts_live"] >= 1        # the survivor kept serving
+        assert sum(r["count"] for r in faults.stats()["injected"]) >= 1
+    finally:
+        for a in agents:
+            a.stop()
+        srv.close(wait=False)
+        faults.uninstall()
+
+
+def test_cluster_enroll_fault_refused_then_rejoins(tmp_path, monkeypatch):
+    """An injected cluster.enroll error refuses the first enrollment
+    (gateway.host_rejected); the agent's reconnect loop re-enrolls once
+    the budget is spent and the pod serves normally."""
+    from test_cluster import STUB_WORKER, _agent, _wait
+    from tclb_tpu.cluster.server import ClusterServer
+    monkeypatch.setenv("TCLB_FLIGHT_DIR", str(tmp_path / "flight"))
+    script = tmp_path / "stub.py"
+    script.write_text(STUB_WORKER)
+    import sys as _sys
+    evts = []
+    telemetry.subscribe(evts.append)
+    faults.install(FaultPlan.parse("seed=3;cluster.enroll:error:n=1"))
+    srv = ClusterServer()
+    agent = None
+    try:
+        srv.start()
+        agent = _agent(srv, "hA", [_sys.executable, str(script)]).start()
+        _wait(lambda: srv.live_hosts() == 1, what="post-refusal enroll")
+        res = srv.submit({"n": 5}).result(timeout=60)
+        assert res["host"] == "hA"
+        assert any(e.get("kind") == "gateway.host_rejected"
+                   for e in evts)
+        assert faults.stats()["injected"][0]["count"] == 1
+    finally:
+        if agent is not None:
+            agent.stop()
+        srv.close(wait=False)
+        faults.uninstall()
+        telemetry.unsubscribe(evts.append)
+
+
 @pytest.mark.slow
 def test_pool_heartbeat_schedule_hang_detected(tmp_path):
     """Seeded worker-side schedule (pool.heartbeat wedge): the beat

@@ -513,3 +513,114 @@ def test_kill_resume_bit_identical(tmp_path):
     np.testing.assert_array_equal(got["fields"], ref["fields"])
     np.testing.assert_array_equal(got["flags"], ref["flags"])
     assert int(got["iteration"]) == int(ref["iteration"]) == 40
+
+
+# --------------------------------------------------------------------------- #
+# Multi-host save/restore: two real OS processes, one checkpoint
+# --------------------------------------------------------------------------- #
+
+# Each process plays ONE host of a {"y": 2, "x": 1} pod mesh: it builds
+# the same lattice over two forced host devices, iterates, then writes
+# ONLY its own host's addressable shard via write_shard_fragment — the
+# exact per-process call CheckpointManager makes under jax.process_count
+# > 1.  Process 0 additionally merges the fragments into the manifest
+# (the main-process half of the barrier protocol; serial child execution
+# stands in for the barrier).
+_MULTIHOST_WRITER = """
+import json, sys
+
+import jax
+jax.config.update("jax_platforms", "cpu")
+jax.config.update("jax_enable_x64", True)
+
+from tclb_tpu.checkpoint import restore as rst
+from tclb_tpu.parallel.mesh import make_mesh
+
+proc, outdir = int(sys.argv[1]), sys.argv[2]
+
+from tclb_tpu.core.lattice import Lattice
+from tclb_tpu.models import get_model
+import numpy as np
+
+m = get_model("d2q9")
+mesh = make_mesh((16, 32), devices=jax.devices()[:2],
+                 decomposition={{"y": 2, "x": 1}})
+lat = Lattice(m, (16, 32), dtype=jax.numpy.float64,
+              settings={{"nu": 0.05, "Velocity": 0.02}}, mesh=mesh)
+flags = np.zeros((16, 32), dtype=np.uint16)
+flags[0, :] = flags[-1, :] = m.node_types["Wall"].value
+lat.set_flags(flags)
+lat.init()
+lat.iterate(12)
+captured = rst.capture_lattice(lat)
+# this process's addressable shards: on a real pod each host only SEES
+# its own devices; emulate by keeping the shard at mesh row `proc`
+for val in captured["arrays"].values():
+    if isinstance(val, rst.ShardedCapture):
+        val.shards = [s for s in val.shards
+                      if s["coords"].get("y") == proc]
+        assert len(val.shards) == 1, val.shards
+rst.write_shard_fragment(outdir, captured, proc)
+if proc == 0:
+    total = rst.write_checkpoint_files(outdir, captured,
+                                       merge_fragments=True)
+    print("merged", total)
+print("ok", proc)
+"""
+
+
+def test_multihost_two_process_save_restores_bit_identical(tmp_path):
+    """Two OS processes each write their own host's shard fragment of a
+    2-host mesh checkpoint; the merged manifest restores onto a 1-host
+    lattice and back onto a sharded one, bit-identical fields + globals
+    against an uninterrupted single-process reference."""
+    import jax
+    script = tmp_path / "writer.py"
+    script.write_text(_MULTIHOST_WRITER.format(repo=REPO))
+    d = tmp_path / "ck"
+    d.mkdir()
+    env = dict(os.environ, JAX_PLATFORMS="cpu", PYTHONPATH=REPO,
+               XLA_FLAGS="--xla_force_host_platform_device_count=2")
+    # host 1 first, then host 0 merging — the manager's barrier means
+    # every fragment has landed before the main process merges
+    for proc in (1, 0):
+        r = subprocess.run(
+            [sys.executable, str(script), str(proc), str(d)],
+            env=env, cwd=REPO, capture_output=True, text=True,
+            timeout=600)
+        assert r.returncode == 0, r.stderr
+        assert f"ok {proc}" in r.stdout
+    assert "merged" in r.stdout
+
+    # the merged checkpoint is whole: 2 shard files per sharded array,
+    # fragments consumed, manifest verifies
+    assert mf.verify_checkpoint(str(d)) == []
+    assert not [f for f in os.listdir(d) if f.startswith("fragment.")]
+    shard_files = [f for f in os.listdir(d) if f.startswith("fields@")]
+    assert len(shard_files) == 2
+    man = mf.read_manifest(str(d))
+    assert man["mesh"] == {"axes": {"y": 2, "x": 1}}
+
+    # reference: the same run, single process, no mesh
+    ref = _make_lattice()
+    ref.iterate(12)
+
+    # restore onto a 1-host (unsharded) lattice: bit-identical
+    plain = _make_lattice()
+    got = ckpt.restore_lattice(plain, str(d))
+    assert got["iteration"] == 12
+    assert_lattices_identical(ref, plain)
+    np.testing.assert_array_equal(np.asarray(plain.state.globals_),
+                                  np.asarray(ref.state.globals_))
+
+    # ... and back onto a sharded layout (different decomposition than
+    # the writers used), still bit-identical, still iterating in step
+    mesh = make_mesh((16, 32), devices=jax.devices()[:4],
+                     decomposition={"y": 4, "x": 1})
+    sharded = _make_lattice(mesh=mesh)
+    ckpt.restore_lattice(sharded, str(d))
+    assert_lattices_identical(ref, sharded)
+    ref.iterate(6)
+    sharded.iterate(6)
+    np.testing.assert_array_equal(np.asarray(sharded.state.fields),
+                                  np.asarray(ref.state.fields))

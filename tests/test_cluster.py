@@ -1,0 +1,671 @@
+"""Cross-host pod serving tests: the cluster control plane
+(:class:`ClusterServer` + :class:`HostRegistry`) and the host-agent
+data plane (:class:`ClusterAgent`), in-process over stub-worker pools.
+
+Covered contracts:
+
+* the shared ``!II`` wire: framed duplex :class:`Channel` roundtrips
+  (with ``.npy`` payloads) and the ``tear()`` chaos helper producing a
+  mid-frame :class:`IpcError` at the peer;
+* routing: fair-share spread of a job burst across enrolled hosts,
+  resumable ``ckpt_root`` affinity while the owner lives, affinity
+  dissolution on host death, and exactly-once in-flight claiming by
+  ``mark_lost`` no matter which thread notices a death first;
+* enroll / serve / result plumbing end-to-end: results carry the
+  serving ``host`` stamp, relayed telemetry is re-emitted gateway-side
+  with a ``host`` stamp, and the ``hosts`` ``/status`` provider and
+  ``GET /v1/hosts`` snapshot reflect enrollment state;
+* requeue-on-host-death: an abruptly lost host's in-flight jobs finish
+  on the surviving host (zero lost), the loss leaves a dead-host dump
+  and a flight-recorder file, and a re-enrollment under the same host
+  id bumps the incarnation (``gateway.host_rejoined``).
+
+The agents here run in-process against stub worker pools (no solver
+imports — milliseconds per job); the full multi-process pod smoke
+(separate gateway + agent OS processes, SIGKILL mid-solve, digest
+parity) runs in CI's ``pod`` job.
+"""
+
+import json
+import os
+import signal
+import socket
+import subprocess
+import sys
+import time
+import urllib.error
+import urllib.request
+
+import numpy as np
+import pytest
+
+from tclb_tpu import faults, telemetry
+from tclb_tpu.cluster import wire
+from tclb_tpu.cluster.agent import ClusterAgent
+from tclb_tpu.cluster.registry import HostRegistry
+from tclb_tpu.cluster.server import ClusterServer
+from tclb_tpu.gateway.service import GatewayService
+from tclb_tpu.serve.pool import WorkerPool
+from tclb_tpu.serve.retry import RetryPolicy
+from tclb_tpu.telemetry import live
+
+
+@pytest.fixture(autouse=True)
+def _clean(tmp_path, monkeypatch):
+    # host-loss events trigger automatic flight dumps: keep them in tmp
+    monkeypatch.setenv("TCLB_FLIGHT_DIR", str(tmp_path / "flight"))
+    telemetry.disable()
+    live.registry().reset()
+    faults.uninstall()
+    yield
+    faults.uninstall()
+    telemetry.disable()
+    live.registry().reset()
+
+
+# --------------------------------------------------------------------------- #
+# Wire: framed channels over a socket
+# --------------------------------------------------------------------------- #
+
+
+def test_channel_roundtrip_and_tear():
+    sa, sb = socket.socketpair()
+    a, b = wire.Channel(sa, peer="a"), wire.Channel(sb, peer="b")
+    try:
+        arr = np.arange(6, dtype=np.float64).reshape(2, 3)
+        a.send({"t": "result", "id": "cj-1", "ok": True},
+               wire.npy_bytes(arr))
+        doc, payload = b.recv()
+        assert doc["t"] == "result" and doc["ok"] is True
+        np.testing.assert_array_equal(wire.npy_load(payload), arr)
+        # tear(): the peer sees a mid-frame IpcError, not a clean EOF
+        a.tear()
+        with pytest.raises(wire.IpcError):
+            b.recv()
+    finally:
+        a.close()
+        b.close()
+
+
+def test_channel_close_gives_clean_eof():
+    sa, sb = socket.socketpair()
+    a, b = wire.Channel(sa, peer="a"), wire.Channel(sb, peer="b")
+    a.send({"t": "hb"})
+    assert b.recv()[0] == {"t": "hb"}
+    a.close()
+    with pytest.raises(EOFError):
+        b.recv()
+    b.close()
+
+
+# --------------------------------------------------------------------------- #
+# Registry: routing + death bookkeeping (no sockets)
+# --------------------------------------------------------------------------- #
+
+
+class _Job:
+    def __init__(self, jid):
+        self.id = jid
+
+
+def test_registry_fair_share_spreads_burst():
+    reg = HostRegistry()
+    a, _, _ = reg.enroll("A", 1, lanes=1, channel=None)
+    b, _, _ = reg.enroll("B", 2, lanes=1, channel=None)
+    counts = {"A": 0, "B": 0}
+    for i in range(8):
+        rec = reg.pick({"job_id": f"j{i}"})
+        assert reg.assign(rec, _Job(f"j{i}"))
+        counts[rec.host] += 1
+    # load-per-lane routing: an 8-job burst lands 4/4, not 8/0
+    assert counts == {"A": 4, "B": 4}
+
+
+def test_registry_resumable_affinity_until_owner_dies():
+    reg = HostRegistry()
+    reg.enroll("A", 1, lanes=1, channel=None)
+    reg.enroll("B", 2, lanes=1, channel=None)
+    doc = {"ckpt_root": "/store/ckpt/j-7"}
+    owner = reg.pick(doc).host
+    for _ in range(4):          # segments stick to the warm host
+        assert reg.pick(doc).host == owner
+    jobs = reg.mark_lost(reg.get(owner), "preempted")
+    assert jobs == []
+    other = reg.pick(doc)
+    assert other is not None and other.host != owner
+    snap = reg.snapshot()
+    assert snap["dead_host_dumps"][-1]["host"] == owner
+
+
+def test_registry_mark_lost_claims_inflight_exactly_once():
+    reg = HostRegistry()
+    rec, _, _ = reg.enroll("A", 1, lanes=2, channel=None)
+    reg.assign(rec, _Job("j1"))
+    reg.assign(rec, _Job("j2"))
+    jobs = reg.mark_lost(rec, "channel closed")
+    assert sorted(j.id for j in jobs) == ["j1", "j2"]
+    # the racing watchdog/reader gets None and must not requeue again
+    assert reg.mark_lost(rec, "heartbeat timeout") is None
+    assert reg.live() == [] and reg.live_lanes() == 0
+
+
+def test_registry_rejoin_bumps_incarnation():
+    reg = HostRegistry()
+    first, rejoined, stale = reg.enroll("A", 1, lanes=1, channel=None)
+    assert first.incarnation == 0 and not rejoined and stale is None
+    reg.mark_lost(first, "gone")
+    second, rejoined, stale = reg.enroll("A", 9, lanes=2, channel=None)
+    assert second.incarnation == 1 and rejoined and stale is None
+    # a still-live duplicate is handed back for teardown
+    third, rejoined, stale = reg.enroll("A", 10, lanes=2, channel=None)
+    assert third.incarnation == 2 and rejoined and stale is second
+
+
+# --------------------------------------------------------------------------- #
+# Server + agents in-process over stub pools
+# --------------------------------------------------------------------------- #
+
+STUB_WORKER = """
+import hashlib, json, os, struct, sys, time
+H = struct.Struct("!II")
+out = os.fdopen(os.dup(1), "wb")
+os.dup2(2, 1)
+inp = os.fdopen(os.dup(0), "rb")
+lane = int(sys.argv[sys.argv.index("--lane") + 1])
+
+def send(doc):
+    body = json.dumps(doc).encode()
+    out.write(H.pack(len(body), 0)); out.write(body); out.flush()
+
+def recv():
+    h = inp.read(H.size)
+    if len(h) < H.size:
+        raise EOFError
+    bl, pl = H.unpack(h)
+    doc = json.loads(inp.read(bl).decode())
+    inp.read(pl)
+    return doc
+
+send({"t": "ready", "pid": os.getpid(), "lane": lane})
+while True:
+    try:
+        doc = recv()
+    except EOFError:
+        sys.exit(0)
+    if doc.get("t") == "shutdown":
+        sys.exit(0)
+    if doc.get("t") != "job":
+        continue
+    jid, spec = doc["id"], doc.get("spec") or {}
+    send({"t": "hb", "id": jid})
+    time.sleep(float(spec.get("sleep", 0)))
+    work = {k: v for k, v in spec.items() if k != "sleep"}
+    digest = hashlib.sha256(
+        json.dumps(work, sort_keys=True).encode()).hexdigest()
+    send({"t": "result", "id": jid, "ok": True, "lane": lane,
+          "pid": os.getpid(), "globals": {"n": spec.get("n")},
+          "state_sha256": digest, "iteration": spec.get("niter", 0)})
+"""
+
+
+@pytest.fixture()
+def stub_cmd(tmp_path):
+    script = tmp_path / "stub_worker.py"
+    script.write_text(STUB_WORKER)
+    return [sys.executable, str(script)]
+
+
+def _stub_pool(stub_cmd, workers=1):
+    return WorkerPool(worker_cmd=stub_cmd, workers=workers,
+                      heartbeat_timeout_s=30.0, spawn_timeout_s=30.0,
+                      term_grace_s=0.5,
+                      retry_policy=RetryPolicy(max_attempts=4,
+                                               base_delay_s=0.02,
+                                               max_delay_s=0.1),
+                      autostart=False)
+
+
+def _agent(server, host_id, stub_cmd, workers=1, relay=False):
+    return ClusterAgent(server.address, host_id=host_id,
+                        hb_interval_s=0.2,
+                        relay=relay,
+                        pool=_stub_pool(stub_cmd, workers=workers))
+
+
+def _wait(cond, timeout=60, what="condition"):
+    deadline = time.monotonic() + timeout
+    while time.monotonic() < deadline:
+        if cond():
+            return
+        time.sleep(0.02)
+    raise AssertionError(f"timed out waiting for {what}")
+
+
+def test_agent_enrolls_and_serves_with_host_stamp(stub_cmd):
+    evts = []
+    telemetry.subscribe(evts.append)
+    srv = ClusterServer(heartbeat_timeout_s=10.0)
+    agent = None
+    try:
+        srv.start()
+        agent = _agent(srv, "h1", stub_cmd).start()
+        _wait(lambda: srv.live_hosts() == 1, what="enrollment")
+        assert srv.live_workers() >= 1
+        jobs = [srv.submit({"n": i, "niter": 3}) for i in range(3)]
+        for i, j in enumerate(jobs):
+            res = j.result(timeout=60)
+            assert res["globals"] == {"n": i}
+            assert res["host"] == "h1"          # the serving host stamp
+            assert res["iteration"] == 3
+        st = srv.stats()
+        assert st["done"] == 3 and st["failed"] == 0
+        assert st["hosts_live"] == 1
+        # the hosts /status provider reflects the enrollment
+        snap = live.status_snapshot()["hosts"]
+        (h,) = snap["hosts"]
+        assert h["host"] == "h1" and h["state"] == "live"
+        assert h["incarnation"] == 0 and h["jobs_done"] == 3
+        assert h["last_heartbeat_age_s"] < 10.0
+        kinds = [e.get("kind") for e in evts]
+        assert "gateway.host_enrolled" in kinds
+        assert "cluster.job_dispatched" in kinds
+        assert "cluster.job_done" in kinds
+    finally:
+        if agent is not None:
+            agent.stop()
+        srv.close(wait=False)
+        telemetry.unsubscribe(evts.append)
+    assert "hosts" not in live.status_snapshot()
+
+
+def test_job_burst_fair_shares_across_two_hosts(stub_cmd):
+    srv = ClusterServer()
+    agents = []
+    try:
+        srv.start()
+        agents = [_agent(srv, h, stub_cmd).start() for h in ("hA", "hB")]
+        _wait(lambda: srv.live_hosts() == 2, what="two enrollments")
+        jobs = [srv.submit({"n": i, "sleep": 0.3}) for i in range(6)]
+        served = {j.result(timeout=120)["host"] for j in jobs}
+        # the burst spread: neither host swallowed the whole sweep
+        assert served == {"hA", "hB"}
+        assert srv.stats()["done"] == 6
+    finally:
+        for a in agents:
+            a.stop()
+        srv.close(wait=False)
+
+
+def test_host_death_requeues_inflight_and_rejoins(stub_cmd, tmp_path):
+    """Kill one of two hosts mid-burst: every job still completes on
+    the survivor (zero lost), the loss is recorded (event + dead-host
+    dump + flight file), and a restarted agent under the same host id
+    re-enrolls at the next incarnation."""
+    evts = []
+    telemetry.subscribe(evts.append)
+    srv = ClusterServer(job_attempts=3, heartbeat_timeout_s=10.0)
+    b = rejoin = None
+    try:
+        srv.start()
+        a = _agent(srv, "hA", stub_cmd).start()
+        b = _agent(srv, "hB", stub_cmd).start()
+        _wait(lambda: srv.live_hosts() == 2, what="two enrollments")
+        jobs = [srv.submit({"n": i, "sleep": 0.5}) for i in range(4)]
+        _wait(lambda: len(srv.registry.get("hA").inflight) >= 1,
+              what="a job in flight on hA")
+        a.stop()                       # abrupt: no result for its jobs
+        for i, j in enumerate(jobs):   # zero lost: all complete on hB
+            res = j.result(timeout=120)
+            assert res["globals"] == {"n": i}
+        hosts = {j._result["host"] for j in jobs}
+        assert "hB" in hosts
+        st = srv.stats()
+        assert st["done"] == 4 and st["failed"] == 0
+        assert st["requeued"] >= 1
+        lost = next(e for e in evts
+                    if e.get("kind") == "gateway.host_lost")
+        assert lost["host"] == "hA" and lost["jobs_requeued"] >= 1
+        assert any(e.get("kind") == "cluster.job_requeued"
+                   for e in evts)
+        snap = srv.registry.snapshot()
+        assert snap["dead_host_dumps"][-1]["host"] == "hA"
+        # the loss dumped the flight recorder for the post-mortem
+        flight = tmp_path / "flight"
+        assert flight.exists() and any(
+            n.startswith("flight-") for n in os.listdir(flight))
+        # restart under the same id: rejoin at the next incarnation
+        rejoin = _agent(srv, "hA", stub_cmd).start()
+        _wait(lambda: (srv.registry.get("hA").state == "live"
+                       and srv.registry.get("hA").incarnation == 1),
+              what="hA rejoin")
+        assert any(e.get("kind") == "gateway.host_rejoined"
+                   and e.get("host") == "hA" for e in evts)
+        res = srv.submit({"n": 99}).result(timeout=60)
+        assert res["host"] in ("hA", "hB")
+    finally:
+        for ag in (b, rejoin):
+            if ag is not None:
+                ag.stop()
+        srv.close(wait=False)
+        telemetry.unsubscribe(evts.append)
+
+
+def test_relayed_telemetry_reemitted_with_host_stamp(stub_cmd):
+    """Agent-side pool events cross the control channel and re-emit in
+    the gateway's fan-out stamped with the originating host, so one
+    trace renders a cross-host timeline even when two hosts reuse a
+    worker pid."""
+    evts = []
+    telemetry.subscribe(evts.append)
+    srv = ClusterServer()
+    agent = None
+    try:
+        srv.start()
+        agent = _agent(srv, "h1", stub_cmd, relay=True).start()
+        _wait(lambda: srv.live_hosts() == 1, what="enrollment")
+        assert srv.submit({"n": 0}).result(timeout=60)["host"] == "h1"
+
+        def relayed():
+            return [e for e in evts
+                    if e.get("kind") == "serve.pool_job_started"
+                    and e.get("host") == "h1"]
+
+        _wait(relayed, what="a host-stamped relayed pool event")
+        # the direct (agent-local) emission has no host; the relayed
+        # re-emission is the disambiguated cross-host copy
+        assert any(e.get("kind") == "serve.pool_job_started"
+                   and "host" not in e for e in evts)
+    finally:
+        if agent is not None:
+            agent.stop()
+        srv.close(wait=False)
+        telemetry.unsubscribe(evts.append)
+
+
+def test_empty_pod_holds_jobs_until_first_enrollment(stub_cmd):
+    srv = ClusterServer()
+    agent = None
+    try:
+        srv.start()
+        job = srv.submit({"n": 1})     # no hosts yet: waits, no fail-fast
+        time.sleep(0.3)
+        assert not job.done
+        agent = _agent(srv, "late", stub_cmd).start()
+        assert job.result(timeout=60)["host"] == "late"
+    finally:
+        if agent is not None:
+            agent.stop()
+        srv.close(wait=False)
+
+
+def test_close_fails_pending_jobs_fast(stub_cmd):
+    srv = ClusterServer()
+    srv.start()
+    job = srv.submit({"n": 1})         # empty pod: would wait forever
+    srv.close(wait=False)
+    from tclb_tpu.serve.pool import PoolJobError
+    with pytest.raises(PoolJobError, match="closed"):
+        job.result(timeout=10)
+    with pytest.raises(PoolJobError, match="closed"):
+        srv.submit({"n": 2})
+
+
+# --------------------------------------------------------------------------- #
+# Gateway surface: /v1/hosts provider
+# --------------------------------------------------------------------------- #
+
+
+def test_gateway_hosts_endpoint_requires_cluster(tmp_path):
+    svc = GatewayService(str(tmp_path / "store"))
+    try:
+        code, doc = svc.hosts()
+        assert code == 404 and "--cluster" in doc["error"]
+    finally:
+        svc.store.close()
+
+
+def test_gateway_hosts_endpoint_snapshots_registry(tmp_path, stub_cmd):
+    srv = ClusterServer()
+    svc = GatewayService(str(tmp_path / "store"), pool=srv)
+    agent = None
+    try:
+        srv.start()
+        agent = _agent(srv, "pod-0", stub_cmd).start()
+        _wait(lambda: srv.live_hosts() == 1, what="enrollment")
+        code, doc = svc.hosts()
+        assert code == 200
+        (h,) = doc["hosts"]
+        assert h["host"] == "pod-0" and h["state"] == "live"
+        assert h["lanes"] == 1
+        assert svc.health()["hosts_live"] == 1
+    finally:
+        if agent is not None:
+            agent.stop()
+        srv.close(wait=False)
+        svc.store.close()
+
+
+# --------------------------------------------------------------------------- #
+# Full pod smoke: real gateway + agent OS processes (CI `pod` job)
+# --------------------------------------------------------------------------- #
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def _pod_env(tmp_path, tag, trace=None):
+    env = dict(os.environ, JAX_PLATFORMS="cpu", PYTHONPATH=REPO,
+               TCLB_FLIGHT_DIR=str(tmp_path / f"flight-{tag}"))
+    # the gateway's trace must not leak into agents (nor any ambient
+    # fault schedule into either side)
+    env.pop("TCLB_TELEMETRY", None)
+    env.pop("TCLB_FAULTS", None)
+    if trace is not None:
+        env["TCLB_TELEMETRY"] = str(trace)
+    return env
+
+
+def _http(url, method="GET", body=None, timeout=300):
+    req = urllib.request.Request(
+        url, method=method,
+        data=json.dumps(body).encode() if body is not None else None,
+        headers={"Content-Type": "application/json"})
+    try:
+        with urllib.request.urlopen(req, timeout=timeout) as resp:
+            return resp.status, json.loads(resp.read())
+    except urllib.error.HTTPError as e:
+        return e.code, json.loads(e.read())
+
+
+def _spawn_pod_gateway(tmp_path, store, tag):
+    """Start ``python -m tclb_tpu gateway --cluster`` (pod mode: zero
+    local lanes) and parse the three addresses it prints — HTTP front
+    door, monitor, and the cluster control plane agents dial."""
+    logf = open(tmp_path / f"gateway-{tag}.log", "w+")
+    proc = subprocess.Popen(
+        [sys.executable, "-m", "tclb_tpu", "gateway",
+         "--port", "0", "--store", str(store), "--workers", "0",
+         "--cluster", "127.0.0.1:0",
+         "--cluster-heartbeat-timeout", "3",
+         "--monitor", "127.0.0.1:0"],
+        env=_pod_env(tmp_path, f"gw-{tag}",
+                     trace=tmp_path / f"trace-{tag}.jsonl"),
+        cwd=REPO, stdout=logf, stderr=subprocess.STDOUT, text=True)
+    deadline = time.time() + 120
+    urls = {}
+    while time.time() < deadline:
+        text = open(logf.name).read()
+        for line in text.splitlines():
+            if line.startswith("monitor: "):
+                urls["monitor"] = line.split()[1].rsplit("/", 1)[0]
+            elif line.startswith("cluster: "):
+                urls["cluster"] = line.split()[1]
+            elif line.startswith("gateway: http"):
+                urls["gateway"] = line.split()[1].rsplit("/v1", 1)[0]
+        if len(urls) == 3:
+            return proc, urls
+        if proc.poll() is not None:
+            raise AssertionError(f"gateway CLI died:\n{text}")
+        time.sleep(0.1)
+    proc.kill()
+    raise AssertionError(f"gateway CLI never printed its URLs: {urls}")
+
+
+def _spawn_agent(tmp_path, cluster_addr, host_id, incarnation=0):
+    """Start a host-agent OS process (own process group, so a SIGKILL
+    takes its worker lanes with it — a whole-host death) and wait for
+    its enrollment line at the expected incarnation."""
+    logf = open(tmp_path / f"agent-{host_id}.log", "a+")
+    proc = subprocess.Popen(
+        [sys.executable, "-m", "tclb_tpu.cluster.agent",
+         "--gateway", cluster_addr, "--host-id", host_id,
+         "--workers", "1", "--hb-interval", "0.5"],
+        env=_pod_env(tmp_path, host_id), cwd=REPO,
+        stdout=logf, stderr=subprocess.STDOUT, text=True,
+        start_new_session=True)
+    needle = f"agent: enrolled host={host_id} incarnation={incarnation}"
+    deadline = time.time() + 120
+    while time.time() < deadline:
+        if needle in open(logf.name).read():
+            return proc
+        if proc.poll() is not None:
+            raise AssertionError(
+                f"agent {host_id} died:\n{open(logf.name).read()}")
+        time.sleep(0.1)
+    proc.kill()
+    raise AssertionError(f"agent {host_id} never enrolled")
+
+
+#: resumable pod job: big enough that the first checkpoint lands with
+#: most of the solve still ahead (a wide SIGKILL window), small enough
+#: that the uninterrupted reference stays a few seconds on CPU
+_POD_RESUMABLE = {"model": "d2q9", "shape": [64, 128], "niter": 6000,
+                  "params": {"nu": 0.05}, "resumable": True,
+                  "checkpoint_every": 200, "digest": True}
+
+
+@pytest.mark.slow
+def test_pod_cli_agents_spread_sigkill_resume_bit_identical(tmp_path):
+    """The full pod smoke (CI ``pod`` job): a gateway CLI in pod mode
+    (``--cluster``, zero local lanes) + two host-agent OS processes.  A
+    16-job burst spreads over both hosts; SIGKILLing one agent's whole
+    process group mid-resumable-solve never touches the gateway — the
+    job requeues to the survivor, resumes from its newest checkpoint
+    (``resumed_from > 0``) and lands bit-identical to the uninterrupted
+    reference; the killed host re-enrolls at the next incarnation; the
+    gateway trace and /metrics carry host-stamped worker telemetry."""
+    store = tmp_path / "store"
+    gw, urls = _spawn_pod_gateway(tmp_path, store, "pod")
+    agents = {}
+    try:
+        for hid in ("hostA", "hostB"):
+            agents[hid] = _spawn_agent(tmp_path, urls["cluster"], hid)
+        code, doc = _http(urls["gateway"] + "/v1/hosts")
+        assert code == 200
+        assert {h["host"]: h["state"] for h in doc["hosts"]} == \
+            {"hostA": "live", "hostB": "live"}
+
+        # 16-job burst: fair share must give BOTH hosts work, and every
+        # record + result row must say which host served it
+        jids = []
+        for i in range(16):
+            code, doc = _http(urls["gateway"] + "/v1/jobs", "POST",
+                              {"model": "d2q9", "shape": [16, 32],
+                               "niter": 5, "params": {"nu": 0.05},
+                               "digest": True, "name": f"sweep{i}"})
+            assert code == 202, doc
+            jids.append(doc["job"]["id"])
+        served = {}
+        for jid in jids:
+            code, doc = _http(urls["gateway"]
+                              + f"/v1/jobs/{jid}/result?wait=300")
+            assert code == 200 and doc["job"]["status"] == "done", doc
+            (host,) = doc["job"]["hosts"]
+            served[host] = served.get(host, 0) + 1
+            assert doc["results"][0]["host"] == host
+        assert set(served) == {"hostA", "hostB"} and \
+            min(served.values()) >= 1, served
+
+        # uninterrupted reference for the resumable digest
+        code, doc = _http(urls["gateway"] + "/v1/jobs", "POST",
+                          dict(_POD_RESUMABLE, name="ref"))
+        assert code == 202, doc
+        code, doc = _http(
+            urls["gateway"] + f"/v1/jobs/{doc['job']['id']}"
+            + "/result?wait=300")
+        assert code == 200 and doc["job"]["status"] == "done", doc
+        assert doc["job"]["resumed_from"] is None
+        ref = doc["results"][0]
+
+        # chaos run: once a checkpoint has landed, SIGKILL the serving
+        # host's whole process group (agent + its worker lanes)
+        code, doc = _http(urls["gateway"] + "/v1/jobs", "POST",
+                          dict(_POD_RESUMABLE, name="chaos"))
+        assert code == 202, doc
+        jid = doc["job"]["id"]
+        ckroot = store / "ckpt" / jid
+        victim = None
+        deadline = time.time() + 240
+        while time.time() < deadline:
+            _, snap = _http(urls["gateway"] + "/v1/hosts")
+            busy = [h for h in snap["hosts"]
+                    if h["state"] == "live" and h["inflight"] >= 1]
+            if busy and ckroot.exists() and os.listdir(ckroot):
+                victim = busy[0]["host"]
+                break
+            assert gw.poll() is None
+            time.sleep(0.05)
+        assert victim, "no host went busy with a landed checkpoint"
+        os.killpg(agents[victim].pid, signal.SIGKILL)
+
+        code, doc = _http(urls["gateway"]
+                          + f"/v1/jobs/{jid}/result?wait=300")
+        assert code == 200, doc
+        assert gw.poll() is None            # the gateway never died
+        job = doc["job"]
+        assert job["status"] == "done"
+        assert job["resumed_from"] is not None and job["resumed_from"] > 0
+        survivor = ({"hostA", "hostB"} - {victim}).pop()
+        assert survivor in job["hosts"]
+        got = doc["results"][0]
+        assert got["state_sha256"] == ref["state_sha256"]
+        assert got["globals"] == ref["globals"]
+
+        # the killed host re-enrolls under the same id, next incarnation
+        agents[victim].wait(timeout=30)
+        agents[victim] = _spawn_agent(tmp_path, urls["cluster"], victim,
+                                      incarnation=1)
+
+        def _rejoined():
+            _, snap = _http(urls["gateway"] + "/v1/hosts")
+            rec = {h["host"]: h for h in snap["hosts"]}[victim]
+            return rec["state"] == "live" and rec["incarnation"] == 1
+        _wait(_rejoined, what="host rejoin at incarnation 1")
+
+        # relayed telemetry: the agents' worker iterate spans reach the
+        # GATEWAY's /metrics and JSONL trace with a host label, and the
+        # membership churn left its flight-recorder events
+        with urllib.request.urlopen(urls["monitor"] + "/metrics",
+                                    timeout=30) as resp:
+            metrics = resp.read().decode()
+        assert 'host="host' in metrics, metrics[:400]
+        assert "tclb_cluster_hosts_lost_total" in metrics
+        trace = [json.loads(line)
+                 for line in open(tmp_path / "trace-pod.jsonl")]
+        kinds = {e.get("kind") for e in trace}
+        assert {"gateway.host_enrolled", "gateway.host_lost",
+                "gateway.host_rejoined"} <= kinds, sorted(
+                    k for k in kinds if k)
+        span_hosts = {e.get("host") for e in trace
+                      if e.get("kind") == "span"
+                      and e.get("name") == "iterate"}
+        assert span_hosts & {"hostA", "hostB"}, sorted(
+            h for h in span_hosts if h)
+    finally:
+        for p in agents.values():
+            try:
+                os.killpg(p.pid, signal.SIGKILL)
+            except (ProcessLookupError, PermissionError):
+                p.kill()
+            p.wait()
+        gw.kill()
+        gw.wait()

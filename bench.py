@@ -51,6 +51,13 @@ BENCH_FLOORS = {
     "d3q27_vs_roofline": 0.78,
     "d3q19_vs_roofline": 0.80,
     "d3q19_heat_vs_roofline": 0.66,
+    # 3D adjoint tentpole: fused z-slab backward (Run_b band kernel)
+    # vs the Pallas-forward/XLA-backward hybrid on the same gradient.
+    # The XLA reverse chain round-trips the 19-plane working set
+    # through HBM per step; the fused kernel keeps the band resident —
+    # under 2x means the backward kernel degraded (or silently fell
+    # back to the hybrid, which the engine-tag assert catches first).
+    "adjoint3d_speedup": 2.0,
     # serving: batched-32 aggregate throughput vs cached batch-1 serial
     # dispatches of the same cases (a speedup ratio, not a roofline
     # fraction) — the ensemble engine's reason to exist is amortizing
@@ -391,6 +398,77 @@ def bench_adjoint(results):
     return []
 
 
+def bench_adjoint3d(results):
+    """3D fused-backward adjoint: the z-slab banded ``Run_b`` kernel
+    (ops/pallas_adjoint ``bwd="pallas"``) vs the PR 9 hybrid (Pallas
+    forward, XLA reverse chain) on the same d3q19_adj gradient.  The
+    XLA chain round-trips the 19-plane working set through HBM on
+    every reverse step; the fused kernel keeps the band resident in
+    VMEM, so ``adjoint3d_speedup`` is floor-gated at 2.0 on TPU.  The
+    engine tag is asserted first — a silent fallback to the hybrid
+    would otherwise report a flattering 1.0x."""
+    import jax
+    import jax.numpy as jnp
+    from tclb_tpu.adjoint import InternalTopology, make_unsteady_gradient
+    from tclb_tpu.core.lattice import Lattice
+    from tclb_tpu.models import get_model
+    from tclb_tpu.ops import pallas_adjoint
+
+    on_tpu = jax.default_backend() == "tpu"
+    if not on_tpu:
+        return []   # interpret-mode 3D backward: minutes of compile
+    m = get_model("d3q19_adj")
+    nz, ny, nx = 32, 64, 256
+    niter = int(os.environ.get("TCLB_BENCH_ITERS_ADJ3D", 200))
+    lat = Lattice(m, (nz, ny, nx), dtype=jnp.float32,
+                  settings={"nu": 0.05, "Velocity": 0.02, "Porocity": 0.5,
+                            "DragInObj": 1.0})
+    flags = np.full((nz, ny, nx), m.flag_for("MRT"), dtype=np.uint16)
+    flags[:, 0, :] = flags[:, -1, :] = m.flag_for("Wall")
+    flags[nz // 4:3 * nz // 4, ny // 4:3 * ny // 4,
+          nx // 3:2 * nx // 3] |= m.flag_for("DesignSpace")
+    lat.set_flags(flags)
+    lat.init()
+    design = InternalTopology(m)
+    theta0 = design.get(lat.state, lat.params)
+
+    def timed_grad():
+        gf = make_unsteady_gradient(m, design, niter, levels=None,
+                                    engine="pallas", shape=(nz, ny, nx))
+        obj, g, _ = gf(theta0, lat.state, lat.params)
+        float(obj)
+        best = 0.0
+        for _ in range(2):
+            t0 = time.perf_counter()
+            obj, g, _ = gf(theta0, lat.state, lat.params)
+            s = float(obj) + float(jnp.sum(g))
+            dt = time.perf_counter() - t0
+            assert np.isfinite(s)
+            best = max(best, nz * ny * nx * niter / dt / 1e6)
+        return best, gf.engine_name
+
+    try:
+        v_fused, tag = timed_grad()
+        assert tag.startswith("pallas_adjoint[d3q19_adj") \
+            and ",3d]" in tag, f"fused 3D backward not engaged: {tag}"
+        results["adjoint3d_fused_mlups"] = round(v_fused, 1)
+        results["adjoint3d_engine"] = tag
+        # hybrid baseline: deny the slab planner so the auto path
+        # builds the Pallas-forward / XLA-backward step (the PR 9 path)
+        orig = pallas_adjoint.adjoint_slab_plan
+        pallas_adjoint.adjoint_slab_plan = lambda *a, **k: None
+        try:
+            v_hyb, tag_h = timed_grad()
+        finally:
+            pallas_adjoint.adjoint_slab_plan = orig
+        assert "bwd=xla" in tag_h, f"hybrid baseline not engaged: {tag_h}"
+        results["adjoint3d_hybrid_mlups"] = round(v_hyb, 1)
+        results["adjoint3d_speedup"] = round(v_fused / v_hyb, 2)
+    except Exception as e:   # never let the 3D adjoint probe kill bench
+        results["adjoint3d_error"] = str(e)[:200]
+    return []
+
+
 def bench_unsteady_adjoint(results):
     """Production unsteady adjoint: the revolve-checkpointed gradient
     (adjoint/revolve — binomial schedule, host-mem snapshot tier) at a
@@ -443,6 +521,36 @@ def bench_unsteady_adjoint(results):
         results["unsteady_adjoint_peak_snapshots"] = \
             rev.last["peak_snapshots"]
         results["unsteady_adjoint_engine"] = rev.engine_name
+
+        # D2D spill overhead: the identical sweep with all but one
+        # snapshot forced through the peer-HBM tier (device_put onto a
+        # leased fleet lane) vs the all-mem run above.  The CI gate
+        # (telemetry report --compare) holds this under 5%; here it is
+        # reported so the JSON row carries the measured cost.  Needs a
+        # second device to park on — single-chip runs skip.
+        if len(jax.devices()) >= 2:
+            from tclb_tpu.serve import FleetDispatcher
+            with FleetDispatcher(devices=jax.devices()[:2]) as disp:
+                rev_p = make_revolve_gradient(
+                    m, design, niter, snapshots=snaps, engine="auto",
+                    shape=(ny, nx), dtype=jnp.float32,
+                    mem_slots=1, peer_slots=snaps - 1, dispatcher=disp)
+                obj_p, g_p, _ = rev_p(theta0, lat.state, lat.params)
+                float(obj_p)                          # warmup / compile
+                t0 = time.perf_counter()
+                obj_p, g_p, _ = rev_p(theta0, lat.state, lat.params)
+                sp = float(obj_p) + float(jnp.sum(g_p))
+                dtp = time.perf_counter() - t0
+                assert np.isfinite(sp)
+                # the tier split must not change the arithmetic: the
+                # bit-invariance contract is what makes the overhead
+                # number a pure transport cost
+                assert sp == s, "peer-tier gradient diverged from all-mem"
+                results["d2d_spill_bytes"] = rev_p.last["spill_peer"]
+                results["d2d_spill_overhead_pct"] = round(
+                    100.0 * (dtp - dt) / dt, 2)
+        else:
+            results["d2d_spill_overhead_pct"] = None
     except Exception as e:   # never let the revolve probe kill bench
         results["unsteady_adjoint_error"] = str(e)[:200]
     return []
@@ -855,6 +963,8 @@ def main():
         checks3d += bench_baseline_cases(results)
     with telemetry.span("bench.adjoint"):
         checks3d += bench_adjoint(results)
+    with telemetry.span("bench.adjoint3d"):
+        checks3d += bench_adjoint3d(results)
     with telemetry.span("bench.unsteady_adjoint"):
         checks3d += bench_unsteady_adjoint(results)
     with telemetry.span("bench.grad_batch"):

@@ -13,9 +13,11 @@ the three layers the production sweep needs:
   optimum :func:`binomial_bound` and whose peak simultaneously-held
   snapshots never exceed ``S`` (both asserted by the property test in
   tests/test_revolve.py);
-* :class:`SnapshotStore` — the two-tier executor store: the first
-  ``mem_slots`` snapshots stay in host memory, the rest spill to disk
-  through :class:`tclb_tpu.checkpoint.writer.AsyncWriter` (one write in
+* :class:`SnapshotStore` — the three-tier executor store: the first
+  ``mem_slots`` snapshots stay in host memory, the next ``peer_slots``
+  park on a fleet lane leased from the serving dispatcher (D2D
+  ``device_put`` onto peer HBM), and the rest spill to disk through
+  :class:`tclb_tpu.checkpoint.writer.AsyncWriter` (one write in
   flight, device→host copy on the writer thread) so spill overlaps the
   forward compute; the fence happens at reverse-sweep fetch, never per
   park.  Spill files are written atomically with a CRC32 sidecar — a
@@ -37,6 +39,7 @@ from __future__ import annotations
 import dataclasses
 import math
 import os
+import threading
 import zlib
 from functools import lru_cache
 from typing import Any, Callable, Optional
@@ -45,7 +48,7 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
-from tclb_tpu import telemetry
+from tclb_tpu import faults, telemetry
 from tclb_tpu.core.lattice import (LatticeState, SimParams, Streaming,
                                    make_action_step)
 from tclb_tpu.core.registry import Model
@@ -142,13 +145,14 @@ def revolve_schedule(T: int, S: int) -> list[tuple]:
 @dataclasses.dataclass(frozen=True)
 class RevolvePlan:
     """The planner's verdict for one adjoint run: snapshot budget and
-    memory/disk split (``auto_plan``)."""
+    the memory / peer-HBM / disk split (``auto_plan``)."""
 
     horizon: int              # schedule units (niter // chunk)
     snapshots: int            # total slots S
-    mem_slots: int            # slots kept in host memory; rest spill
+    mem_slots: int            # slots kept in host memory
     bytes_per_snapshot: int
     advances: int             # binomial_bound(horizon, snapshots)
+    peer_slots: int = 0       # slots parked on a leased fleet device
 
     @property
     def recompute_factor(self) -> float:
@@ -158,15 +162,21 @@ class RevolvePlan:
 def auto_plan(model: Model, shape, horizon: int,
               dtype=jnp.float32,
               host_budget_bytes: Optional[float] = None,
-              spill: bool = False) -> RevolvePlan:
-    """Pick ``S`` and the memory/disk split from the host budget modeled
-    in :func:`tclb_tpu.ops.fusion.snapshot_mem_slots` (same working-set
-    arithmetic as the serving batch cap).  Policy: as many in-memory
-    slots as the budget allows (capped at the horizon — beyond that the
-    schedule cannot use them); with ``spill`` enabled, grow S past the
-    memory tier only while it still buys a meaningful recompute
-    reduction (disk reads are not free), stopping once the recompute
-    factor drops under ~1.5 extra sweeps."""
+              spill: bool = False,
+              dispatcher: Optional[Any] = None,
+              peer_budget_bytes: Optional[float] = None) -> RevolvePlan:
+    """Pick ``S`` and the three-tier split from measured capacities: the
+    host budget modeled in
+    :func:`tclb_tpu.ops.fusion.snapshot_mem_slots` (same working-set
+    arithmetic as the serving batch cap), then — when a
+    :class:`~tclb_tpu.serve.dispatcher.FleetDispatcher` with a sparable
+    lane is given — a peer-HBM tier sized from ``peer_budget_bytes``
+    (``TCLB_PEER_BUDGET_MB`` or 1 GiB: deliberately a fraction of any
+    real device so the leased lane's HBM still fits a reinstated serving
+    batch), and finally, with ``spill`` enabled, the disk tier grows S
+    only while it still buys a meaningful recompute reduction (disk
+    reads are not free), stopping once the recompute factor drops under
+    ~1.5 extra sweeps."""
     from tclb_tpu.ops import fusion
     per = int(jnp.dtype(dtype).itemsize * model.n_storage
               * int(np.prod(shape)))
@@ -174,27 +184,56 @@ def auto_plan(model: Model, shape, horizon: int,
                                     jnp.dtype(dtype).itemsize,
                                     budget_bytes=host_budget_bytes)
     mem = max(1, min(mem, horizon))
-    S = mem
+    peer = 0
+    if dispatcher is not None and mem < horizon:
+        free = sum(1 for l in getattr(dispatcher, "lanes", [])
+                   if not l.evicted and l.reserved is None)
+        if free >= 2:   # reserve_lane keeps the last healthy lane serving
+            if peer_budget_bytes is None:
+                mb = os.environ.get("TCLB_PEER_BUDGET_MB")
+                peer_budget_bytes = (int(mb) * 1024 * 1024 if mb
+                                     else 1024 * 1024 * 1024)
+            peer = min(int(peer_budget_bytes) // max(per, 1),
+                       horizon - mem)
+            peer = max(0, peer)
+    S = mem + peer
     if spill:
         while S < horizon and binomial_bound(horizon, S) > 1.5 * horizon:
             S += 1
     return RevolvePlan(horizon=int(horizon), snapshots=S, mem_slots=mem,
                        bytes_per_snapshot=per,
-                       advances=binomial_bound(horizon, S))
+                       advances=binomial_bound(horizon, S),
+                       peer_slots=peer)
 
 
-# -- the two-tier snapshot store ------------------------------------------ #
+# -- the three-tier snapshot store ---------------------------------------- #
+
+
+def _tree_nbytes(tree) -> int:
+    return sum(int(getattr(x, "nbytes", 0)) for x in jax.tree.leaves(tree))
 
 
 class SnapshotStore:
-    """Two-tier store executing a revolve schedule's snapshot traffic.
+    """Three-tier store executing a revolve schedule's snapshot traffic:
+    host memory → peer-device HBM → disk.
 
     The first ``mem_slots`` concurrently-live snapshots stay in host
-    memory (numpy); further ones spill to ``spill_dir`` through the
-    async checkpoint writer — the device→host copy and the file write
-    both happen on the writer thread, so parking overlaps the forward
-    compute that follows it.  ``get`` fences (drains the writer) only
-    when the requested snapshot was spilled and not yet durable.
+    memory (numpy); the next ``peer_slots`` park on an idle fleet
+    device's HBM — a lane leased from the ``dispatcher``
+    (:meth:`~tclb_tpu.serve.dispatcher.FleetDispatcher.reserve_lane`),
+    parked via a pinned ``device_put`` (D2D over ICI on a pod — the
+    host never touches the bytes); further ones spill to ``spill_dir``
+    through the async checkpoint writer — the device→host copy and the
+    file write both happen on the writer thread, so parking overlaps
+    the forward compute that follows it.  ``get`` fences (drains the
+    writer) only when the requested snapshot was spilled to disk and
+    not yet durable.
+
+    The peer tier DEGRADES, never fails: an injected/real D2D fault
+    (``adjoint.spill_d2d``), or the dispatcher revoking the lease for
+    serving demand, evacuates every peer snapshot to the next tier,
+    releases the lane, and the sweep continues — gradients stay
+    bit-identical because every tier round-trips the exact array bytes.
 
     Spill files are crash-consistent: the payload is written through
     ``atomic_path`` (temp + fsync + rename — a SIGKILL never leaves a
@@ -204,43 +243,136 @@ class SnapshotStore:
     sidecar is identifiable as uncommitted."""
 
     def __init__(self, mem_slots: int, spill_dir: Optional[str] = None,
-                 prefix: str = "snap"):
+                 prefix: str = "snap", peer_slots: int = 0,
+                 dispatcher: Optional[Any] = None):
         from tclb_tpu.checkpoint.writer import AsyncWriter
         self.mem_slots = max(0, int(mem_slots))
         self.spill_dir = spill_dir
         self.prefix = prefix
+        self.peer_slots = max(0, int(peer_slots))
+        self.dispatcher = dispatcher
         self._mem: dict[Any, Any] = {}
+        self._peer: dict[Any, Any] = {}   # key -> device-resident pytree
         self._disk: dict[Any, str] = {}
+        self._lease: Optional[Any] = None
+        # tier transitions are cross-thread: a lease revocation arrives
+        # on a dispatcher thread and migrates the peer tier while the
+        # sweep thread is mid put/get.  RLock because _peer_down parks
+        # through _park_low.  Ordering: store lock -> dispatcher lock
+        # (reserve/release under this lock); the dispatcher never calls
+        # back into the store while holding its own lock (on_revoke
+        # fires outside it), so the ordering is acyclic.
+        self._tlock = threading.RLock()
         self._writer = AsyncWriter()
         self._durable: set = set()
         self.peak_live = 0
+        # cumulative bytes parked per tier (spill_bytes = peer + disk,
+        # the pre-three-tier aggregate the CI compare gate keys on)
+        self.tier_bytes = {"mem": 0, "peer": 0, "disk": 0}
         self.spill_bytes = 0
         self.parks = 0
         self.fetches = 0
+        self.evacuations = 0
 
     def _path(self, key) -> str:
         return os.path.join(self.spill_dir, f"{self.prefix}_{key:05d}.npy")
 
-    def put(self, key, tree) -> None:
-        """Park a snapshot.  The pytree's leaves may be live device
-        arrays: materialization happens on the writer thread for the
-        spill tier (host copy for the memory tier is deferred the same
-        way), so the caller returns immediately and keeps dispatching
-        forward work."""
-        self.parks += 1
-        if len(self._mem) < self.mem_slots or self.spill_dir is None:
-            slot: dict = {}
-            self._mem[key] = slot
-            self._writer.submit(
-                lambda: slot.update(
-                    v=jax.tree.map(np.asarray, tree)))
-        else:
+    # -- peer tier (leased fleet lane) ------------------------------------ #
+
+    def _ensure_lease(self):
+        if self._lease is not None and not self._lease.released:
+            return self._lease
+        if self.dispatcher is None or self.peer_slots <= 0:
+            return None
+        lease = self.dispatcher.reserve_lane(
+            tenant="adjoint.spill", on_revoke=self._on_revoke)
+        if lease is None:
+            # no lane to spare: don't re-ask on every park this sweep
+            self.peer_slots = 0
+            return None
+        if lease.released or lease.revoked:
+            # revoked during the handshake (a demand spike between the
+            # grant and our adoption): stand down before parking
+            # anything on a lane that is already serving again
+            self.peer_slots = 0
+            return None
+        self._lease = lease
+        return lease
+
+    def _on_revoke(self, lease, reason: str) -> None:
+        """Dispatcher reclaims the leased lane for serving: migrate
+        every peer snapshot down the ladder before the lane resumes.
+        The dispatcher releases the lease itself after this returns."""
+        self._peer_down(f"revoked:{reason}", release=False)
+
+    def _peer_down(self, reason: str, release: bool = True) -> None:
+        with self._tlock:
+            lease, self._lease = self._lease, None
+            moved = list(self._peer.items())
+            self._peer.clear()
+            self.peer_slots = 0
+            for k, parked in moved:
+                host = jax.tree.map(np.asarray, parked)
+                self._park_low(k, host)
+                self.evacuations += 1
+        telemetry.event("adjoint.spill_peer_down", reason=str(reason)[:200],
+                        evacuated=len(moved))
+        telemetry.counter("adjoint.spill_peer_down")
+        if release and lease is not None and not lease.released:
+            lease.release()
+
+    def _park_low(self, key, tree) -> None:
+        """Park below the peer tier: disk when configured, else host
+        memory (overflowing ``mem_slots``, same as the two-tier store
+        did without a spill dir — correctness over budget)."""
+        if self.spill_dir is not None:
             path = self._path(key)
             self._disk[key] = path
             self._durable.discard(key)
             self._writer.submit(lambda: self._spill(key, path, tree))
-        live = len(self._mem) + len(self._disk)
-        self.peak_live = max(self.peak_live, live)
+        else:
+            slot: dict = {}
+            self._mem[key] = slot
+            self.tier_bytes["mem"] += _tree_nbytes(tree)
+            self._writer.submit(
+                lambda: slot.update(v=jax.tree.map(np.asarray, tree)))
+
+    def put(self, key, tree) -> None:
+        """Park a snapshot down the tier ladder.  The pytree's leaves
+        may be live device arrays: materialization happens on the writer
+        thread for the disk tier (host copy for the memory tier is
+        deferred the same way), so the caller returns immediately and
+        keeps dispatching forward work; the peer tier's ``device_put``
+        dispatches asynchronously for the same reason."""
+        self.parks += 1
+        with self._tlock:
+            if len(self._mem) < self.mem_slots:
+                slot: dict = {}
+                self._mem[key] = slot
+                self.tier_bytes["mem"] += _tree_nbytes(tree)
+                self._writer.submit(
+                    lambda: slot.update(
+                        v=jax.tree.map(np.asarray, tree)))
+            elif len(self._peer) < self.peer_slots \
+                    and self._ensure_lease() is not None:
+                lease = self._lease
+                try:
+                    faults.fire("adjoint.spill_d2d", key=int(key),
+                                lane=lease.lane.index)
+                    parked = jax.tree.map(
+                        lambda x: jax.device_put(x, lease.device), tree)
+                    self._peer[key] = parked
+                    nb = _tree_nbytes(parked)
+                    self.tier_bytes["peer"] += nb
+                    self.spill_bytes += nb
+                    telemetry.counter("adjoint.spill_d2d")
+                except Exception as e:  # noqa: BLE001 - degrade to disk
+                    self._peer_down(f"d2d_failed:{e!r}")
+                    self._park_low(key, tree)
+            else:
+                self._park_low(key, tree)
+            live = len(self._mem) + len(self._peer) + len(self._disk)
+            self.peak_live = max(self.peak_live, live)
 
     def _spill(self, key, path: str, tree) -> None:
         from tclb_tpu.checkpoint import writer as ckw
@@ -254,8 +386,16 @@ class SnapshotStore:
         payload = host[0]
         rest = host[1:]
         data = ckw.npy_bytes(payload)
-        ckw.atomic_write_bytes(path, data)
+        # the disk tier shares checkpoint IO's chaos seam: `torn`
+        # truncates the payload under an honest CRC sidecar, so the
+        # verification machinery downstream is exercised, not faked
+        mode = faults.fire("checkpoint.write",
+                           file=os.path.basename(path))
         crc = zlib.crc32(data) & 0xFFFFFFFF
+        if mode == "torn":
+            ckw.atomic_write_bytes(path, data[:max(1, len(data) // 2)])
+        else:
+            ckw.atomic_write_bytes(path, data)
         ckw.atomic_write_bytes(path + ".crc", str(crc).encode())
         if rest:
             import io
@@ -263,16 +403,36 @@ class SnapshotStore:
             np.savez(buf, *rest)
             ckw.atomic_write_bytes(path + ".meta", buf.getvalue())
         self._treedef = treedef
+        self.tier_bytes["disk"] += len(data)
         self.spill_bytes += len(data)
         self._durable.add(key)
+
+    def tier_of(self, key) -> Optional[str]:
+        """Which tier currently holds ``key`` (None when not held)."""
+        if key in self._mem:
+            return "mem"
+        if key in self._peer:
+            return "peer"
+        if key in self._disk:
+            return "disk"
+        return None
 
     def get(self, key):
         """Fetch a parked snapshot (host-side numpy pytree)."""
         self.fetches += 1
-        if key in self._mem:
-            if "v" not in self._mem[key]:
+        with self._tlock:
+            slot = self._mem.get(key)
+            parked = self._peer.get(key)
+        if slot is not None:
+            if "v" not in slot:
                 self._writer.wait()
-            return self._mem[key]["v"]
+            return slot["v"]
+        if parked is not None:
+            # D2H fetch of the exact parked bytes — no writer fence:
+            # device_put ordering is the device stream's problem.  The
+            # reference pinned under the lock stays valid even if a
+            # concurrent revocation evacuates the peer tier right now.
+            return jax.tree.map(np.asarray, parked)
         if key not in self._disk:
             raise KeyError(f"snapshot {key} not held")
         if key not in self._durable:
@@ -287,10 +447,12 @@ class SnapshotStore:
         return jax.tree.unflatten(self._treedef, leaves)
 
     def free(self, key) -> None:
-        if key in self._mem:
-            del self._mem[key]
-            return
-        path = self._disk.pop(key, None)
+        with self._tlock:
+            if self._mem.pop(key, None) is not None:
+                return
+            if self._peer.pop(key, None) is not None:
+                return
+            path = self._disk.pop(key, None)
         if path is not None:
             self._durable.discard(key)
             self._writer.wait()
@@ -304,10 +466,15 @@ class SnapshotStore:
         self._writer.wait()
 
     def close(self) -> None:
-        """Drain the writer and delete every remaining spill file."""
+        """Drain the writer, release the leased lane and delete every
+        remaining spill file."""
         try:
             self._writer.wait()
         finally:
+            self._peer.clear()
+            if self._lease is not None and not self._lease.released:
+                self._lease.release()
+                self._lease = None
             for key in list(self._disk):
                 try:
                     self.free(key)
@@ -352,18 +519,23 @@ def make_revolve_gradient(model: Model, design, niter: int,
                           dtype=jnp.float32,
                           spill_dir: Optional[str] = None,
                           mem_slots: Optional[int] = None,
-                          host_budget_bytes: Optional[float] = None
+                          host_budget_bytes: Optional[float] = None,
+                          dispatcher: Optional[Any] = None,
+                          peer_slots: Optional[int] = None
                           ) -> Callable:
     """``grad_fn(theta, state, params) -> (objective, grads, final_state)``
     under a revolve schedule: peak live snapshots ≤ ``S``, total
     advanced units equal to the Griewank binomial optimum.
 
-    ``snapshots=None`` lets :func:`auto_plan` pick S (and the
-    memory/disk split when ``spill_dir`` is given) from the host budget.
-    Values are bit-identical to ``make_unsteady_gradient(levels=1)`` on
-    the same engine: the unit step, the forward-ordered flat objective
-    sum, the reverse-ordered cotangent accumulation and the final
-    ``design.put`` VJP replicate that program's arithmetic order."""
+    ``snapshots=None`` lets :func:`auto_plan` pick S (and the tier
+    split — memory, then peer-device HBM when a ``dispatcher`` with a
+    sparable lane is given, then disk when ``spill_dir`` is given) from
+    measured capacities.  Values are bit-identical to
+    ``make_unsteady_gradient(levels=1)`` on the same engine AND
+    invariant to the tier split: the unit step, the forward-ordered
+    flat objective sum, the reverse-ordered cotangent accumulation and
+    the final ``design.put`` VJP replicate that program's arithmetic
+    order, and every tier round-trips exact array bytes."""
     from tclb_tpu.adjoint.run import _pick_engine, objective_weights
 
     step = _pick_engine(model, design, niter, engine, shape, action,
@@ -381,13 +553,20 @@ def make_revolve_gradient(model: Model, design, niter: int,
     if snapshots is None:
         plan = auto_plan(model, shape or (), T, dtype=dtype,
                          host_budget_bytes=host_budget_bytes,
-                         spill=spill_dir is not None) if shape else \
+                         spill=spill_dir is not None,
+                         dispatcher=dispatcher) if shape else \
             RevolvePlan(T, max(1, T), max(1, T), 0, binomial_bound(T, T))
         S = plan.snapshots
         mem = plan.mem_slots
+        peer = plan.peer_slots
     else:
         S = max(1, int(snapshots))
         mem = S if mem_slots is None else int(mem_slots)
+        peer = 0
+    if peer_slots is not None:
+        peer = max(0, int(peer_slots))
+    if dispatcher is None:
+        peer = 0
     schedule = revolve_schedule(T, S)
 
     def _units(state1, params1, w):
@@ -427,7 +606,8 @@ def make_revolve_gradient(model: Model, design, niter: int,
             lambda p: objective_weights(model, p), params1)
         unit_fwd, unit_bwd = _units(state1, params1, w)
 
-        store = SnapshotStore(mem, spill_dir=spill_dir)
+        store = SnapshotStore(mem, spill_dir=spill_dir,
+                              peer_slots=peer, dispatcher=dispatcher)
         incs: list = [None] * T
         cur = (state1.fields, state1.globals_, state1.iteration)
         pos = 0
@@ -441,7 +621,7 @@ def make_revolve_gradient(model: Model, design, niter: int,
         with telemetry.span("adjoint.sweep", model=model.name,
                             mode="revolve",
                             horizon=T, chunk=chunk, snapshots=S,
-                            mem_slots=mem,
+                            mem_slots=mem, peer_slots=peer,
                             engine=getattr(step, "engine_name", "xla"),
                             bound=binomial_bound(T, S)) as sp:
             for act in schedule:
@@ -491,10 +671,17 @@ def make_revolve_gradient(model: Model, design, niter: int,
             cot_state1 = jax.tree.map(_zero_cot, state1)
             cot_state1 = cot_state1.replace(fields=cot_f, globals_=cot_g)
             (g_theta,) = put_vjp((cot_state1, cot_p))
+            tiers = [t for t in ("mem", "peer", "disk")
+                     if store.tier_bytes[t] > 0]
             sp.add(advances=advanced,
                    recompute_factor=round(advanced / max(T, 1), 4),
                    peak_snapshots=store.peak_live,
-                   spill_bytes=store.spill_bytes)
+                   spill_bytes=store.spill_bytes,
+                   spill_mem=store.tier_bytes["mem"],
+                   spill_peer=store.tier_bytes["peer"],
+                   spill_disk=store.tier_bytes["disk"],
+                   evacuations=store.evacuations,
+                   tiers=tiers)
         store.close()
         _last_gradient.update(
             model=model.name, horizon=T, snapshots=S,
@@ -502,6 +689,11 @@ def make_revolve_gradient(model: Model, design, niter: int,
             recompute_factor=round(advanced / max(T, 1), 4),
             peak_snapshots=store.peak_live,
             spill_bytes=store.spill_bytes,
+            spill_mem=store.tier_bytes["mem"],
+            spill_peer=store.tier_bytes["peer"],
+            spill_disk=store.tier_bytes["disk"],
+            evacuations=store.evacuations,
+            tiers=tiers,
             objective=float(obj),
             engine=getattr(step, "engine_name", "xla"))
         grad_fn.last = dict(_last_gradient)
@@ -512,6 +704,7 @@ def make_revolve_gradient(model: Model, design, niter: int,
     grad_fn.engine_name = getattr(step, "engine_name", "xla")
     grad_fn.snapshots = S
     grad_fn.mem_slots = mem
+    grad_fn.peer_slots = peer
     grad_fn.horizon = T
     grad_fn.bound = binomial_bound(T, S)
     return grad_fn

@@ -218,6 +218,50 @@ def check_footprint(model: Model, shape=None) -> list:
                         "one reach-slab per fused step — wider stencils "
                         "read stale halo slabs", "action:Iteration",
                         {"z_reach": zr}))
+            # -- 3D adjoint band (the fused Run_b slab kernel) ----------- #
+            # The backward band DMAs 2*R halo slabs per side — the
+            # adjoint-band rule extended to z-slabs: the in-band chain
+            # recomputes the forward cone AND transposes it, each
+            # costing one reach.  The modular halo DMA chain caps at
+            # fusion.ADJ_HALO_MAX slabs per side; a chain reach beyond
+            # it means the Run_b slab halo is NARROWER than the adjoint
+            # reach — one band's cotangent cone would alias slabs a
+            # neighbor band also seeds, double-counting cotangents.
+            from tclb_tpu.ops import fusion, pallas_adjoint
+            R1 = max(reach, 1)
+            is_adj = model.name.endswith("_adj")
+            if 2 * R1 > fusion.ADJ_HALO_MAX:
+                findings.append(Finding(
+                    "footprint.adjoint_band",
+                    "error" if is_adj else "warning", model.name,
+                    f"3D adjoint band needs 2*R = {2 * R1} halo slabs "
+                    f"per side but the Run_b slab kernel DMAs at most "
+                    f"{fusion.ADJ_HALO_MAX}: the slab halo is narrower "
+                    "than the adjoint reach"
+                    + (" — fused 3D backward ineligible (an _adj model "
+                       "silently degrades to the XLA reverse chain)"
+                       if is_adj else ""),
+                    "action:Iteration",
+                    {"R": R1, "halo": fusion.ADJ_HALO_MAX}))
+            else:
+                k = pallas_adjoint.max_chunk(model)
+                data = {"max_chunk": k, "reach": reach}
+                if shape is not None and len(shape) == 3:
+                    plan3 = pallas_adjoint.adjoint_slab_plan(model, shape)
+                    if plan3 is None:
+                        findings.append(Finding(
+                            "footprint.adjoint_band", "warning",
+                            model.name,
+                            f"no (k, bz) fits the fused 3D backward's "
+                            f"VMEM budget at shape {tuple(shape)} — "
+                            "reverse sweeps degrade to the XLA chain",
+                            "action:Iteration", {"shape": list(shape)}))
+                    else:
+                        data.update({"k": plan3[0], "bz": plan3[1]})
+                findings.append(Finding(
+                    "footprint.adjoint_chunk", "info", model.name,
+                    f"3D adjoint chunk budget: max_chunk={k} "
+                    f"(fuse-1 reach {reach})", "action:Iteration", data))
     return findings
 
 

@@ -208,4 +208,34 @@ def check_resources(model: Model, shape=None) -> list:
                     f"scratch~{estD >> 20} MiB (+ collision "
                     "temporaries)", where,
                     {"fuse": KD, "bz": bzD, "scratch_bytes": estD}))
+        # -- fused 3D backward kernel at the production chunk ----------- #
+        # mirror the 2D adjoint_layout finding: evaluate the Run_b slab
+        # planner at the shape production actually runs, so an infeasible
+        # plan surfaces as a finding instead of a silent XLA-chain sweep
+        from tclb_tpu.ops import pallas_adjoint
+        if model.name.endswith("_adj") \
+                and pallas_adjoint.max_chunk(model) >= 1:
+            k3 = pallas_adjoint.max_chunk(model)
+            plan3 = pallas_adjoint.adjoint_slab_plan(model, shape, k=k3)
+            if plan3 is None:
+                findings.append(Finding(
+                    "resources.adjoint_vmem", "warning", model.name,
+                    f"fused 3D backward: no (k, bz) fits the slab "
+                    f"scratch budget at {nz}x{ny}x{nx} "
+                    f"({model.n_storage} storage planes) — reverse "
+                    "sweeps degrade to the XLA chain", where,
+                    {"k_max": k3, "shape": list(shape)}))
+            else:
+                kb, bzb = plan3
+                _, rb = pallas_generic.action_plan(model, "Iteration",
+                                                   fuse=kb)
+                Rb = max(rb, 1)
+                estB = 2 * (bzb + 4 * Rb) \
+                    * (2 * model.n_storage + 1) * ny * nx * 4
+                findings.append(Finding(
+                    "resources.adjoint_slab", "info", model.name,
+                    f"fused 3D backward kernel: k={kb} bz={bzb} "
+                    f"reach={Rb} scratch~{estB >> 20} MiB", where,
+                    {"k": kb, "bz": bzb, "reach": Rb,
+                     "scratch_bytes": estB}))
     return findings

@@ -17,7 +17,16 @@ unknown names so a typo cannot silently disable a chaos schedule):
 ``serve.stage``           host staging ``device_put`` (``Lane._stage_loop``)
 ``serve.compile``         AOT compile on a cache miss (``CompiledCache.get``)
 ``checkpoint.write``      checkpoint shard IO (``writer.write_npy``):
-                          ``enospc`` / ``torn`` / ``slow`` fsync
+                          ``enospc`` / ``torn`` / ``slow`` fsync — also
+                          covers the revolve store's DISK spill tier
+                          (``revolve.SnapshotStore._spill`` writes
+                          through the same atomic helpers)
+``adjoint.spill_d2d``     peer-device HBM spill ``device_put`` in the
+                          revolve store (``SnapshotStore.put``, peer
+                          tier): ``error`` fails the D2D park — the
+                          store evacuates the peer tier to disk,
+                          releases its lane lease and degrades; ``slow``
+                          delays the park (overhead, not failure)
 ``store.journal``         JobStore journal append (``store.JobStore.put``)
 ``gateway.request``       gateway request handling (``GatewayService.submit``)
 ``pool.spawn``            worker subprocess spawn (``WorkerPool._spawn``):
@@ -103,6 +112,7 @@ POINTS = frozenset({
     "serve.stage",
     "serve.compile",
     "checkpoint.write",
+    "adjoint.spill_d2d",
     "store.journal",
     "gateway.request",
     "pool.spawn",

@@ -84,6 +84,61 @@ def choose_fuse_slab(nz: int, fits: Callable[[int, int], bool],
     return best
 
 
+ADJ_HALO_MAX = 8   # max halo slabs per side the fused 3D backward DMAs:
+#                    the adjoint band needs 2*reach(K) slabs per side
+#                    (cotangent cone + recompute cone), and past 8 the
+#                    one-slab-at-a-time modular halo copies cost more
+#                    HBM round trips than the fused chunk saves
+
+
+def adjoint_slab_plan(nz: int, n_storage: int, plane_bytes: int,
+                      reach_of: Callable[[int], int], k_max: int,
+                      n_aux: int = 1,
+                      budget: Optional[int] = None,
+                      halo_max: int = ADJ_HALO_MAX
+                      ) -> Optional[Tuple[int, int]]:
+    """Pick ``(K, bz)`` for the fused 3D BACKWARD slab kernel, or None.
+
+    The backward band holds THREE double-buffered stacks (chunk-input
+    primal, output-cotangent, flags/aux) at height ``bz + 4*reach(K)``
+    — 2R halo slabs per side, twice the forward's R, because the
+    in-band VJP both recomputes the forward cone AND widens it again
+    transposing it (the adjoint-band rule analysis/footprint.py pins).
+    ``K`` is restricted to divisors of ``k_max`` so the caller's chunk
+    loop (``niter % k == 0`` from the engine picker) stays exact, and
+    to ``2*reach(K) <= halo_max`` / ``nz >= 2*reach(K)`` so the modular
+    halo DMAs index true slabs.  Among feasible configs the amortized
+    planes-per-step traffic decides; ties go to the deeper chunk.
+    """
+    if budget is None:
+        budget = 24 * 1024 * 1024
+    best, best_c = None, None
+    for k in range(1, max(1, k_max) + 1):
+        if k_max % k:
+            continue
+        try:
+            r = max(int(reach_of(k)), 1)
+        except Exception:
+            break
+        if 2 * r > halo_max or nz < 2 * r:
+            continue
+        per_slab = (2 * n_storage + n_aux) * plane_bytes
+        bz_best = None
+        for bz in range(1, nz + 1):
+            if nz % bz:
+                continue
+            if 2 * (bz + 4 * r) * per_slab > budget:
+                break
+            bz_best = bz
+        if bz_best is None:
+            continue
+        c = ((2 * n_storage + n_aux) * (bz_best + 4 * r)
+             + n_storage * bz_best) / float(k * bz_best)
+        if best_c is None or c < best_c - 1e-9:
+            best, best_c = (k, bz_best), c
+    return best
+
+
 ENSEMBLE_BATCH_MAX = 256   # scheduling sanity cap, not a memory bound
 
 

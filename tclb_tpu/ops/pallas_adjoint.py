@@ -32,6 +32,7 @@ gradients run fused too (``series=True`` flavor, one step per chunk).
 
 from __future__ import annotations
 
+from functools import partial
 from typing import Optional
 
 import jax
@@ -43,9 +44,9 @@ from jax.experimental.pallas import tpu as pltpu
 
 from tclb_tpu.core.lattice import LatticeState, SimParams
 from tclb_tpu.core.registry import Model
-from tclb_tpu.ops import pallas_generic
-from tclb_tpu.ops.pallas_generic import (_CompilerParams, _HALO, action_plan,
-                                         run_action_plan)
+from tclb_tpu.ops import fusion, pallas_generic
+from tclb_tpu.ops.pallas_generic import (_CompilerParams, _HALO, KernelCtx,
+                                         action_plan, run_action_plan)
 
 _probe_cache: dict = {}
 
@@ -138,8 +139,12 @@ def _supports_diff_3d(model: Model, shape, dtype,
     """3D eligibility: the generic z-slab engine must cover the
     configuration (its in-kernel-globals flavor is the forward sweep),
     the objective must be SUM Globals, and the traced grad probe at the
-    production chunk size must go through.  The Control-series flavor is
-    2D-only for now."""
+    production chunk size must go through.  When
+    :func:`adjoint_slab_plan` finds a feasible ``(k, bz)`` the backward
+    runs the fused z-slab ``Run_b`` kernel; otherwise the step degrades
+    to the XLA-chain backward (still eligible — the forward sweep
+    dominates a revolve adjoint).  The Control-series flavor is 2D-only
+    for now."""
     if series:
         return False
     if jnp.dtype(dtype) != jnp.dtype(jnp.float32):
@@ -152,6 +157,8 @@ def _supports_diff_3d(model: Model, shape, dtype,
     if not (1 <= model.n_globals <= 8) \
             or any(g.op != "SUM" for g in model.globals_):
         return False
+    if len(model.settings) > 1024:
+        return False   # the (8, 128) in-kernel settings-tape accumulator
     from tclb_tpu import analysis
     if not analysis.kernel_safety_ok(model):
         return False
@@ -193,24 +200,84 @@ def _probe_params(model: Model, dtype):
                      zone_table=jnp.zeros((n_sett, model.zone_max), dtype))
 
 
-def _make_diff_step_3d(model: Model, shape, dtype=jnp.float32,
-                       interpret: Optional[bool] = None,
-                       present: Optional[set] = None,
-                       k: Optional[int] = None):
-    """The 3D differentiable chunk: ``custom_vjp`` pairing the z-slab
-    Pallas engine's in-kernel-globals flavor (forward) with the VJP of
-    the XLA whole-array action chain (backward).
+@partial(jax.custom_vjp, nondiff_argnums=(1, 2))
+def _roll3_prim(x, s, nx):
+    return pltpu.roll(x, s, axis=2)
 
-    In a checkpointed/revolve adjoint the FORWARD steps dominate —
-    every reverse of one unit costs up to ``r`` recomputed advances
-    (Griewank's repetition number) plus exactly one backward — so the
-    fused Pallas forward is where the wall time goes; the backward
-    chain stays on XLA, whose 3D step is bit-parity-tested against the
-    slab kernel (tests/test_pallas3d), so the gradient linearizes the
-    same physics the Pallas forward ran."""
+
+def _roll3_fwd(x, s, nx):
+    return _roll3_prim(x, s, nx), None
+
+
+def _roll3_bwd(s, nx, _res, ct):
+    # same linearity argument as the 2D _roll_prim, lane axis 2: the
+    # transpose of out[..., i] = x[..., i - s] is the opposite roll
+    return (_roll3_prim(ct, (nx - s) % nx, nx),)
+
+
+_roll3_prim.defvjp(_roll3_fwd, _roll3_bwd)
+
+
+def _lane_roll3(sl, shift, nx):
+    s = shift % nx
+    return _roll3_prim(sl, s, nx) if s else sl
+
+
+def adjoint_slab_plan(model: Model, shape, k: Optional[int] = None,
+                      budget: Optional[int] = None):
+    """The fused 3D backward's ``(k, bz)`` — None when no chunk/slab
+    config fits the VMEM budget (the builder then degrades to the XLA
+    backward).  Thin model-aware wrapper over
+    :func:`tclb_tpu.ops.fusion.adjoint_slab_plan` so the builder, the
+    eligibility gate and the static analyzers all plan identically."""
     nz, ny, nx = (int(s) for s in shape)
     if k is None:
         k = max_chunk(model)
+    if k < 1:
+        return None
+    # the backward aux stack is one flags plane either way: zonal models
+    # run the lean flavor (planes rebuilt in-kernel from the SMEM zone
+    # table), zonal-free models have nothing beyond flags
+    return fusion.adjoint_slab_plan(
+        nz, model.n_storage, ny * nx * 4,
+        lambda f: action_plan(model, "Iteration", fuse=f)[1], k,
+        n_aux=1, budget=budget)
+
+
+def _make_diff_step_3d(model: Model, shape, dtype=jnp.float32,
+                       interpret: Optional[bool] = None,
+                       present: Optional[set] = None,
+                       k: Optional[int] = None,
+                       bwd: str = "auto"):
+    """The 3D differentiable chunk: ``custom_vjp`` pairing the z-slab
+    Pallas engine's in-kernel-globals flavor (forward) with a z-slab
+    Pallas BACKWARD band kernel — the 3D ``Run_b``.
+
+    The backward mirrors the forward's DMA pipeline on slabs haloed by
+    ``2*R`` (the adjoint-band rule: the in-band chain recomputes the
+    forward cone AND transposes it, each costing reach ``R``), pulls the
+    chunk-input primal + the output cotangent + the flags plane on three
+    double-buffered stacks, re-traces the fused action chain FULL-SLAB
+    (every per-row op identical to the windowed forward on the rows the
+    window mask keeps) and takes ``jax.vjp`` of it in-band; the settings
+    tape accumulates per-slab so band overlaps never double-count.
+    ``bwd="xla"`` keeps the PR 9 hybrid (Pallas forward / XLA-chain
+    backward) — the measured baseline ``bench.py``'s
+    ``adjoint3d_speedup`` compares against; ``"auto"`` takes the fused
+    kernel whenever :func:`adjoint_slab_plan` finds a feasible config."""
+    nz, ny, nx = (int(s) for s in shape)
+    if k is None:
+        k = max_chunk(model)
+    plan3 = adjoint_slab_plan(model, shape, k) if bwd != "xla" else None
+    if bwd == "pallas" and plan3 is None:
+        raise ValueError(f"{model.name} {shape}: no (k, bz) fits the "
+                         "fused 3D backward's VMEM budget")
+    fused = plan3 is not None
+    if fused:
+        # the chunk the WHOLE diff step (forward loop included) runs at:
+        # a divisor of the requested k, so the caller's niter % k == 0
+        # guarantee carries over
+        k = plan3[0]
     base = pallas_generic.make_pallas_iterate_3d(
         model, shape, dtype, interpret=interpret, fuse=1, present=present)
     impl = base._impl
@@ -225,6 +292,10 @@ def _make_diff_step_3d(model: Model, shape, dtype=jnp.float32,
     n_globals = model.n_globals
     from tclb_tpu.core.lattice import make_action_step
     xla_step = make_action_step(model, "Iteration", present=present)
+
+    call_bwd = _mk_call_bwd_3d(model, shape, cdtype, interpret, present,
+                               k, plan3[1], lean) if fused else None
+    n_sett = len(model.settings)
 
     def _mk_step(params: SimParams, flags):
         if params.time_series is not None:
@@ -262,7 +333,7 @@ def _make_diff_step_3d(model: Model, shape, dtype=jnp.float32,
         def chunk_fwd(fields, p, fl, itv):
             return chunk(fields, p, fl, itv), (fields, p, fl, itv)
 
-        def chunk_bwd(res, cot):
+        def chunk_bwd_xla(res, cot):
             fields, p, fl, itv = res
             cot_f, cot_g, cot_gl = cot
 
@@ -285,7 +356,42 @@ def _make_diff_step_3d(model: Model, shape, dtype=jnp.float32,
                     np.zeros(np.shape(fl), jax.dtypes.float0),
                     np.zeros(np.shape(itv), jax.dtypes.float0))
 
-        chunk.defvjp(chunk_fwd, chunk_bwd)
+        def chunk_bwd_pallas(res, cot):
+            fields, p, fl, itv = res
+            cot_f, cot_g, cot_gl = cot
+            lg = jnp.stack([cot_g.astype(cdtype), cot_gl.astype(cdtype)])
+            sett = p.settings.astype(cdtype)
+            flags_i32 = fl.astype(jnp.int32)
+            it_arr = jnp.asarray(itv, jnp.int32).reshape((1,))
+            lam_f_ct = cot_f.astype(cdtype)
+            if lean:
+                ztab = jnp.concatenate(
+                    [p.zone_table[j].astype(cdtype) for j in zonal_si])
+                aux = flags_i32.astype(cdtype)[None]
+                lam_f, sett_acc = call_bwd(sett, lg, it_arr, ztab,
+                                           fields.astype(cdtype),
+                                           lam_f_ct, aux)
+            else:
+                zones = flags_i32 >> zshift
+                aux = jnp.stack(
+                    [flags_i32.astype(cdtype)]
+                    + [p.zone_table[j].astype(cdtype)[zones]
+                       for j in zonal_si])
+                lam_f, sett_acc = call_bwd(sett, lg, it_arr,
+                                           fields.astype(cdtype),
+                                           lam_f_ct, aux)
+            lam_sett = sett_acc.reshape(-1)[:n_sett]
+            # non-series 3D: cotangents flow to the scalar settings (the
+            # in-kernel tape); the zone-table/aux cotangent is zero —
+            # the same aux_grad=False contract as the 2D default
+            cp = jax.tree.map(jnp.zeros_like, p)
+            cp = cp.replace(settings=lam_sett.astype(p.settings.dtype))
+            return (lam_f.astype(fields.dtype), cp,
+                    np.zeros(np.shape(fl), jax.dtypes.float0),
+                    np.zeros(np.shape(itv), jax.dtypes.float0))
+
+        chunk.defvjp(chunk_fwd,
+                     chunk_bwd_pallas if fused else chunk_bwd_xla)
 
         def step(state: LatticeState, p2: SimParams):
             new_fields, g, g_last = chunk(state.fields, p2, state.flags,
@@ -305,9 +411,259 @@ def _make_diff_step_3d(model: Model, shape, dtype=jnp.float32,
     step.prepare = prepare
     step.chunk = k
     step.returns_inc = True
-    step.engine_name = (f"pallas_adjoint3d[{model.name},k={k},"
-                        f"bz={impl['bz']},bwd=xla]")
+    if fused:
+        step.engine_name = (f"pallas_adjoint[{model.name},k={k},"
+                            f"bz={plan3[1]},3d]")
+    else:
+        step.engine_name = (f"pallas_adjoint3d[{model.name},k={k},"
+                            f"bz={impl['bz']},bwd=xla]")
     return step
+
+
+def _mk_call_bwd_3d(model: Model, shape, cdtype, interpret, present,
+                    k: int, bz: int, lean: bool):
+    """Build the z-slab backward band kernel (``Run_b``): one grid step
+    per slab band, halo = ``2 * R(k)`` slabs per side (adjoint-band
+    rule), three double-buffered DMA stacks (chunk-input primal, output
+    cotangent, flags/aux), in-band ``jax.vjp`` of the full-slab fused
+    action chain.  Returns ``call(sett, lg, it, [ztab,] primal, lam_out,
+    aux) -> (lam_in, settings_tape)``."""
+    nz, ny, nx = (int(s) for s in shape)
+    plan_k, reach_k = action_plan(model, "Iteration", fuse=k)
+    Rk = max(reach_k, 1)
+    Hb = bz + 4 * Rk
+    ns = model.n_storage
+    n_globals = model.n_globals
+    n_sett = len(model.settings)
+    zonal_names = list(model.zonal_settings)
+    zone_max = model.zone_max
+    zshift = model.zone_shift
+    n_aux = 1 if lean else 1 + len(zonal_names)
+    n_sem = 1 + 4 * Rk
+    ei = model.ei
+    stage_fns = {nm: model.stage_fns[model.stages[nm].main]
+                 for nm in model.actions["Iteration"]}
+    loads_density = {nm: model.stages[nm].load_densities
+                     for nm in model.actions["Iteration"]}
+    nt_present = set(model.node_types) if present is None else set(present)
+    n_per_rep = len(model.actions["Iteration"])
+    adv = int(any(model.stages[s].load_densities
+                  for s in model.actions["Iteration"]))
+    if interpret is None:
+        interpret = jax.default_backend() != "tpu"
+
+    def bwd_kernel(sett, lg_ref, it_ref, *rest):
+        if lean:
+            ztab, p_hbm, l_hbm, a_hbm, *refs = rest
+        else:
+            ztab = None
+            p_hbm, l_hbm, a_hbm, *refs = rest
+        out_lam, out_sett, bufp, bufl, bufa, sems = refs
+        i = pl.program_id(0)
+        n = pl.num_programs(0)
+
+        def band_dmas(slot, band):
+            # halo slabs one at a time with modular indices (a block
+            # copy straddling the periodic z boundary would read out of
+            # bounds — same scheme as the forward slab kernel, halo 2R)
+            base = band * jnp.int32(bz)
+            out = []
+            for si_, (hbm, buf, nplanes) in enumerate((
+                    (p_hbm, bufp, ns), (l_hbm, bufl, ns),
+                    (a_hbm, bufa, n_aux))):
+                out.append(pltpu.make_async_copy(
+                    hbm.at[pl.ds(0, nplanes), pl.ds(base, bz)],
+                    buf.at[slot, :, pl.ds(2 * Rk, bz)],
+                    sems.at[slot, n_sem * si_]))
+                for r in range(2 * Rk):
+                    zm_r = jax.lax.rem(
+                        base - jnp.int32(2 * Rk - r) + jnp.int32(nz),
+                        jnp.int32(nz))
+                    zp_r = jax.lax.rem(base + jnp.int32(bz + r),
+                                       jnp.int32(nz))
+                    out.append(pltpu.make_async_copy(
+                        hbm.at[pl.ds(0, nplanes), pl.ds(zm_r, 1)],
+                        buf.at[slot, :, pl.ds(r, 1)],
+                        sems.at[slot, n_sem * si_ + 1 + r]))
+                    out.append(pltpu.make_async_copy(
+                        hbm.at[pl.ds(0, nplanes), pl.ds(zp_r, 1)],
+                        buf.at[slot, :, pl.ds(2 * Rk + bz + r, 1)],
+                        sems.at[slot, n_sem * si_ + 1 + 2 * Rk + r]))
+            return out
+
+        slot = jax.lax.rem(i, jnp.int32(2))
+        nxt = jax.lax.rem(i + jnp.int32(1), jnp.int32(2))
+
+        @pl.when(i == 0)
+        def _():
+            for d in band_dmas(jnp.int32(0), i):
+                d.start()
+
+        @pl.when(i + 1 < n)
+        def _():
+            for d in band_dmas(nxt, i + jnp.int32(1)):
+                d.start()
+
+        for d in band_dmas(slot, i):
+            d.wait()
+
+        sv = jnp.stack([sett[j] for j in range(n_sett)])
+        it0 = it_ref[0]
+        # settings enter the trace PER SLAB: the cotangent seeds span the
+        # R-extended window overlapping the neighbor bands' windows, so a
+        # scalar settings cotangent would double-count the margin slabs;
+        # slab-resolved cotangents can be band-trimmed before the
+        # cross-band accumulation (the 2D tape's argument, z-banded)
+        sv_rows = jnp.broadcast_to(sv[None, :], (Hb, n_sett))
+
+        class _RowSett3:
+            def __init__(self, rows):
+                self._rows = rows
+
+            def __getitem__(self, j):
+                return self._rows[:, j][:, None, None]
+
+        flags_full = bufa[slot, 0].astype(jnp.int32)
+        if ztab is not None:
+            zones_full = flags_full >> zshift
+            zonal_full = {nm: fusion.zone_plane(ztab, j, zone_max,
+                                                zones_full)
+                          for j, nm in enumerate(zonal_names)}
+        else:
+            zonal_full = {nm: bufa[slot, 1 + j]
+                          for j, nm in enumerate(zonal_names)}
+
+        def _rollyx(sl, dy, dx):
+            if dy:
+                sl = jnp.roll(sl, dy, axis=1)
+            if dx % nx:
+                sl = _lane_roll3(sl, dx, nx)
+            return sl
+
+        def C(work, sv_rows_):
+            """The forward chunk traced FULL-SLAB from this band's
+            buffers: per-row ops identical to the windowed forward
+            kernel (z pulls become axis-0 rolls whose wrap garbage stays
+            in the outermost ``Rk`` slabs), so rows inside the window
+            mask below linearize exactly the physics that ran."""
+            work = list(work)
+            g_acc: dict = {}
+            g_lst: dict = {}
+            for st_i, (stage_name, _ext) in enumerate(plan_k):
+                rep = st_i // n_per_rep
+                if loads_density[stage_name]:
+                    planes = []
+                    for k_ in range(ns):
+                        dxk, dyk, dzk = (int(v) for v in ei[k_])
+                        sl = jnp.roll(work[k_], dzk, axis=0) if dzk \
+                            else work[k_]
+                        planes.append(_rollyx(sl, dyk, dxk))
+                else:
+                    planes = list(work)
+
+                def loader(index, dx, dy, dz=0):
+                    sl = work[index]
+                    if dz:
+                        sl = jnp.roll(sl, -dz, axis=0)
+                    return _rollyx(sl, -dy, -dx)
+
+                ctx = KernelCtx(
+                    model, planes, loader, flags_full, dict(zonal_full),
+                    _RowSett3(sv_rows_), cdtype, it0 + adv * rep,
+                    nt_present, compute_globals=True)
+                res = stage_fns[stage_name](ctx)
+                for nm, plane in ctx._globals.items():
+                    g_acc[nm] = plane if nm not in g_acc \
+                        else g_acc[nm] + plane
+                    if rep == k - 1:
+                        g_lst[nm] = plane if nm not in g_lst \
+                            else g_lst[nm] + plane
+
+                if isinstance(res, dict):
+                    updates: dict[int, jnp.ndarray] = {}
+                    for name, stack in res.items():
+                        if name in model.groups:
+                            idx = model.groups[name]
+                            if len(idx) == 1 and stack.ndim == 3:
+                                updates[idx[0]] = stack
+                            else:
+                                for j, k_ in enumerate(idx):
+                                    updates[k_] = stack[j]
+                        else:
+                            updates[model.storage_index[name]] = stack
+                else:
+                    updates = {k_: res[k_] for k_ in range(ns)}
+                for k_, new in updates.items():
+                    work[k_] = new
+            zero_pl = jnp.zeros((Hb, ny, nx), cdtype)
+            gpl = [g_acc.get(g.name, zero_pl) for g in model.globals_]
+            gll = [g_lst.get(g.name, zero_pl) for g in model.globals_]
+            return jnp.stack(work), jnp.stack(gpl), jnp.stack(gll)
+
+        pst = [bufp[slot, j] for j in range(ns)]
+        _, vjp_fn = jax.vjp(C, pst, sv_rows)
+        # cotangent seeds live on the R-extended output window
+        # [band - R, band + bz + R): slabs beyond it either belong to the
+        # neighbor bands' lambda_in (they own those output slabs) or hold
+        # full-slab roll garbage — both masked to zero
+        rows = jax.lax.broadcasted_iota(jnp.int32, (Hb, ny, nx), 0)
+        win = (rows >= Rk) & (rows < bz + 3 * Rk)
+        zero_pl = jnp.zeros((Hb, ny, nx), cdtype)
+        lam_win = jnp.stack(
+            [jnp.where(win, bufl[slot, j], zero_pl) for j in range(ns)])
+        lgpl = jnp.stack(
+            [jnp.where(win, jnp.full((Hb, ny, nx), lg_ref[0, gi], cdtype),
+                       zero_pl) for gi in range(n_globals)])
+        lgll = jnp.stack(
+            [jnp.where(win, jnp.full((Hb, ny, nx), lg_ref[1, gi], cdtype),
+                       zero_pl) for gi in range(n_globals)])
+        lam_p, lam_sv_rows = vjp_fn((lam_win, lgpl, lgll))
+
+        for j in range(ns):
+            out_lam[j] = lam_p[j][2 * Rk:2 * Rk + bz]
+
+        @pl.when(i == 0)
+        def _():
+            out_sett[...] = jnp.zeros((8, 128), cdtype)
+        # band slabs only: margin slabs belong to the neighbor bands
+        lam_sv = lam_sv_rows[2 * Rk:2 * Rk + bz, :].sum(axis=0)
+        pad_s = jnp.concatenate(
+            [lam_sv, jnp.zeros((1024 - n_sett,), cdtype)]).reshape((8, 128))
+        out_sett[...] = out_sett[...] + pad_s
+
+    return pl.pallas_call(
+        bwd_kernel,
+        grid=(nz // bz,),
+        in_specs=[
+            pl.BlockSpec(memory_space=pltpu.SMEM),
+            pl.BlockSpec(memory_space=pltpu.SMEM),
+            pl.BlockSpec(memory_space=pltpu.SMEM),
+        ] + ([pl.BlockSpec(memory_space=pltpu.SMEM)] if lean else [])
+        + [
+            pl.BlockSpec(memory_space=pl.ANY),
+            pl.BlockSpec(memory_space=pl.ANY),
+            pl.BlockSpec(memory_space=pl.ANY),
+        ],
+        out_specs=[
+            pl.BlockSpec((ns, bz, ny, nx), lambda i: (0, i, 0, 0),
+                         memory_space=pltpu.VMEM),
+            pl.BlockSpec((8, 128), lambda i: (0, 0),
+                         memory_space=pltpu.VMEM),
+        ],
+        out_shape=[
+            jax.ShapeDtypeStruct((ns, nz, ny, nx), cdtype),
+            jax.ShapeDtypeStruct((8, 128), cdtype),
+        ],
+        scratch_shapes=[
+            pltpu.VMEM((2, ns, Hb, ny, nx), cdtype),
+            pltpu.VMEM((2, ns, Hb, ny, nx), cdtype),
+            pltpu.VMEM((2, n_aux, Hb, ny, nx), cdtype),
+            pltpu.SemaphoreType.DMA((2, 3 * n_sem)),
+        ],
+        compiler_params=_CompilerParams(
+            vmem_limit_bytes=100 * 1024 * 1024),
+        interpret=interpret,
+    )
 
 
 def make_diff_step(model: Model, shape, dtype=jnp.float32,
@@ -316,7 +672,8 @@ def make_diff_step(model: Model, shape, dtype=jnp.float32,
                    k: Optional[int] = None,
                    series: bool = False,
                    aux_grad: Optional[bool] = None,
-                   by_bwd: Optional[int] = None):
+                   by_bwd: Optional[int] = None,
+                   bwd: str = "auto"):
     """Build ``step(state, params) -> (state, chunk_globals)`` advancing
     ``step.chunk`` iterations on the fused Pallas kernels,
     differentiable end-to-end: forward = the generic engine's
@@ -335,13 +692,14 @@ def make_diff_step(model: Model, shape, dtype=jnp.float32,
     kernel emits the aux-stack cotangent at all (an extra HBM write).
 
     3D shapes dispatch to :func:`_make_diff_step_3d` (z-slab Pallas
-    forward, XLA-chain backward; no series flavor)."""
+    forward AND backward; ``bwd="xla"`` keeps the PR 9 hybrid as the
+    measured baseline; no series flavor)."""
     if len(shape) == 3:
         if series:
             raise ValueError("3D diff step: no Control-series flavor")
         return _make_diff_step_3d(model, shape, dtype,
                                   interpret=interpret, present=present,
-                                  k=k)
+                                  k=k, bwd=bwd)
     ny, nx = (int(s) for s in shape)
     if series:
         k = 1

@@ -120,6 +120,33 @@ class _Staged:
         self.waits = waits
 
 
+class LaneLease:
+    """A reservation of one fleet lane's DEVICE by a non-serving tenant
+    (the revolve peer-HBM spill tier).  While held, the lane's stager
+    takes no batches — serving jobs and spill tenants never fight for
+    the device's memory.  The dispatcher may *revoke* the lease when
+    serving demand needs the lane back; the tenant's ``on_revoke``
+    callback must then migrate its data off the device (the revolve
+    store re-spills peer snapshots to disk) before the lane resumes."""
+
+    def __init__(self, disp: "FleetDispatcher", lane: "Lane", tenant: str,
+                 on_revoke: Optional[Callable[["LaneLease", str], None]]
+                 = None):
+        self.disp = disp
+        self.lane = lane
+        self.tenant = tenant
+        self.on_revoke = on_revoke
+        self.revoked = False
+        self.released = False
+
+    @property
+    def device(self):
+        return self.lane.device
+
+    def release(self) -> None:
+        self.disp.release_lane(self)
+
+
 class Lane:
     """One device's serving lane: a staging thread feeding an execute
     thread through a one-slot buffer (the double buffer)."""
@@ -132,6 +159,9 @@ class Lane:
         self.device_str = str(device)
         self.cache = CompiledCache()
         self.evicted = False
+        # tenant name while a LaneLease holds this lane, else None
+        # (written under the dispatcher lock; the stager polls it)
+        self.reserved: Optional[str] = None
         self.batches = 0
         self.jobs_served = 0
         self.busy_s = 0.0
@@ -419,6 +449,7 @@ class FleetDispatcher:
         self._plans: dict[tuple, EnsemblePlan] = {}
         self._plan_lock = locks.make_lock("serve.dispatcher.FleetDispatcher._plan_lock")
         self._jobs = 0
+        self._leases: list[LaneLease] = []
         self._lock = locks.make_lock("serve.dispatcher.FleetDispatcher._lock")
         self._inflight: dict[int, Job] = {}
         self._closing = False
@@ -483,7 +514,10 @@ class FleetDispatcher:
                        "busy_s": round(l.busy_s, 6),
                        "occupancy_pct": round(100.0 * l.busy_s / wall, 2),
                        "failstreak": l.failstreak,
-                       "evicted": l.evicted} for l in self.lanes],
+                       "evicted": l.evicted,
+                       "reserved": l.reserved} for l in self.lanes],
+            "reserved_lanes": sum(1 for l in self.lanes
+                                  if l.reserved is not None),
             "evicted_devices": [l.device_str for l in self.lanes
                                 if l.evicted],
             "uptime_s": round(wall, 3),
@@ -664,6 +698,11 @@ class FleetDispatcher:
         cap is the memory predicate AND a fair share of the visible
         burst, so 16 queued jobs land one-batch-per-device instead of
         one lane swallowing them all."""
+        if lane.reserved is not None:
+            # a spill tenant holds the device; don't pull work the lane
+            # cannot run — the queue stays for the unreserved lanes
+            time.sleep(0.05)
+            return None
         try:
             first = self._queue.get(timeout=0.1)
         except queue.Empty:
@@ -681,7 +720,8 @@ class FleetDispatcher:
             self._stream(first)
             return []
         key = _bin_key(first.spec)
-        active = max(1, sum(1 for l in self.lanes if not l.evicted))
+        active = max(1, sum(1 for l in self.lanes
+                            if not l.evicted and l.reserved is None))
         fair = -(-(self._queue.qsize() + 1) // active)  # ceil
         cap = max(1, min(self.batch_cap(first.spec), fair))
         batch, requeue = [first], []
@@ -800,6 +840,69 @@ class FleetDispatcher:
             lat.iterate(spec.niter)
         return EnsembleResult(case=spec.case, state=lat.state,
                               globals=lat.get_globals())
+
+    # -- lane reservation (spill tenants) ------------------------------------ #
+
+    def reserve_lane(self, tenant: str = "adjoint",
+                     on_revoke: Optional[Callable] = None
+                     ) -> Optional[LaneLease]:
+        """Lease one idle lane's device to a non-serving tenant (the
+        revolve peer-HBM spill tier), or None when no lane can be
+        spared.  At least one healthy lane always stays unreserved so
+        serving never starves; evicted lanes are never leased (their
+        device already failed).  The lease is revocable: serving demand
+        may reclaim the lane via :meth:`revoke_lease`, after the
+        tenant's ``on_revoke`` migrated its data off the device."""
+        with self._lock:
+            free = [l for l in self.lanes
+                    if not l.evicted and l.reserved is None]
+            if len(free) < 2:
+                return None   # keep the last healthy lane serving
+            # prefer an idle lane: leasing mid-batch would co-host the
+            # tenant's buffers with a running batch's working set
+            lane = next((l for l in free if l._idle.is_set()), free[0])
+            lane.reserved = tenant
+            lease = LaneLease(self, lane, tenant, on_revoke)
+            self._leases.append(lease)
+        telemetry.counter("serve.lane_reserved")
+        telemetry.event("serve.lane_reserved", lane=lane.index,
+                        device=lane.device_str, tenant=tenant)
+        return lease
+
+    def release_lane(self, lease: LaneLease) -> None:
+        """Return a leased lane to serving (idempotent)."""
+        with self._lock:
+            if lease.released:
+                return
+            lease.released = True
+            if lease in self._leases:
+                self._leases.remove(lease)
+            lease.lane.reserved = None
+        telemetry.counter("serve.lane_released")
+        telemetry.event("serve.lane_released", lane=lease.lane.index,
+                        device=lease.lane.device_str, tenant=lease.tenant)
+
+    def revoke_lease(self, lease: LaneLease, reason: str = "demand") -> None:
+        """Reclaim a leased lane for serving: notify the tenant (which
+        must migrate its device-resident data — the revolve store
+        re-spills peer snapshots to disk), then release the lane.  The
+        callback runs OUTSIDE the dispatcher lock: it does device work
+        (D2H fetches + disk writes)."""
+        with self._lock:
+            if lease.released or lease.revoked:
+                return
+            lease.revoked = True
+        telemetry.counter("serve.lane_revoked")
+        telemetry.event("serve.lane_revoked", lane=lease.lane.index,
+                        device=lease.lane.device_str, tenant=lease.tenant,
+                        reason=reason)
+        if lease.on_revoke is not None:
+            try:
+                lease.on_revoke(lease, reason)
+            except Exception as e:  # noqa: BLE001 - reclaim regardless
+                log.warning(f"fleet: lease revoke callback failed "
+                            f"({lease.tenant}): {e!r}")
+        self.release_lane(lease)
 
     # -- eviction / bookkeeping --------------------------------------------- #
 
@@ -938,6 +1041,7 @@ class FleetDispatcher:
             "devices": [str(d) for d in self.devices],
             "lanes": [{"lane": l.index, "device": str(l.device),
                        "batches": l.batches, "evicted": l.evicted,
+                       "reserved": l.reserved,
                        "cache": l.cache.stats()} for l in self.lanes],
             "jobs": self._jobs,
         }

@@ -104,13 +104,16 @@ def _adjoint_summary(evts: list[dict]) -> dict:
         key = f"{s.get('model', '?')}/{s.get('mode', '?')}"
         row = rows.setdefault(key, {
             "sweeps": 0, "total_s": 0.0, "peak_snapshots": 0,
-            "spill_bytes": 0, "recompute_factor": None,
+            "spill_bytes": 0, "spill_mem": 0, "spill_peer": 0,
+            "spill_disk": 0, "recompute_factor": None,
             "engine": s.get("engine")})
         row["sweeps"] += 1
         row["total_s"] += float(s.get("dur_s", 0.0))
         row["peak_snapshots"] = max(row["peak_snapshots"],
                                     int(s.get("peak_snapshots", 0) or 0))
         row["spill_bytes"] += int(s.get("spill_bytes", 0) or 0)
+        for tier in ("spill_mem", "spill_peer", "spill_disk"):
+            row[tier] += int(s.get(tier, 0) or 0)
         if s.get("recompute_factor") is not None:
             row["recompute_factor"] = float(s["recompute_factor"])
         if s.get("engine") is not None:
@@ -549,6 +552,42 @@ def compare(base: dict, other: dict, threshold: float = 0.05) -> dict:
                         "delta_pct": row["p95_delta_pct"]})
             rows[phase] = row
         out["slo"] = rows
+    # adjoint tier split: parking snapshots on a peer device (or disk)
+    # must stay cheap — a sweep whose mean wall time grew past the
+    # threshold while the candidate's spill columns carry bytes
+    # localizes the regression to a TIER, not just "gradients got
+    # slower" (the CI spill-overhead gate keys on exactly this row)
+    aa = (base.get("adjoint") or {}).get("modes") or {}
+    ab = (other.get("adjoint") or {}).get("modes") or {}
+    if aa or ab:
+        def _tiers(r):
+            return None if r is None else {
+                "mem": int(r.get("spill_mem", 0) or 0),
+                "peer": int(r.get("spill_peer", 0) or 0),
+                "disk": int(r.get("spill_disk", 0) or 0)}
+
+        def _mean(r):
+            return None if not r or not r.get("sweeps") else \
+                r["total_s"] / r["sweeps"]
+        rows = {}
+        for key in sorted(set(aa) | set(ab)):
+            ra, rb = aa.get(key), ab.get(key)
+            ma, mb = _mean(ra), _mean(rb)
+            row = {"base_spill": _tiers(ra), "other_spill": _tiers(rb),
+                   "base_mean_s": None if ma is None else round(ma, 6),
+                   "other_mean_s": None if mb is None else round(mb, 6)}
+            if ma and mb is not None:
+                delta = (mb - ma) / ma
+                row["mean_delta_pct"] = round(100 * delta, 2)
+                if delta > threshold:
+                    out["regressions"].append({
+                        "what": "adjoint_sweep_time", "mode": key,
+                        "base_mean_s": round(ma, 6),
+                        "other_mean_s": round(mb, 6),
+                        "delta_pct": row["mean_delta_pct"],
+                        "other_spill": _tiers(rb)})
+            rows[key] = row
+        out["adjoint"] = rows
     # fallback-chain drift is a regression signal of its own (an engine
     # newly failing to compile shows up here before any timing does)
     fb_a = [(f.get("from"), f.get("to")) for f in base.get("fallbacks", [])]
@@ -711,14 +750,16 @@ def format_text(summary: dict) -> str:
         lines.append("adjoint")
         lines.append(f"  {'model/mode':<28} {'sweeps':>6} {'time_s':>10} "
                      f"{'peak_snaps':>10} {'recompute':>10} "
-                     f"{'spill_MB':>9}")
+                     f"{'mem_MB':>8} {'peer_MB':>8} {'disk_MB':>8}")
         for key, r in ad["modes"].items():
             lines.append(
                 f"  {key:<28} {r['sweeps']:>6} "
                 f"{_fmt(r['total_s'], 3):>10} "
                 f"{r['peak_snapshots']:>10} "
                 f"{_fmt(r['recompute_factor'], 3):>10} "
-                f"{_fmt(r['spill_bytes'] / 1e6, 2):>9}")
+                f"{_fmt(r.get('spill_mem', 0) / 1e6, 2):>8} "
+                f"{_fmt(r.get('spill_peer', 0) / 1e6, 2):>8} "
+                f"{_fmt(r.get('spill_disk', 0) / 1e6, 2):>8}")
         lines.append("")
     if summary.get("fleet"):
         fl = summary["fleet"]

@@ -184,6 +184,45 @@ def test_footprint_fires_on_fusion_halo_overreach():
     assert "footprint.fusion_halo" in _error_checks(check_footprint(m))
 
 
+def test_footprint_fires_on_3d_adjoint_band_overreach():
+    """A 3D model whose fuse-1 chain reach R needs 2*R halo slabs beyond
+    what the fused backward (Run_b) slab kernel ever DMAs
+    (fusion.ADJ_HALO_MAX per side): for an ``_adj`` model that is an
+    error — the model claims adjoint support but every reverse sweep
+    silently degrades — and for other names a warning."""
+    from tclb_tpu.analysis.footprint import check_footprint
+    from tclb_tpu.ops import fusion
+
+    def build(name):
+        d = ModelDef(name, ndim=3)
+        d.add_density("g", group="g")
+        d.add_field("phi", dz=(-5, 5))   # 2*R = 10 > ADJ_HALO_MAX = 8
+        run = _passthrough(["g", "phi"])
+        return d.finalize().bind(run=run, init=run)
+
+    assert fusion.ADJ_HALO_MAX < 10
+    errs = _error_checks(check_footprint(build("fx_wide_adj")))
+    assert "footprint.adjoint_band" in errs
+    # same geometry without the adjoint claim: capability warning only
+    fs = check_footprint(build("fx_wide"))
+    bands = [f for f in fs if f.check == "footprint.adjoint_band"]
+    assert bands and all(f.severity == "warning" for f in bands)
+
+
+def test_footprint_3d_adjoint_chunk_on_real_model():
+    """The clean side of the band rule: d3q19_adj at its production
+    chunk sits exactly at the halo boundary and must report the info
+    finding (with the planner's (k, bz) at a concrete shape), never the
+    error."""
+    from tclb_tpu.analysis.footprint import check_footprint
+    m = get_model("d3q19_adj")
+    fs = check_footprint(m, shape=(8, 16, 128))
+    assert "footprint.adjoint_band" not in _error_checks(fs)
+    info = [f for f in fs if f.check == "footprint.adjoint_chunk"]
+    assert info and info[0].details["max_chunk"] >= 1
+    assert "k" in info[0].details and "bz" in info[0].details
+
+
 def test_resources_fire_on_vmem_overflow():
     d = ModelDef("fx_vmem", ndim=2)
     for i in range(120):

@@ -569,6 +569,137 @@ def test_pool_telemetry_relay_schedule_never_blocks_jobs(tmp_path, mode):
 
 
 # --------------------------------------------------------------------------- #
+# Adjoint D2D spill: failed peer parks degrade to the disk tier
+# --------------------------------------------------------------------------- #
+
+
+def _spill_fleet():
+    # two non-default host devices (conftest forces 8): the peer park
+    # is a genuine cross-device device_put
+    return FleetDispatcher(devices=jax.devices()[1:3])
+
+
+def test_adjoint_d2d_point_registered_and_spec_roundtrips():
+    assert "adjoint.spill_d2d" in faults.POINTS
+    plan = FaultPlan.parse(
+        "seed=3;adjoint.spill_d2d:error:n=1;checkpoint.write:torn:n=1")
+    assert FaultPlan.parse(plan.to_spec()) == plan
+
+
+def test_adjoint_d2d_error_degrades_store_to_disk(tmp_path):
+    """An injected D2D park failure tears down the peer tier: the
+    snapshot (and everything after it) lands on disk bit-exact, the
+    lane lease is returned, and the degrade is observable."""
+    from tclb_tpu.adjoint.revolve import SnapshotStore
+    evts = []
+    telemetry.subscribe(evts.append)
+    faults.install(FaultPlan.parse("seed=3;adjoint.spill_d2d:error:n=1"))
+    try:
+        with _spill_fleet() as d:
+            store = SnapshotStore(mem_slots=0, peer_slots=2,
+                                  spill_dir=str(tmp_path), dispatcher=d)
+            try:
+                vals = [(np.full((16, 16), float(k)), np.int32(k))
+                        for k in range(2)]
+                for k, v in enumerate(vals):
+                    store.put(k, v)
+                store.wait()
+                assert [store.tier_of(k) for k in range(2)] \
+                    == ["disk", "disk"]
+                for k, v in enumerate(vals):
+                    got = store.get(k)
+                    for a, b in zip(got, v):
+                        np.testing.assert_array_equal(np.asarray(a), b)
+                # no lane left reserved after the failed park
+                assert all(l.reserved is None for l in d.lanes)
+            finally:
+                store.close()
+        assert faults.stats()["injected"][0]["count"] == 1
+        assert any(e.get("kind") == "adjoint.spill_peer_down"
+                   for e in evts)
+    finally:
+        telemetry.unsubscribe(evts.append)
+
+
+def test_adjoint_d2d_slow_schedule_latency_only(tmp_path):
+    """A slow-mode D2D schedule adds latency, never failure: the parks
+    still land on the peer tier and the lease survives."""
+    from tclb_tpu.adjoint.revolve import SnapshotStore
+    faults.install(FaultPlan.parse(
+        "seed=9;adjoint.spill_d2d:slow:delay=0.01:n=2"))
+    with _spill_fleet() as d:
+        store = SnapshotStore(mem_slots=0, peer_slots=2,
+                              spill_dir=str(tmp_path), dispatcher=d)
+        try:
+            vals = [(np.full((16, 16), float(k)), np.int32(k))
+                    for k in range(2)]
+            for k, v in enumerate(vals):
+                store.put(k, v)
+            assert [store.tier_of(k) for k in range(2)] \
+                == ["peer", "peer"]
+            assert store.evacuations == 0
+            assert store._lease is not None \
+                and not store._lease.released
+            for k, v in enumerate(vals):
+                got = store.get(k)
+                for a, b in zip(got, v):
+                    np.testing.assert_array_equal(np.asarray(a), b)
+        finally:
+            store.close()
+        assert all(l.reserved is None for l in d.lanes)
+    assert faults.stats()["injected"][0]["count"] == 2
+
+
+@pytest.mark.slow
+def test_adjoint_d2d_fault_gradient_bit_identical(tmp_path):
+    """The blast-radius contract for the peer spill tier: a seeded D2D
+    failure mid-sweep degrades the spill to disk, the gradient stays
+    bit-identical to the clean peer-tier run, and no lane is left
+    reserved."""
+    import jax.numpy as jnp
+    from tclb_tpu.adjoint import InternalTopology
+    from tclb_tpu.adjoint.revolve import make_revolve_gradient
+    from tclb_tpu.core.lattice import Lattice
+
+    m = get_model("d2q9_adj")
+    lat = Lattice(m, (8, 16), dtype=jnp.float64,
+                  settings={"nu": 0.1, "Velocity": 0.05, "Porocity": 0.5,
+                            "DragInObj": 1.0, "MaterialInObj": 0.0})
+    flags = np.full((8, 16), m.flag_for("MRT"), dtype=np.uint16)
+    flags[:, 0] = m.flag_for("WVelocity", "MRT")
+    flags[:, -1] = m.flag_for("EPressure", "MRT")
+    flags[0, :] = flags[-1, :] = m.flag_for("Wall")
+    flags[2:6, 5:10] |= m.flag_for("DesignSpace")
+    lat.set_flags(flags)
+    lat.init()
+    design = InternalTopology(m)
+    theta0 = design.get(lat.state, lat.params)
+
+    with _spill_fleet() as d:
+        rev = make_revolve_gradient(m, design, 12, snapshots=4,
+                                    engine="xla", shape=(8, 16),
+                                    dtype=jnp.float64, mem_slots=1,
+                                    peer_slots=3,
+                                    spill_dir=str(tmp_path / "spill"),
+                                    dispatcher=d)
+        o_clean, g_clean, _ = rev(theta0, lat.state, lat.params)
+        assert rev.last["spill_peer"] > 0
+
+        faults.install(FaultPlan.parse(
+            "seed=3;adjoint.spill_d2d:error:n=1"))
+        o_fault, g_fault, _ = rev(theta0, lat.state, lat.params)
+        assert rev.last["spill_peer"] == 0
+        assert rev.last["spill_disk"] > 0
+        assert "disk" in rev.last["tiers"]
+        assert all(l.reserved is None for l in d.lanes)
+
+    assert float(o_fault) == float(o_clean)
+    np.testing.assert_array_equal(np.asarray(g_fault),
+                                  np.asarray(g_clean))
+    assert faults.stats()["injected"][0]["count"] == 1
+
+
+# --------------------------------------------------------------------------- #
 # Cluster schedules: the three cluster.* injection points
 # --------------------------------------------------------------------------- #
 

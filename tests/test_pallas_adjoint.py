@@ -57,9 +57,15 @@ def test_supports_diff():
     # series flavor (control gradients)
     assert pallas_adjoint.supports_diff(m, (16, 128), jnp.float32,
                                         series=True)
-    # 3D hybrid (Pallas forward / XLA backward) is in scope now
-    assert pallas_adjoint.supports_diff(get_model("d3q19_adj"),
-                                        (8, 16, 128), jnp.float32)
+    # 3D is in scope: fused Pallas backward whenever a (k, bz) slab
+    # config fits VMEM, XLA-chain backward otherwise
+    m3 = get_model("d3q19_adj")
+    assert pallas_adjoint.supports_diff(m3, (8, 16, 128), jnp.float32)
+    assert pallas_adjoint.max_chunk(m3) == 4
+    plan3 = pallas_adjoint.adjoint_slab_plan(m3, (8, 16, 128))
+    assert plan3 is not None
+    k3, bz3 = plan3
+    assert k3 >= 1 and 8 % bz3 == 0
 
 
 def test_design_needs_classifier():
@@ -180,37 +186,80 @@ def test_pallas_kuper_gradient():
     np.testing.assert_allclose(gp, gx, rtol=1e-3, atol=2e-6)
 
 
-def test_pallas_3d_gradient_matches_xla():
-    """3D hybrid engine (d3q19_adj): Pallas runs the forward sweep, XLA
-    the backward — same traced action chain, so the gradients must agree
-    at f32 tolerance with the all-XLA adjoint."""
+def _setup_3d(shape=(4, 8, 128)):
     m = get_model("d3q19_adj")
-    shape = (6, 16, 128)
     lat = Lattice(m, shape, dtype=jnp.float32,
                   settings={"nu": 0.1, "Velocity": 0.02, "Porocity": 0.5,
                             "DragInObj": 1.0})
     flags = np.full(shape, m.flag_for("MRT"), np.uint16)
     flags[:, 0, :] = flags[:, -1, :] = m.flag_for("Wall")
-    flags[1:4, 4:10, 20:40] |= m.flag_for("DesignSpace")
+    flags[1:3, 2:6, 20:40] |= m.flag_for("DesignSpace")
     lat.set_flags(flags)
     lat.init()
+    return m, lat
+
+
+def test_pallas_3d_fused_gradient_matches_xla():
+    """The 3D tentpole: the fused z-slab Pallas BACKWARD kernel (the 3D
+    ``Run_b``) against the all-XLA adjoint — same traced action chain,
+    so the gradients must agree at f32 tolerance.  (4, 8, 128) is the
+    smallest k=2 slab config, kept small because CPU interpret-mode
+    compiles dominate the wall clock."""
+    m, lat = _setup_3d()
     design = InternalTopology(m)
     theta0 = design.get(lat.state, lat.params)
     g_x = make_unsteady_gradient(m, design, 4, levels=1, engine="xla")
     obj_x, gx, fin_x = g_x(theta0, lat.state, lat.params)
     g_p = make_unsteady_gradient(m, design, 4, levels=1,
-                                 engine="pallas", shape=shape,
+                                 engine="pallas", shape=lat.shape,
                                  dtype=jnp.float32)
-    assert g_p.engine_name.startswith("pallas_adjoint3d")
-    assert "bwd=xla" in g_p.engine_name
+    # the fused backward, NOT the PR 9 hybrid: a silent degrade to the
+    # XLA-chain backward would tag pallas_adjoint3d[...,bwd=xla]
+    assert g_p.engine_name.startswith("pallas_adjoint[d3q19_adj")
+    assert ",3d]" in g_p.engine_name and "k=2" in g_p.engine_name
     obj_p, gp, fin_p = g_p(theta0, lat.state, lat.params)
     gx, gp = np.asarray(gx), np.asarray(gp)
     assert float(obj_x) == pytest.approx(float(obj_p), rel=1e-5)
     assert np.abs(gx).max() > 0.0
-    np.testing.assert_allclose(gp, gx, rtol=1e-4, atol=1e-6)
+    np.testing.assert_allclose(gp, gx, rtol=1e-4, atol=3e-7)
     np.testing.assert_allclose(np.asarray(fin_p.fields),
                                np.asarray(fin_x.fields),
                                rtol=1e-5, atol=1e-6)
+
+
+def test_pallas_3d_hybrid_gradient_matches_xla(monkeypatch):
+    """The PR 9 hybrid (Pallas forward / XLA-chain backward) stays
+    available as the degrade target: with no feasible (k, bz) slab plan
+    the auto path builds it, tags it honestly, and still matches the
+    all-XLA adjoint."""
+    monkeypatch.setattr(pallas_adjoint, "adjoint_slab_plan",
+                        lambda *a, **k: None)
+    m, lat = _setup_3d()
+    design = InternalTopology(m)
+    theta0 = design.get(lat.state, lat.params)
+    g_x = make_unsteady_gradient(m, design, 4, levels=1, engine="xla")
+    obj_x, gx, _ = g_x(theta0, lat.state, lat.params)
+    g_p = make_unsteady_gradient(m, design, 4, levels=1,
+                                 engine="pallas", shape=lat.shape,
+                                 dtype=jnp.float32)
+    assert g_p.engine_name.startswith("pallas_adjoint3d")
+    assert "bwd=xla" in g_p.engine_name
+    obj_p, gp, _ = g_p(theta0, lat.state, lat.params)
+    gx, gp = np.asarray(gx), np.asarray(gp)
+    assert float(obj_x) == pytest.approx(float(obj_p), rel=1e-5)
+    assert np.abs(gx).max() > 0.0
+    np.testing.assert_allclose(gp, gx, rtol=1e-4, atol=1e-6)
+
+
+def test_pallas_3d_bwd_pallas_raises_when_infeasible(monkeypatch):
+    """``bwd="pallas"`` is a hard request: when no slab config fits the
+    VMEM budget it must raise, never silently hand back the hybrid."""
+    monkeypatch.setattr(pallas_adjoint, "adjoint_slab_plan",
+                        lambda *a, **k: None)
+    m = get_model("d3q19_adj")
+    with pytest.raises(ValueError, match="VMEM"):
+        pallas_adjoint.make_diff_step(m, (4, 8, 128), jnp.float32,
+                                      k=2, bwd="pallas")
 
 
 def test_pallas_gradient_vs_fd():

@@ -20,6 +20,7 @@ import jax.numpy as jnp
 import numpy as np
 import pytest
 
+from tclb_tpu import telemetry
 from tclb_tpu.adjoint import (InternalTopology, batched_descent,
                               make_unsteady_gradient)
 from tclb_tpu.adjoint.revolve import (SnapshotStore, auto_plan,
@@ -29,8 +30,8 @@ from tclb_tpu.adjoint.revolve import (SnapshotStore, auto_plan,
 from tclb_tpu.core.lattice import Lattice
 from tclb_tpu.models import get_model
 from tclb_tpu.ops import fusion
-from tclb_tpu.serve import (Case, GradSpec, JobSpec, Scheduler,
-                            make_grad_evaluator)
+from tclb_tpu.serve import (Case, FleetDispatcher, GradSpec, JobSpec,
+                            Scheduler, make_grad_evaluator)
 from tclb_tpu.serve.ensemble import EnsemblePlan
 
 
@@ -184,6 +185,118 @@ def test_auto_plan_splits_tiers():
 
 
 # --------------------------------------------------------------------------- #
+# Three-tier store: peer-device HBM via a leased fleet lane (D2D)
+# --------------------------------------------------------------------------- #
+
+
+def _fleet2():
+    """Two NON-default host devices (conftest forces 8 virtual CPU
+    devices), so the peer park is a genuine cross-device device_put —
+    the forced-host stand-in for a pod's D2D over ICI."""
+    return FleetDispatcher(devices=jax.devices()[1:3])
+
+
+def test_snapshot_store_peer_tier_d2d_round_trip():
+    with _fleet2() as d:
+        store = SnapshotStore(mem_slots=1, peer_slots=2, dispatcher=d)
+        try:
+            for k in range(3):
+                store.put(k, _tree(k))
+            assert [store.tier_of(k) for k in range(3)] \
+                == ["mem", "peer", "peer"]
+            lease = store._lease
+            assert lease is not None and not lease.released
+            assert lease.device in jax.devices()[1:3]
+            # the parked leaves actually live on the leased peer device
+            for leaf in jax.tree.leaves(store._peer[1]):
+                assert leaf.devices() == {lease.device}
+            for k in range(3):
+                got = store.get(k)
+                for a, b in zip(got, _tree(k)):
+                    np.testing.assert_array_equal(np.asarray(a), b)
+            assert store.tier_bytes["peer"] > 0
+            assert store.spill_bytes == store.tier_bytes["peer"]
+        finally:
+            store.close()
+        # the lease is returned with the store: nothing stays reserved
+        assert all(l.reserved is None for l in d.lanes)
+
+
+def test_snapshot_store_three_tier_ladder(tmp_path):
+    """mem -> peer -> disk, in that order, and every tier round-trips
+    the exact bytes."""
+    with _fleet2() as d:
+        store = SnapshotStore(mem_slots=1, peer_slots=1,
+                              spill_dir=str(tmp_path), dispatcher=d)
+        try:
+            for k in range(4):
+                store.put(k, _tree(k))
+            assert [store.tier_of(k) for k in range(4)] \
+                == ["mem", "peer", "disk", "disk"]
+            store.wait()
+            for k in range(4):
+                got = store.get(k)
+                for a, b in zip(got, _tree(k)):
+                    np.testing.assert_array_equal(np.asarray(a), b)
+            for tier in ("mem", "peer", "disk"):
+                assert store.tier_bytes[tier] > 0, tier
+            assert store.spill_bytes \
+                == store.tier_bytes["peer"] + store.tier_bytes["disk"]
+        finally:
+            store.close()
+        assert all(l.reserved is None for l in d.lanes)
+    assert not [p for p in os.listdir(tmp_path) if p.endswith(".npy")]
+
+
+def test_peer_revocation_migrates_snapshots_down(tmp_path):
+    """Serving demand reclaims the leased lane: every peer snapshot
+    migrates down the ladder bit-exact, the lane comes back unreserved,
+    and later parks go straight to disk (no re-lease mid-sweep)."""
+    evts = []
+    telemetry.subscribe(evts.append)
+    try:
+        with _fleet2() as d:
+            store = SnapshotStore(mem_slots=0, peer_slots=2,
+                                  spill_dir=str(tmp_path), dispatcher=d)
+            try:
+                store.put(0, _tree(0))
+                store.put(1, _tree(1))
+                assert [store.tier_of(k) for k in (0, 1)] \
+                    == ["peer", "peer"]
+                d.revoke_lease(store._lease, reason="demand")
+                assert [store.tier_of(k) for k in (0, 1)] \
+                    == ["disk", "disk"]
+                assert store.evacuations == 2
+                assert all(l.reserved is None for l in d.lanes)
+                store.put(2, _tree(2))
+                assert store.tier_of(2) == "disk"
+                for k in range(3):
+                    got = store.get(k)
+                    for a, b in zip(got, _tree(k)):
+                        np.testing.assert_array_equal(np.asarray(a), b)
+            finally:
+                store.close()
+        kinds = [e.get("kind") for e in evts]
+        assert "serve.lane_revoked" in kinds
+        assert "adjoint.spill_peer_down" in kinds
+    finally:
+        telemetry.unsubscribe(evts.append)
+
+
+def test_reserve_lane_never_starves_serving():
+    """The dispatcher never leases its last healthy lane: a 1-lane
+    fleet refuses, a 2-lane fleet grants exactly one."""
+    with FleetDispatcher(devices=jax.devices()[:1]) as d1:
+        assert d1.reserve_lane(tenant="adjoint.spill") is None
+    with _fleet2() as d2:
+        lease = d2.reserve_lane(tenant="adjoint.spill")
+        assert lease is not None
+        assert d2.reserve_lane(tenant="adjoint.spill") is None
+        lease.release()
+        assert all(l.reserved is None for l in d2.lanes)
+
+
+# --------------------------------------------------------------------------- #
 # Gradient parity (slow tier: full adjoint compiles)
 # --------------------------------------------------------------------------- #
 
@@ -269,6 +382,93 @@ def test_revolve_spill_tier_matches(tmp_path):
     o_ref, g_ref, _ = ref(theta0, lat.state, lat.params)
     assert float(o1) == float(o_ref)
     _assert_ulp_close(g1, g_ref)
+
+
+@pytest.mark.slow
+def test_revolve_tier_split_bit_invariant(tmp_path):
+    """The gradient is bit-invariant to the TIER SPLIT, not just to S:
+    all-mem == mem+peer == mem+peer+disk, bit for bit, and no lane is
+    left reserved after any sweep."""
+    m, lat = _setup()
+    design = InternalTopology(m)
+    theta0 = design.get(lat.state, lat.params)
+    niter = 12
+
+    rev0 = make_revolve_gradient(m, design, niter, snapshots=4,
+                                 engine="xla", shape=(8, 16),
+                                 dtype=jnp.float64)
+    o0, g0, s0 = rev0(theta0, lat.state, lat.params)
+    assert rev0.last["tiers"] == ["mem"]
+
+    with _fleet2() as d:
+        rev1 = make_revolve_gradient(m, design, niter, snapshots=4,
+                                     engine="xla", shape=(8, 16),
+                                     dtype=jnp.float64, mem_slots=1,
+                                     peer_slots=3, dispatcher=d)
+        o1, g1, s1 = rev1(theta0, lat.state, lat.params)
+        assert rev1.last["spill_peer"] > 0
+        assert all(l.reserved is None for l in d.lanes)
+
+        rev2 = make_revolve_gradient(m, design, niter, snapshots=4,
+                                     engine="xla", shape=(8, 16),
+                                     dtype=jnp.float64, mem_slots=1,
+                                     peer_slots=1,
+                                     spill_dir=str(tmp_path),
+                                     dispatcher=d)
+        o2, g2, s2 = rev2(theta0, lat.state, lat.params)
+        assert rev2.last["spill_peer"] > 0
+        assert rev2.last["spill_disk"] > 0
+        assert sorted(rev2.last["tiers"]) == ["disk", "mem", "peer"]
+        assert all(l.reserved is None for l in d.lanes)
+
+    assert float(o1) == float(o0) == float(o2)
+    np.testing.assert_array_equal(np.asarray(g1), np.asarray(g0))
+    np.testing.assert_array_equal(np.asarray(g2), np.asarray(g0))
+    np.testing.assert_array_equal(np.asarray(s1.fields),
+                                  np.asarray(s0.fields))
+    np.testing.assert_array_equal(np.asarray(s2.fields),
+                                  np.asarray(s0.fields))
+
+
+@pytest.mark.slow
+def test_revolve_peer_eviction_mid_sweep_gradient_unchanged(tmp_path):
+    """Serving demand revokes the leased lane DURING the sweep (the
+    revocation fires synchronously off the lane-reserved event): the
+    spill falls through to the disk tier mid-flight, the gradient stays
+    bit-identical to the all-memory run, and no lane is left
+    reserved."""
+    m, lat = _setup()
+    design = InternalTopology(m)
+    theta0 = design.get(lat.state, lat.params)
+    niter = 12
+
+    rev0 = make_revolve_gradient(m, design, niter, snapshots=4,
+                                 engine="xla", shape=(8, 16),
+                                 dtype=jnp.float64)
+    _, g0, _ = rev0(theta0, lat.state, lat.params)
+
+    with _fleet2() as d:
+        def demand(e):
+            if e.get("kind") == "serve.lane_reserved" \
+                    and e.get("tenant") == "adjoint.spill":
+                d.revoke_lease(d._leases[-1], reason="demand")
+
+        telemetry.subscribe(demand)
+        try:
+            rev = make_revolve_gradient(m, design, niter, snapshots=4,
+                                        engine="xla", shape=(8, 16),
+                                        dtype=jnp.float64, mem_slots=1,
+                                        peer_slots=3,
+                                        spill_dir=str(tmp_path),
+                                        dispatcher=d)
+            _, g1, _ = rev(theta0, lat.state, lat.params)
+        finally:
+            telemetry.unsubscribe(demand)
+        assert all(l.reserved is None for l in d.lanes)
+
+    assert rev.last["spill_peer"] == 0    # the lane was reclaimed
+    assert rev.last["spill_disk"] > 0     # ... and the spill degraded
+    np.testing.assert_array_equal(np.asarray(g1), np.asarray(g0))
 
 
 @pytest.mark.slow
